@@ -1,0 +1,18 @@
+"""Test configuration: force an 8-device virtual CPU mesh BEFORE jax import.
+
+This is the TPU analogue of the reference's in-process MiniCluster test
+substrate (``tony-mini/.../MiniCluster.java:43-63``): all distributed tests run
+against host-local virtual devices so CI needs no hardware (SURVEY.md §4.1).
+"""
+
+import os
+import sys
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+
+# Make `import tony_tpu` work no matter where pytest is invoked from.
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))

@@ -1,0 +1,126 @@
+"""Config system tests.
+
+Mirrors reference coverage: ``TestTonyConfigurationFields.java:17-45``
+(keys↔defaults parity), ``TestTonyClient.java`` (validation/limits), and the
+layered-merge semantics of ``TonyClient.initTonyConf`` :483-517.
+"""
+
+import json
+import os
+
+import pytest
+
+from tony_tpu import constants
+from tony_tpu.conf import keys as K
+from tony_tpu.conf.config import ConfigError, TonyTpuConfig
+
+
+def test_defaults_present():
+    conf = TonyTpuConfig()
+    assert conf.get(K.TASK_HEARTBEAT_INTERVAL_MS) == 1000
+    assert conf.get(K.TASK_MAX_MISSED_HEARTBEATS) == 25
+    assert conf.get(K.TASK_REGISTRATION_TIMEOUT_S) == 900
+    assert conf.get(K.APPLICATION_FRAMEWORK) == "jax"
+
+
+def test_registry_defaults_are_typed():
+    """Every registered key's default must match its declared type
+    (the parity discipline of TestTonyConfigurationFields)."""
+    for key in K.registry().values():
+        assert isinstance(key.default, key.type), key.name
+        assert key.doc, f"{key.name} missing documentation"
+
+
+def test_layering_and_overrides(tmp_path):
+    cfg_file = tmp_path / "job.json"
+    cfg_file.write_text(json.dumps({
+        "tony": {
+            "worker": {"instances": 4, "command": "python train.py"},
+            "application": {"name": "from-file"},
+        }
+    }))
+    conf = TonyTpuConfig.from_layers(
+        config_file=str(cfg_file),
+        overrides=["tony.application.name=from-override",
+                   "tony.worker.instances=2"],
+    )
+    assert conf.get("tony.application.name") == "from-override"
+    jobs = conf.job_types()
+    assert jobs["worker"].instances == 2
+    assert jobs["worker"].command == "python train.py"
+
+
+def test_site_file_is_last_layer(tmp_path, monkeypatch):
+    site = tmp_path / "site"
+    site.mkdir()
+    (site / "tony-site.json").write_text(
+        json.dumps({"tony.application.queue": "prod"}))
+    monkeypatch.setenv("TONY_TPU_CONF_DIR", str(site))
+    conf = TonyTpuConfig.from_layers(overrides=["tony.application.queue=dev"])
+    assert conf.get("tony.application.queue") == "prod"
+
+
+def test_multi_value_keys_append():
+    """Reference TonyClient.java:498-510 append semantics for multi-value keys."""
+    conf = TonyTpuConfig()
+    conf.set(K.APPLICATION_UNTRACKED_JOBTYPES, "ps")
+    conf.set(K.APPLICATION_UNTRACKED_JOBTYPES, "evaluator")
+    assert conf.get_list(K.APPLICATION_UNTRACKED_JOBTYPES) == ["ps", "evaluator"]
+
+
+def test_jobtype_discovery_and_dynamic_keys():
+    conf = TonyTpuConfig({
+        "tony.worker.instances": "3",
+        "tony.worker.chips": "4",
+        "tony.ps.instances": 1,
+        "tony.ps.env": "A=1,B=2",
+        "tony.dbloader.instances": 1,
+        "tony.dbloader.depends-on": "db",
+        "tony.db.instances": 1,
+    })
+    jobs = conf.job_types()
+    assert set(jobs) == {"worker", "ps", "dbloader", "db"}
+    assert jobs["worker"].instances == 3 and jobs["worker"].chips == 4
+    assert jobs["ps"].env == {"A": "1", "B": "2"}
+    assert jobs["dbloader"].depends_on == ("db",)
+
+
+def test_reserved_segments_not_jobtypes():
+    assert K.parse_job_key("tony.task.instances") is None
+    assert K.parse_job_key("tony.worker.instances") == ("worker", "instances")
+    assert K.parse_job_key("tony.worker.bogus") is None
+
+
+def test_validate_quotas():
+    """Reference TonyClient.validateTonyConf :598-667."""
+    conf = TonyTpuConfig({
+        "tony.worker.instances": 4,
+        "tony.worker.chips": 8,
+        "tony.application.max-total-instances": 2,
+    })
+    with pytest.raises(ConfigError, match="exceeds quota"):
+        conf.validate()
+    conf.set("tony.application.max-total-instances", -1)
+    conf.set("tony.application.max-total-chips", 16)
+    with pytest.raises(ConfigError, match="chips"):
+        conf.validate()
+    conf.set("tony.application.max-total-chips", 32)
+    conf.validate()
+
+
+def test_validate_unknown_dependency():
+    conf = TonyTpuConfig({
+        "tony.worker.instances": 1,
+        "tony.worker.depends-on": "nonexistent",
+    })
+    with pytest.raises(ConfigError, match="unknown jobtype"):
+        conf.validate()
+
+
+def test_freeze_and_load(tmp_path):
+    conf = TonyTpuConfig({"tony.worker.instances": 2})
+    final = tmp_path / constants.FINAL_CONFIG_FILE
+    conf.freeze(str(final))
+    loaded = TonyTpuConfig.load_final(str(final))
+    assert loaded.job_types()["worker"].instances == 2
+    assert loaded.get(K.TASK_HEARTBEAT_INTERVAL_MS) == 1000

@@ -1,0 +1,81 @@
+"""Event stream + history layout tests.
+
+Mirrors reference coverage: ``TestEventHandler.java``,
+``TestHistoryFileUtils.java``, ``TestParserUtils.java`` against fixture
+history trees (SURVEY.md §4.2).
+"""
+
+import os
+import time
+
+from tony_tpu import constants
+from tony_tpu.events import history
+from tony_tpu.events.events import Event, EventHandler, EventType, read_events
+
+
+def test_event_roundtrip():
+    ev = Event(EventType.TASK_STARTED, {"task": "worker:0", "host": "h1"})
+    back = Event.from_json(ev.to_json())
+    assert back.type == EventType.TASK_STARTED
+    assert back.payload == {"task": "worker:0", "host": "h1"}
+
+
+def test_event_handler_lifecycle(tmp_path):
+    """Queue → writer thread → inprogress → rename (EventHandler.java:98-135)."""
+    start = int(time.time() * 1000)
+    name = history.in_progress_name("app_1", start, "alice")
+    h = EventHandler(str(tmp_path), name)
+    h.start()
+    h.emit(Event(EventType.APPLICATION_INITED, {"app": "app_1"}))
+    for i in range(5):
+        h.emit(Event(EventType.TASK_STARTED, {"task": f"worker:{i}"}))
+    h.emit(Event(EventType.APPLICATION_FINISHED, {"status": "SUCCEEDED"}))
+    final = h.stop(history.final_name("app_1", start, start + 10, "alice",
+                                      "SUCCEEDED"))
+    assert os.path.exists(final)
+    assert not any(f.endswith(constants.INPROGRESS_SUFFIX)
+                   for f in os.listdir(tmp_path))
+    events = read_events(final)
+    assert [e.type for e in events][0] == EventType.APPLICATION_INITED
+    assert events[-1].payload["status"] == "SUCCEEDED"
+    assert len(events) == 7
+
+
+def test_filename_metadata_roundtrip():
+    """Reference ParserUtils.parseMetadata :67-98."""
+    name = history.final_name("application_123_456", 1000, 2000, "bob", "FAILED")
+    meta = history.parse_metadata(name)
+    assert meta.app_id == "application_123_456"
+    assert meta.started_ms == 1000 and meta.completed_ms == 2000
+    assert meta.user == "bob" and meta.status == "FAILED"
+    running = "app_1-5000-carol" + constants.EVENTS_SUFFIX
+    meta2 = history.parse_metadata(running)
+    assert meta2.status == "RUNNING" and not meta2.finished
+
+
+def test_mover_and_purger(tmp_path):
+    """Reference HistoryFileMover.java:74-121 + HistoryFilePurger.java:53-107."""
+    root = str(tmp_path)
+    now = int(time.time() * 1000)
+    old = now - 40 * 86400 * 1000
+    for app, start, end in [("app_old", old, old + 10), ("app_new", now, now + 10)]:
+        d = history.intermediate_dir(root, app)
+        os.makedirs(d)
+        fname = history.final_name(app, start, end, "u", "SUCCEEDED")
+        with open(os.path.join(d, fname), "w") as f:
+            f.write(Event(EventType.APPLICATION_FINISHED, {}).to_json() + "\n")
+    # A job whose coordinator died: only an inprogress file → renamed KILLED.
+    d = history.intermediate_dir(root, "app_dead")
+    os.makedirs(d)
+    open(os.path.join(d, history.in_progress_name("app_dead", now, "u")), "w").close()
+
+    moved = history.HistoryFileMover(root).move_once()
+    assert len(moved) == 3
+    dirs = history.list_job_dirs(root)
+    assert set(dirs) == {"app_old", "app_new", "app_dead"}
+    dead_hist = history.find_history_file(dirs["app_dead"])
+    assert history.parse_metadata(dead_hist).status == "KILLED"
+
+    purged = history.HistoryFilePurger(root, retention_days=30).purge_once(now)
+    assert purged == ["app_old"]
+    assert set(history.list_job_dirs(root)) == {"app_new", "app_dead"}

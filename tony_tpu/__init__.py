@@ -1,0 +1,30 @@
+"""tony-tpu: a TPU-native framework for orchestrating distributed deep-learning jobs.
+
+tony-tpu fills the role the reference framework (TonY — see /root/reference,
+``README.md``) fills for Hadoop/YARN clusters, re-designed from scratch for TPU
+hardware and the JAX/XLA execution model:
+
+- A **job coordinator** (the ApplicationMaster analogue,
+  reference ``tony-core/src/main/java/com/linkedin/tony/ApplicationMaster.java``)
+  gang-schedules jobtypes over a slice inventory, runs the cluster-spec
+  rendezvous barrier, monitors heartbeats and applies failure policy.
+- A **task executor** (reference ``TaskExecutor.java``) supervises one user
+  process per task, wiring the framework-specific environment contract
+  (JAX coordination service, TF_CONFIG, torch rendezvous, DMLC_*).
+- A **client library + CLI** (reference ``TonyClient.java``,
+  ``tony-cli/``) merges layered configs into a frozen artifact, validates
+  resource quotas, submits, and mirrors task state to listeners.
+- A **parallelism library** (new work — absent from the reference, see
+  SURVEY.md §2.3) owns what TonY delegated to user frameworks: device meshes,
+  DP/FSDP/TP/PP/EP and sequence/context parallelism with ring attention,
+  implemented with jax.sharding / shard_map / pallas.
+
+Unlike the reference, the data plane and the orchestration plane meet here:
+XLA collectives over ICI/DCN are the communication backend, bootstrapped by
+the coordinator's rendezvous (replacing four env-var dialects with one).
+"""
+
+__version__ = "0.1.0"
+
+from tony_tpu import constants  # noqa: F401
+from tony_tpu.conf.config import TonyTpuConfig  # noqa: F401

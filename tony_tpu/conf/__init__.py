@@ -1,0 +1,2 @@
+from tony_tpu.conf.config import TonyTpuConfig  # noqa: F401
+from tony_tpu.conf import keys  # noqa: F401

@@ -1,0 +1,241 @@
+"""Layered configuration with a frozen "final config" artifact.
+
+Reference model (``TonyClient.initTonyConf`` :483-517 and
+``processFinalTonyConf`` :189-228): defaults ← job config file ← explicit
+``-conf k=v`` overrides ← site file, frozen into a single ``tony-final.xml``
+that is localized to the AM and every container, so every process reads one
+source of truth (``ApplicationMaster.java:216``, ``TaskExecutor.java:269``).
+
+This build keeps the exact layering but uses JSON/YAML instead of Hadoop XML,
+and the frozen artifact is ``tony-final.json`` (constants.FINAL_CONFIG_FILE).
+Multi-value keys append across layers (reference ``TonyClient.java:498-510``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+from typing import Any, Dict, Iterable, List, Mapping, Optional, Tuple
+
+from tony_tpu import constants
+from tony_tpu.conf import keys as K
+
+
+class ConfigError(ValueError):
+    pass
+
+
+@dataclasses.dataclass
+class JobType:
+    """A gang of identical tasks (reference per-jobtype dynamic keys,
+    ``TonyConfigurationKeys.java:171-239``)."""
+
+    name: str
+    instances: int = 0
+    command: str = ""
+    chips: int = 0
+    vcores: int = 1
+    memory: str = "2g"
+    depends_on: Tuple[str, ...] = ()
+    env: Dict[str, str] = dataclasses.field(default_factory=dict)
+    node_pool: str = ""
+
+    @property
+    def is_chief_type(self) -> bool:
+        return self.name == constants.CHIEF_JOB_NAME
+
+
+def _load_file(path: str) -> Dict[str, Any]:
+    with open(path, "r", encoding="utf-8") as f:
+        text = f.read()
+    if path.endswith((".yaml", ".yml")):
+        import yaml  # baked in
+
+        data = yaml.safe_load(text) or {}
+    else:
+        data = json.loads(text or "{}")
+    if not isinstance(data, dict):
+        raise ConfigError(f"config file {path} must contain a mapping")
+    return _flatten(data)
+
+
+def _flatten(data: Mapping[str, Any], prefix: str = "") -> Dict[str, Any]:
+    """Allow nested mappings in config files: {"tony": {"worker": {"instances": 2}}}
+    flattens to dotted keys."""
+    out: Dict[str, Any] = {}
+    for k, v in data.items():
+        name = f"{prefix}{k}" if not prefix else f"{prefix}.{k}"
+        if isinstance(v, Mapping):
+            out.update(_flatten(v, name))
+        else:
+            out[name] = v
+    return out
+
+
+class TonyTpuConfig:
+    """Dict-backed layered configuration."""
+
+    def __init__(self, initial: Optional[Mapping[str, Any]] = None):
+        self._conf: Dict[str, Any] = {}
+        for key in K.registry().values():
+            self._conf[key.name] = key.default
+        if initial:
+            for k, v in initial.items():
+                self.set(k, v)
+
+    # -- layering ---------------------------------------------------------
+    @classmethod
+    def from_layers(
+        cls,
+        config_file: Optional[str] = None,
+        overrides: Iterable[str] = (),
+        site_dir: Optional[str] = None,
+    ) -> "TonyTpuConfig":
+        """defaults ← config_file ← overrides(k=v) ← site file.
+
+        Mirrors ``TonyClient.initTonyConf`` :483-517 (the site file is the
+        last layer there too: ``$TONY_CONF_DIR/tony-site.xml``).
+        """
+        conf = cls()
+        if config_file:
+            conf.merge(_load_file(config_file))
+        for kv in overrides:
+            if "=" not in kv:
+                raise ConfigError(f"override must be key=value, got {kv!r}")
+            k, v = kv.split("=", 1)
+            conf.set(k.strip(), v)
+        site_dir = site_dir or os.environ.get("TONY_TPU_CONF_DIR", "")
+        if site_dir:
+            for fname in ("tony-site.json", "tony-site.yaml"):
+                p = os.path.join(site_dir, fname)
+                if os.path.exists(p):
+                    conf.merge(_load_file(p))
+                    break
+        return conf
+
+    def merge(self, other: Mapping[str, Any]) -> None:
+        for k, v in other.items():
+            self.set(k, v)
+
+    # -- access -----------------------------------------------------------
+    def set(self, name: str, value: Any) -> None:
+        value = K.coerce(name, value)
+        if K.is_multi_value(name) and self._conf.get(name):
+            existing = str(self._conf[name])
+            incoming = str(value)
+            if existing and incoming and incoming not in existing.split(","):
+                value = f"{existing},{incoming}"
+        self._conf[name] = value
+
+    def get(self, name: str, default: Any = None) -> Any:
+        if name in self._conf:
+            return self._conf[name]
+        key = K.registry().get(name)
+        if key is not None:
+            return key.default
+        return default
+
+    def get_int(self, name: str, default: int = 0) -> int:
+        v = self.get(name, default)
+        return int(v) if v is not None and v != "" else default
+
+    def get_bool(self, name: str, default: bool = False) -> bool:
+        v = self.get(name, default)
+        if isinstance(v, str):
+            return v.strip().lower() in ("true", "1", "yes", "on")
+        return bool(v)
+
+    def get_list(self, name: str) -> List[str]:
+        v = self.get(name, "")
+        if not v:
+            return []
+        return [s.strip() for s in str(v).split(",") if s.strip()]
+
+    def as_dict(self) -> Dict[str, Any]:
+        return dict(self._conf)
+
+    # -- jobtypes ---------------------------------------------------------
+    def job_types(self) -> Dict[str, JobType]:
+        """Discover jobtypes from dynamic keys (reference
+        ``TonyConfigurationKeys.getJobTypes`` + ``Utils.parseContainerRequests``
+        :366-408)."""
+        names = set()
+        for name in self._conf:
+            jk = K.parse_job_key(name)
+            if jk:
+                names.add(jk[0])
+        jobs: Dict[str, JobType] = {}
+        for job in sorted(names):
+            instances = self.get_int(K.INSTANCES_FORMAT.format(job=job), 0)
+            if instances <= 0:
+                continue
+            env_pairs = {}
+            for kv in self.get_list(K.ENV_FORMAT.format(job=job)):
+                if "=" in kv:
+                    k, v = kv.split("=", 1)
+                    env_pairs[k] = v
+            jobs[job] = JobType(
+                name=job,
+                instances=instances,
+                command=str(self.get(K.COMMAND_FORMAT.format(job=job), "") or ""),
+                chips=self.get_int(K.CHIPS_FORMAT.format(job=job), 0),
+                vcores=self.get_int(K.VCORES_FORMAT.format(job=job), 1),
+                memory=str(self.get(K.MEMORY_FORMAT.format(job=job), "2g")),
+                depends_on=tuple(self.get_list(K.DEPENDS_ON_FORMAT.format(job=job))),
+                env=env_pairs,
+                node_pool=str(self.get(K.NODE_POOL_FORMAT.format(job=job), "") or ""),
+            )
+        return jobs
+
+    def untracked_jobtypes(self) -> Tuple[str, ...]:
+        return tuple(self.get_list(K.APPLICATION_UNTRACKED_JOBTYPES))
+
+    # -- validation -------------------------------------------------------
+    def validate(self) -> None:
+        """Quota + sanity checks (reference ``TonyClient.validateTonyConf``
+        :598-667: instance and resource quota enforcement at submit time)."""
+        jobs = self.job_types()
+        if not jobs:
+            raise ConfigError(
+                "no jobtypes configured: set tony.<job>.instances >= 1")
+        total_instances = sum(j.instances for j in jobs.values())
+        max_total = self.get_int(K.MAX_TOTAL_INSTANCES, -1)
+        if max_total >= 0 and total_instances > max_total:
+            raise ConfigError(
+                f"requested {total_instances} instances exceeds quota "
+                f"{max_total} ({K.MAX_TOTAL_INSTANCES})")
+        total_chips = sum(j.instances * j.chips for j in jobs.values())
+        max_chips = self.get_int(K.MAX_TOTAL_CHIPS, -1)
+        if max_chips >= 0 and total_chips > max_chips:
+            raise ConfigError(
+                f"requested {total_chips} chips exceeds quota {max_chips} "
+                f"({K.MAX_TOTAL_CHIPS})")
+        for j in jobs.values():
+            cap = self.get_int(K.MAX_INSTANCES_FORMAT.format(job=j.name), -1)
+            if cap >= 0 and j.instances > cap:
+                raise ConfigError(
+                    f"jobtype {j.name}: {j.instances} instances exceeds "
+                    f"max-instances {cap}")
+            for dep in j.depends_on:
+                if dep not in jobs:
+                    raise ConfigError(
+                        f"jobtype {j.name} depends on unknown jobtype {dep}")
+
+    # -- freeze / thaw ----------------------------------------------------
+    def freeze(self, path: str) -> str:
+        """Write the frozen final config artifact (``tony-final.json``),
+        the single source of truth shipped to coordinator and executors
+        (reference ``tony-final.xml``, Constants.java:139)."""
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        with open(path, "w", encoding="utf-8") as f:
+            json.dump(self._conf, f, indent=2, sort_keys=True)
+        return path
+
+    @classmethod
+    def load_final(cls, path: str) -> "TonyTpuConfig":
+        with open(path, "r", encoding="utf-8") as f:
+            data = json.load(f)
+        conf = cls()
+        conf._conf.update(data)  # already-coerced values; bypass multi-value append
+        return conf

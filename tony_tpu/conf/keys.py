@@ -1,0 +1,257 @@
+"""Configuration key registry: every key, its default, type and documentation.
+
+Parity target: reference ``TonyConfigurationKeys.java`` (287 LoC; dynamic
+per-jobtype keys by regex :171-239) and ``resources/tony-default.xml``
+(108 properties), whose agreement is enforced by
+``TestTonyConfigurationFields.java:17-45``. Here the registry *is* the defaults
+file — a single source of truth — and the parity test checks that the
+documented defaults table (``tony_tpu/conf/defaults.md``) matches this module.
+
+Naming: dotted lowercase, rooted at ``tony.`` like the reference, so that
+reference configs translate mechanically (``tony.worker.instances`` keeps its
+meaning; GPU resource keys become chip keys).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import re
+from typing import Any, Callable, Dict, Optional, Pattern, Tuple
+
+
+@dataclasses.dataclass(frozen=True)
+class ConfigKey:
+    name: str
+    default: Any
+    type: type
+    doc: str
+    multi_value: bool = False  # append-on-merge (reference MULTI_VALUE_CONF :285)
+
+
+_REGISTRY: Dict[str, ConfigKey] = {}
+
+
+def _key(name: str, default: Any, typ: type, doc: str, multi_value: bool = False) -> str:
+    _REGISTRY[name] = ConfigKey(name, default, typ, doc, multi_value)
+    return name
+
+
+# --- application ----------------------------------------------------------
+APPLICATION_NAME = _key(
+    "tony.application.name", "tony-tpu", str, "Application display name.")
+APPLICATION_FRAMEWORK = _key(
+    "tony.application.framework", "jax", str,
+    "ML framework runtime: jax | tensorflow | pytorch | mxnet | horovod | generic "
+    "(reference MLFramework enum TonyConfigurationKeys.java:12-17; jax is new).")
+APPLICATION_QUEUE = _key(
+    "tony.application.queue", "default", str, "Scheduler queue / reservation pool.")
+APPLICATION_TIMEOUT_S = _key(
+    "tony.application.timeout-s", 0, int,
+    "Whole-job wall-clock timeout in seconds; 0 disables "
+    "(reference tony.application.timeout, TonyClient.java:874-882).")
+APPLICATION_RETRY_COUNT = _key(
+    "tony.application.retry-count", 0, int,
+    "Coordinator-level whole-job retries (reference tony.am.retry-count, "
+    "ApplicationMaster.java:356-371).")
+APPLICATION_PREPARE_STAGE = _key(
+    "tony.application.prepare-stage", "", str,
+    "Comma list of jobtypes forming the prepare stage of the DAG "
+    "(reference Utils.java:372-406).", multi_value=True)
+APPLICATION_TRAINING_STAGE = _key(
+    "tony.application.training-stage", "", str,
+    "Comma list of jobtypes forming the training stage of the DAG.",
+    multi_value=True)
+APPLICATION_UNTRACKED_JOBTYPES = _key(
+    "tony.application.untracked.jobtypes", "ps", str,
+    "Jobtypes whose processes run forever and do not gate completion "
+    "(reference TonyConfigurationKeys.java:252-253).", multi_value=True)
+APPLICATION_STOP_ON_FAILURE_JOBTYPES = _key(
+    "tony.application.stop-on-failure-jobtypes", "", str,
+    "Jobtypes whose single-task failure fails the whole job immediately "
+    "(reference TonySession.java:251-271).", multi_value=True)
+APPLICATION_FAIL_ON_WORKER_FAILURE = _key(
+    "tony.application.fail-on-worker-failure-enabled", False, bool,
+    "If true, any tracked task failure fails the job without waiting "
+    "(reference TonySession.java:251-271).")
+APPLICATION_NUM_CLIENTS_TO_WAIT = _key(
+    "tony.application.wait-for-client-finish", True, bool,
+    "Coordinator waits for the client's finish signal before tearing down "
+    "(reference ApplicationMaster.java:684).")
+APPLICATION_SECURITY_ENABLED = _key(
+    "tony.application.security.enabled", False, bool,
+    "Enable token auth on the control-plane RPC "
+    "(reference ApplicationMaster.java:433-452).")
+
+# --- task / executor ------------------------------------------------------
+TASK_HEARTBEAT_INTERVAL_MS = _key(
+    "tony.task.heartbeat-interval-ms", 1000, int,
+    "Executor→coordinator heartbeat cadence "
+    "(reference TonyConfigurationKeys.java:143-144).")
+TASK_MAX_MISSED_HEARTBEATS = _key(
+    "tony.task.max-missed-heartbeats", 25, int,
+    "Missed heartbeats before a task is deemed dead "
+    "(reference TonyConfigurationKeys.java:145-147).")
+TASK_METRICS_INTERVAL_MS = _key(
+    "tony.task.metrics-interval-ms", 5000, int,
+    "Resource-metrics sampling cadence (reference :149-150).")
+TASK_REGISTRATION_TIMEOUT_S = _key(
+    "tony.task.registration-timeout-s", 900, int,
+    "Gang rendezvous timeout: all tasks must register within this window "
+    "(reference tony.application.registration-timeout default 15 min, "
+    "TonyConfigurationKeys.java:243-244).")
+TASK_EXECUTOR_EXECUTION_TIMEOUT_S = _key(
+    "tony.task.execution-timeout-s", 0, int,
+    "Per-task user-process timeout; 0 disables "
+    "(reference tony.task.executor.execution-timeout-ms).")
+TASK_REUSE_PORT = _key(
+    "tony.task.reuse-port", False, bool,
+    "Hold the rendezvous port with SO_REUSEPORT between registration and "
+    "user-process bind (reference ReusablePort.java:151-236).")
+TASK_PORT_FILE = _key(
+    "tony.task.port-file", "", str,
+    "Optional file the executor writes its reserved rendezvous port to.")
+
+# --- coordinator ----------------------------------------------------------
+COORDINATOR_MONITOR_INTERVAL_MS = _key(
+    "tony.coordinator.monitor-interval-ms", 500, int,
+    "Coordinator main monitoring loop cadence (reference AM 5 s loop "
+    "ApplicationMaster.java:646; faster here — it is cheap in-process).")
+COORDINATOR_HOST_KEY = _key(
+    "tony.coordinator.host", "127.0.0.1", str,
+    "Bind host for the coordinator control-plane server.")
+COORDINATOR_PORT_KEY = _key(
+    "tony.coordinator.port", 0, int,
+    "Bind port for the coordinator control-plane server (0 = ephemeral).")
+COORDINATOR_STOP_GRACE_S = _key(
+    "tony.coordinator.stop-grace-s", 15, int,
+    "Grace period when stopping running tasks "
+    "(reference ApplicationMaster.java:694-711).")
+
+# --- client ---------------------------------------------------------------
+CLIENT_POLL_INTERVAL_MS = _key(
+    "tony.client.poll-interval-ms", 1000, int,
+    "Client app-report poll cadence (reference TonyClient.java:840-843).")
+MAX_TOTAL_INSTANCES = _key(
+    "tony.application.max-total-instances", -1, int,
+    "Quota: maximum total task instances; -1 = unlimited "
+    "(reference TonyClient.java:598-667).")
+MAX_TOTAL_CHIPS = _key(
+    "tony.application.max-total-chips", -1, int,
+    "Quota: maximum total TPU chips across all jobtypes; -1 = unlimited "
+    "(replaces the reference's GPU quota keys).")
+SRC_DIR = _key(
+    "tony.application.src-dir", "", str,
+    "Directory of user code zipped and shipped to every task "
+    "(reference tony.src.dir, TonyClient.java:189-228).")
+PYTHON_VENV = _key(
+    "tony.application.python-venv", "", str,
+    "Optional archived Python environment localized for tasks "
+    "(reference tony.python.venv).")
+PYTHON_BINARY_PATH = _key(
+    "tony.application.python-binary-path", "python3", str,
+    "Python interpreter used to build task commands when `tony.<job>.command` "
+    "is not given (reference TonyClient.buildTaskCommand :454-475).")
+EXECUTION_ENV = _key(
+    "tony.application.execution-env", "", str,
+    "Comma list of KEY=VALUE pairs exported into every task environment "
+    "(reference tony.execution.env).", multi_value=True)
+CONTAINER_RESOURCES = _key(
+    "tony.application.resources", "", str,
+    "Comma list of extra files (SRC[::NAME][#archive]) localized to all tasks "
+    "(reference LocalizableResource.java:20-30).", multi_value=True)
+
+# --- history / events -----------------------------------------------------
+HISTORY_LOCATION = _key(
+    "tony.history.location", "", str,
+    "Root directory for job history (empty = <workdir>/tony-history) "
+    "(reference tony.history.location).")
+HISTORY_MOVER_INTERVAL_S = _key(
+    "tony.history.mover-interval-s", 300, int,
+    "Intermediate→finished history mover cadence "
+    "(reference HistoryFileMover.java:74-121, 5 min).")
+HISTORY_PURGER_INTERVAL_S = _key(
+    "tony.history.purger-interval-s", 21600, int,
+    "History retention purger cadence (reference 6 h).")
+HISTORY_RETENTION_DAYS = _key(
+    "tony.history.retention-days", 30, int,
+    "Days of finished history kept (reference 30 days).")
+KEEP_FAILED_DIRS = _key(
+    "tony.keep-failed-task-dirs", False, bool,
+    "Keep working dirs of failed tasks for debugging.")
+
+# --- TPU topology ---------------------------------------------------------
+TPU_TOPOLOGY = _key(
+    "tony.tpu.topology", "", str,
+    "Requested slice topology, e.g. 'v5p-32' or '2x2x4'; empty = use all "
+    "locally visible devices. The mesh builder consumes this (SURVEY.md §7.7).")
+TPU_MESH_SHAPE = _key(
+    "tony.tpu.mesh-shape", "", str,
+    "Logical mesh axes as 'name:size,name:size', e.g. "
+    "'data:4,model:2'. Empty = 1-D data mesh over all devices.")
+
+# --- portal ---------------------------------------------------------------
+PORTAL_PORT = _key(
+    "tony.portal.port", 19886, int,
+    "History web portal port (reference tony-portal Play app).")
+
+# --- per-jobtype dynamic keys (reference TonyConfigurationKeys.java:171-239)
+INSTANCES_FORMAT = "tony.{job}.instances"
+COMMAND_FORMAT = "tony.{job}.command"
+CHIPS_FORMAT = "tony.{job}.chips"          # replaces tony.X.gpus
+VCORES_FORMAT = "tony.{job}.vcores"
+MEMORY_FORMAT = "tony.{job}.memory"
+MAX_INSTANCES_FORMAT = "tony.{job}.max-instances"
+DEPENDS_ON_FORMAT = "tony.{job}.depends-on"
+ENV_FORMAT = "tony.{job}.env"
+NODE_POOL_FORMAT = "tony.{job}.node-pool"  # replaces tony.X.node-label
+
+_JOB_KEY_RE: Pattern[str] = re.compile(
+    r"^tony\.([a-z][a-z0-9_]*)\.(instances|command|chips|vcores|memory|"
+    r"max-instances|depends-on|env|node-pool)$")
+
+_RESERVED_NON_JOB_SEGMENTS = {
+    "application", "task", "coordinator", "client", "history", "tpu", "portal",
+    "keep-failed-task-dirs",
+}
+
+
+def registry() -> Dict[str, ConfigKey]:
+    """The static key registry (name → ConfigKey)."""
+    return dict(_REGISTRY)
+
+
+def is_multi_value(name: str) -> bool:
+    k = _REGISTRY.get(name)
+    return bool(k and k.multi_value)
+
+
+def parse_job_key(name: str) -> Optional[Tuple[str, str]]:
+    """Return (jobtype, attribute) if `name` is a dynamic per-jobtype key.
+
+    Mirrors the reference's regex-driven jobtype discovery
+    (``TonyConfigurationKeys.getJobTypes``, :171-176).
+    """
+    m = _JOB_KEY_RE.match(name)
+    if not m:
+        return None
+    job = m.group(1)
+    if job in _RESERVED_NON_JOB_SEGMENTS:
+        return None
+    return job, m.group(2)
+
+
+def coerce(name: str, value: Any) -> Any:
+    """Coerce a raw (possibly string) value to the registered key type."""
+    key = _REGISTRY.get(name)
+    if key is None:
+        jk = parse_job_key(name)
+        if jk and jk[1] in ("instances", "chips", "vcores", "max-instances"):
+            return int(value)
+        return value
+    if key.type is bool and isinstance(value, str):
+        return value.strip().lower() in ("true", "1", "yes", "on")
+    if key.type is int and not isinstance(value, bool):
+        return int(value)
+    if key.type is str:
+        return str(value)
+    return value

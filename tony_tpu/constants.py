@@ -1,0 +1,111 @@
+"""Shared constant names: environment-variable contract, well-known job names,
+file names, and test hooks.
+
+Parity target: reference ``tony-core/src/main/java/com/linkedin/tony/Constants.java``
+(env vars :44-62, job names :104-110, test hooks :116-121, file names :139).
+The TPU build replaces the four per-framework rendezvous dialects with one
+coordinator-address contract, but still exports the legacy framework variables
+from the runtimes layer so TF / PyTorch / MXNet user scripts keep working.
+"""
+
+# ---------------------------------------------------------------------------
+# Core task-identity environment contract (set by the coordinator when
+# launching an executor; reference ApplicationMaster.java:1129-1141).
+# ---------------------------------------------------------------------------
+JOB_NAME = "TONY_JOB_NAME"            # jobtype of this task, e.g. "worker"
+TASK_INDEX = "TONY_TASK_INDEX"        # index within the jobtype
+TASK_NUM = "TONY_TASK_NUM"            # number of tasks of this jobtype
+IS_CHIEF = "TONY_IS_CHIEF"            # "true" iff chief semantics apply
+SESSION_ID = "TONY_SESSION_ID"        # retry epoch (reference TonySession.java:51)
+APP_ID = "TONY_APP_ID"                # application id
+COORDINATOR_HOST = "TONY_COORDINATOR_HOST"
+COORDINATOR_PORT = "TONY_COORDINATOR_PORT"
+METRICS_PORT = "TONY_METRICS_PORT"    # metrics RPC port on the coordinator
+TASK_ID = "TONY_TASK_ID"              # "<jobtype>:<index>"
+TASK_COMMAND = "TONY_TASK_COMMAND"    # user command for this task
+EXECUTOR_CONF = "TONY_EXECUTOR_CONF"  # path to the frozen final config
+
+# Global-rank contract for the JAX runtime (computed over the whole gang).
+GLOBAL_RANK = "TONY_GLOBAL_RANK"
+GLOBAL_WORLD = "TONY_GLOBAL_WORLD"
+
+# ---------------------------------------------------------------------------
+# Framework rendezvous variables exported by runtimes
+# (reference TaskExecutor.java:161-207, Constants.java:44-62).
+# ---------------------------------------------------------------------------
+TF_CONFIG = "TF_CONFIG"
+CLUSTER_SPEC = "CLUSTER_SPEC"
+
+# PyTorch (reference Constants.java:50-54)
+INIT_METHOD = "INIT_METHOD"
+MASTER_ADDR = "MASTER_ADDR"
+MASTER_PORT = "MASTER_PORT"
+RANK = "RANK"
+WORLD = "WORLD"
+WORLD_SIZE = "WORLD_SIZE"
+
+# MXNet (reference Constants.java:57-62)
+DMLC_PS_ROOT_URI = "DMLC_PS_ROOT_URI"
+DMLC_PS_ROOT_PORT = "DMLC_PS_ROOT_PORT"
+DMLC_ROLE = "DMLC_ROLE"
+DMLC_NUM_SERVER = "DMLC_NUM_SERVER"
+DMLC_NUM_WORKER = "DMLC_NUM_WORKER"
+DMLC_USE_KUBERNETES = "DMLC_USE_KUBERNETES"
+
+# JAX coordination service (the one uniform TPU-native mechanism; replaces all
+# of the above for JAX jobs — SURVEY.md §2.4).
+JAX_COORDINATOR_ADDRESS = "JAX_COORDINATOR_ADDRESS"
+JAX_NUM_PROCESSES = "JAX_NUM_PROCESSES"
+JAX_PROCESS_ID = "JAX_PROCESS_ID"
+
+# TensorBoard (reference Constants.java TB_PORT; TaskExecutor.java:83-95)
+TB_PORT = "TB_PORT"
+
+# ---------------------------------------------------------------------------
+# Well-known job (task-type) names (reference Constants.java:104-110).
+# ---------------------------------------------------------------------------
+CHIEF_JOB_NAME = "chief"
+PS_JOB_NAME = "ps"
+WORKER_JOB_NAME = "worker"
+EVALUATOR_JOB_NAME = "evaluator"
+SCHEDULER_JOB_NAME = "scheduler"   # MXNet
+SERVER_JOB_NAME = "server"         # MXNet
+NOTEBOOK_JOB_NAME = "notebook"
+DRIVER_JOB_NAME = "driver"
+
+# ---------------------------------------------------------------------------
+# File-name constants (reference Constants.java:139 TONY_FINAL_XML and
+# HistoryFileUtils.java:12-31 jhist naming).
+# ---------------------------------------------------------------------------
+FINAL_CONFIG_FILE = "tony-final.json"
+EVENTS_SUFFIX = ".jhist.jsonl"
+INPROGRESS_SUFFIX = ".jhist.jsonl.inprogress"
+HISTORY_INTERMEDIATE = "intermediate"
+HISTORY_FINISHED = "finished"
+
+# ---------------------------------------------------------------------------
+# Fault-injection test hooks, honoured by production code exactly like the
+# reference's (Constants.java:116-121; see SURVEY.md §4.1 hook table).
+# ---------------------------------------------------------------------------
+TEST_COORDINATOR_CRASH = "TONY_TEST_COORDINATOR_CRASH"
+# "<jobtype>" — coordinator kills one task of the type once chief registers
+# (reference TEST_WORKER_TERMINATION, ApplicationMaster.java:1224-1235).
+TEST_WORKER_TERMINATION = "TONY_TEST_WORKER_TERMINATION"
+# "N" — executor silently skips its first N heartbeats
+# (reference TaskExecutor.java:330-357).
+TEST_NUM_HB_MISS = "TONY_TEST_NUM_HB_MISS"
+# "job#idx#seconds" — executor sleeps after the user process exits
+# (straggler skew; reference TaskExecutor.java:372-392).
+TEST_EXECUTOR_SKEW = "TONY_TEST_EXECUTOR_SKEW"
+# "seconds" — delay the coordinator's completion handling (races the
+# heartbeat-unregister path; reference ApplicationMaster.java:1029-1038).
+TEST_COMPLETION_DELAY = "TONY_TEST_COMPLETION_DELAY"
+
+# Untracked jobtypes: run-forever tasks (parameter servers) whose exit does not
+# gate job completion (reference TonyConfigurationKeys.java:252-253).
+DEFAULT_UNTRACKED_JOBTYPES = (PS_JOB_NAME,)
+
+# Exit codes (reference common/TaskStatus semantics, TonySession.java:480-497).
+EXIT_SUCCESS = 0
+EXIT_FAILURE = 1
+EXIT_KILLED = 137  # SIGKILL'd by supervisor / liveness monitor

@@ -1,0 +1,2 @@
+from tony_tpu.events.events import Event, EventType, EventHandler  # noqa: F401
+from tony_tpu.events import history  # noqa: F401

@@ -1,0 +1,112 @@
+"""Asynchronous structured event stream for job history.
+
+Reference model: ``events/EventHandler.java`` (157 LoC) — a BlockingQueue
+drained by a writer thread into an Avro container file named
+``<appId>-<start>[-<end>]-<user>[-STATUS].jhist`` under the job's history
+directory, written as ``.inprogress`` and renamed on completion
+(:43-60, :98-113, :126-135). Event types are APPLICATION_INITED,
+APPLICATION_FINISHED, TASK_STARTED, TASK_FINISHED (``avro/EventType.avsc``).
+
+This build uses JSON-lines instead of Avro (self-describing, greppable, no
+schema compiler) with the same lifecycle: queue → writer thread → in-progress
+file → atomic rename to final name carrying end-time and status.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+import json
+import os
+import queue
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+
+class EventType(str, enum.Enum):
+    APPLICATION_INITED = "APPLICATION_INITED"
+    APPLICATION_FINISHED = "APPLICATION_FINISHED"
+    TASK_STARTED = "TASK_STARTED"
+    TASK_FINISHED = "TASK_FINISHED"
+
+
+@dataclasses.dataclass
+class Event:
+    type: EventType
+    payload: Dict[str, Any]
+    timestamp_ms: int = 0
+
+    def __post_init__(self) -> None:
+        if not self.timestamp_ms:
+            self.timestamp_ms = int(time.time() * 1000)
+
+    def to_json(self) -> str:
+        return json.dumps(
+            {"type": self.type.value, "timestamp": self.timestamp_ms,
+             "event": self.payload},
+            sort_keys=True)
+
+    @classmethod
+    def from_json(cls, line: str) -> "Event":
+        d = json.loads(line)
+        return cls(EventType(d["type"]), d.get("event", {}), d.get("timestamp", 0))
+
+
+class EventHandler:
+    """Queue-backed async writer (reference EventHandler.java:98-113)."""
+
+    def __init__(self, job_dir: str, in_progress_name: str):
+        self._queue: "queue.Queue[Optional[Event]]" = queue.Queue()
+        self._job_dir = job_dir
+        self._path = os.path.join(job_dir, in_progress_name)
+        self._thread: Optional[threading.Thread] = None
+        self._stopped = threading.Event()
+        os.makedirs(job_dir, exist_ok=True)
+
+    def start(self) -> None:
+        self._thread = threading.Thread(
+            target=self._drain, name="tony-event-writer", daemon=True)
+        self._thread.start()
+
+    def emit(self, event: Event) -> None:
+        self._queue.put(event)
+
+    def _drain(self) -> None:
+        with open(self._path, "a", encoding="utf-8") as f:
+            while True:
+                try:
+                    ev = self._queue.get(timeout=0.2)
+                except queue.Empty:
+                    if self._stopped.is_set():
+                        break
+                    f.flush()
+                    continue
+                if ev is None:
+                    break
+                f.write(ev.to_json() + "\n")
+            f.flush()
+
+    def stop(self, final_name: str) -> str:
+        """Flush remaining events and rename in-progress → final
+        (reference EventHandler.java:126-135)."""
+        self._stopped.set()
+        self._queue.put(None)
+        if self._thread:
+            self._thread.join(timeout=10)
+        final_path = os.path.join(self._job_dir, final_name)
+        if os.path.exists(self._path):
+            os.replace(self._path, final_path)
+        return final_path
+
+
+def read_events(path: str) -> List[Event]:
+    """Decode an event file back into Events (reference
+    ``ParserUtils.parseEvents`` :258-287)."""
+    out: List[Event] = []
+    with open(path, "r", encoding="utf-8") as f:
+        for line in f:
+            line = line.strip()
+            if line:
+                out.append(Event.from_json(line))
+    return out
