@@ -1,0 +1,53 @@
+"""Port reservation (reference ``TestPortAllocation.java``) and task-metrics
+monitor (reference ``TestTaskMonitor.java``) tests."""
+
+import os
+import socket
+
+import pytest
+
+from tony_tpu.executor import monitor as mon
+from tony_tpu.executor.ports import ReservedPort
+
+
+def test_ephemeral_port_reserve_release_rebind():
+    p = ReservedPort(reuse=False)
+    assert p.port > 0
+    # While held, a plain bind to the same port must fail.
+    s = socket.socket()
+    with pytest.raises(OSError):
+        s.bind(("", p.port))
+    s.close()
+    p.release()
+    s2 = socket.socket()
+    s2.bind(("", p.port))  # released → rebindable
+    s2.close()
+
+
+@pytest.mark.skipif(not hasattr(socket, "SO_REUSEPORT"),
+                    reason="SO_REUSEPORT not supported")
+def test_reusable_port_concurrent_bind():
+    """Reference ReusablePort semantics: user process binds while the
+    reservation is still held (TestPortAllocation SO_REUSEPORT cases)."""
+    p = ReservedPort(reuse=True)
+    s = socket.socket()
+    s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEPORT, 1)
+    s.bind(("", p.port))  # succeeds while reservation held
+    s.close()
+    p.release()
+
+
+def test_proc_tree_rss_self():
+    rss = mon._proc_tree_rss_bytes(os.getpid())
+    assert rss > 1024 * 1024  # this test process surely uses >1MB
+
+
+def test_monitor_aggregation():
+    pushed = []
+    m = mon.TaskMonitor("worker:0", push=lambda t, d: pushed.append((t, d)),
+                        interval_s=99)
+    first = m.sample_once()
+    second = m.sample_once()
+    assert second[mon.MAX_MEMORY_BYTES] >= first[mon.AVG_MEMORY_BYTES] > 0
+    m.stop()  # pushes final metrics
+    assert pushed and pushed[-1][0] == "worker:0"

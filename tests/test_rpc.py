@@ -1,0 +1,104 @@
+"""Control-plane RPC transport tests (reference coverage: the RPC layer is
+exercised implicitly by TestTonyE2E; here we test the transport directly)."""
+
+import threading
+
+import pytest
+
+from tony_tpu.rpc.wire import AuthError, RpcClient, RpcError, RpcServer
+
+
+class EchoService:
+    def __init__(self):
+        self.calls = 0
+
+    def echo(self, value):
+        self.calls += 1
+        return value
+
+    def add(self, a, b):
+        return a + b
+
+    def boom(self):
+        raise ValueError("intentional")
+
+    def none_result(self):
+        return None
+
+    def ns__method(self):
+        return "namespaced"
+
+    def _private(self):
+        return "secret"
+
+
+@pytest.fixture()
+def server():
+    svc = EchoService()
+    srv = RpcServer(svc, port=0)
+    srv.start()
+    yield srv
+    srv.stop()
+
+
+def test_roundtrip_and_types(server):
+    c = RpcClient("127.0.0.1", server.port, max_retries=2, retry_sleep_s=0.05)
+    assert c.call("add", a=2, b=3) == 5
+    assert c.call("echo", value={"spec": {"worker": ["h:1", "h:2"]}}) == \
+        {"spec": {"worker": ["h:1", "h:2"]}}
+    assert c.call("none_result") is None
+    assert c.call("ns.method") == "namespaced"
+    c.close()
+
+
+def test_errors_propagate_and_connection_survives(server):
+    c = RpcClient("127.0.0.1", server.port, max_retries=2, retry_sleep_s=0.05)
+    with pytest.raises(RpcError, match="intentional"):
+        c.call("boom")
+    with pytest.raises(RpcError, match="no such method"):
+        c.call("nonexistent")
+    with pytest.raises(RpcError, match="no such method"):
+        c.call("_private")
+    assert c.call("add", a=1, b=1) == 2  # server loop survived the errors
+    c.close()
+
+
+def test_concurrent_clients(server):
+    results = []
+
+    def worker(n):
+        c = RpcClient("127.0.0.1", server.port, max_retries=2,
+                      retry_sleep_s=0.05)
+        for i in range(20):
+            results.append(c.call("add", a=n, b=i))
+        c.close()
+
+    threads = [threading.Thread(target=worker, args=(n,)) for n in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert len(results) == 80
+
+
+def test_retry_exhaustion():
+    c = RpcClient("127.0.0.1", 1, max_retries=2, retry_sleep_s=0.01,
+                  connect_timeout_s=0.2)
+    with pytest.raises(RpcError, match="failed after 2 attempts"):
+        c.call("echo", value=1)
+
+
+def test_token_auth():
+    """Reference ClientToAMToken auth (ApplicationMaster.java:433-452)."""
+    srv = RpcServer(EchoService(), port=0, token="s3cret")
+    srv.start()
+    try:
+        good = RpcClient("127.0.0.1", srv.port, token="s3cret",
+                         max_retries=1, retry_sleep_s=0.01)
+        assert good.call("add", a=1, b=1) == 2
+        bad = RpcClient("127.0.0.1", srv.port, token="wrong",
+                        max_retries=1, retry_sleep_s=0.01)
+        with pytest.raises(AuthError):
+            bad.call("add", a=1, b=1)
+    finally:
+        srv.stop()

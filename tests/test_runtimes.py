@@ -1,0 +1,99 @@
+"""Framework-runtime env contract tests.
+
+Mirrors the env assertions of the reference's E2E check scripts
+(``exit_0_check_env.py``, ``exit_0_check_pytorchenv.py``) and
+``TestUtils`` TF_CONFIG/pytorch-spec parsing coverage.
+"""
+
+import json
+
+import pytest
+
+from tony_tpu import constants
+from tony_tpu.conf.config import TonyTpuConfig
+from tony_tpu.runtimes.base import TaskIdentity, flatten_spec, get_runtime
+
+SPEC = {
+    "chief": ["h0:100"],
+    "worker": ["h1:200", "h2:300"],
+    "ps": ["h3:400"],
+}
+
+
+def identity(job, idx, n, port=0):
+    return TaskIdentity(job, idx, n, job == "chief" and idx == 0, port)
+
+
+def test_flatten_order_chief_first():
+    assert flatten_spec(SPEC) == ["chief:0", "worker:0", "worker:1", "ps:0"]
+
+
+def test_jax_runtime_bootstrap():
+    rt = get_runtime("jax")
+    env = rt.build_env(SPEC, identity("worker", 1, 2), TonyTpuConfig())
+    assert env[constants.JAX_COORDINATOR_ADDRESS] == "h0:100"
+    assert env[constants.JAX_NUM_PROCESSES] == "4"
+    assert env[constants.JAX_PROCESS_ID] == "2"
+    assert env[constants.GLOBAL_RANK] == "2"
+    assert env[constants.GLOBAL_WORLD] == "4"
+    assert json.loads(env[constants.CLUSTER_SPEC]) == SPEC
+
+
+def test_tensorflow_runtime_tf_config():
+    rt = get_runtime("tensorflow")
+    env = rt.build_env(SPEC, identity("ps", 0, 1), TonyTpuConfig())
+    tf_config = json.loads(env[constants.TF_CONFIG])
+    assert tf_config["cluster"] == SPEC
+    assert tf_config["task"] == {"type": "ps", "index": 0}
+
+
+def test_pytorch_runtime_rendezvous():
+    rt = get_runtime("pytorch")
+    env = rt.build_env({"worker": ["h1:200", "h2:300"]},
+                       identity("worker", 1, 2), TonyTpuConfig())
+    assert env[constants.INIT_METHOD] == "tcp://h1:200"
+    assert env[constants.MASTER_ADDR] == "h1"
+    assert env[constants.MASTER_PORT] == "200"
+    assert env[constants.RANK] == "1"
+    assert env[constants.WORLD] == "2"
+    assert env[constants.WORLD_SIZE] == "2"
+
+
+def test_mxnet_runtime_dmlc():
+    spec = {"scheduler": ["h0:9000"], "server": ["h1:1"],
+            "worker": ["h2:1", "h3:1"]}
+    rt = get_runtime("mxnet")
+    env = rt.build_env(spec, identity("server", 0, 1), TonyTpuConfig())
+    assert env[constants.DMLC_PS_ROOT_URI] == "h0"
+    assert env[constants.DMLC_PS_ROOT_PORT] == "9000"
+    assert env[constants.DMLC_ROLE] == "server"
+    assert env[constants.DMLC_NUM_SERVER] == "1"
+    assert env[constants.DMLC_NUM_WORKER] == "2"
+
+
+def test_mxnet_requires_scheduler():
+    rt = get_runtime("mxnet")
+    with pytest.raises(ValueError, match="scheduler"):
+        rt.build_env({"worker": ["h:1"]}, identity("worker", 0, 1),
+                     TonyTpuConfig())
+
+
+def test_horovod_runtime_exports_nothing_extra():
+    rt = get_runtime("horovod")
+    env = rt.build_env({"worker": ["h:1"]}, identity("worker", 0, 1),
+                       TonyTpuConfig())
+    assert set(env) == {constants.CLUSTER_SPEC, constants.GLOBAL_RANK,
+                        constants.GLOBAL_WORLD}
+
+
+def test_generic_runtime_for_arbitrary_jobtypes():
+    """The ray-on-tony pattern: head+worker with CLUSTER_SPEC only."""
+    spec = {"head": ["h0:6379"], "worker": ["h1:1", "h2:1"]}
+    rt = get_runtime("generic")
+    env = rt.build_env(spec, identity("head", 0, 1), TonyTpuConfig())
+    assert json.loads(env[constants.CLUSTER_SPEC])["head"] == ["h0:6379"]
+
+
+def test_unknown_framework_raises():
+    with pytest.raises(ValueError, match="unknown framework"):
+        get_runtime("caffe")

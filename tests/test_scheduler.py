@@ -1,0 +1,78 @@
+"""Gang/DAG scheduler tests (reference ``TestTaskScheduler.java:22-152``)."""
+
+import pytest
+
+from tony_tpu.conf.config import TonyTpuConfig
+from tony_tpu.coordinator.scheduler import GangScheduler, SchedulerError
+
+
+def collect_launcher():
+    launched = []
+    return launched, launched.append
+
+
+def test_no_dependencies_all_launch():
+    conf = TonyTpuConfig({"tony.worker.instances": 2,
+                          "tony.ps.instances": 1})
+    launched, launch = collect_launcher()
+    s = GangScheduler(conf, launch)
+    s.schedule_ready()
+    assert set(launched) == {"worker", "ps"}
+    assert s.all_scheduled
+
+
+def test_depends_on_ordering():
+    """db → dbloader → worker (the TestTonyE2E custom-jobtype DAG :255-272)."""
+    conf = TonyTpuConfig({
+        "tony.db.instances": 1,
+        "tony.dbloader.instances": 1,
+        "tony.dbloader.depends-on": "db",
+        "tony.worker.instances": 1,
+        "tony.worker.depends-on": "dbloader",
+    })
+    launched, launch = collect_launcher()
+    s = GangScheduler(conf, launch)
+    s.schedule_ready()
+    assert launched == ["db"]
+    s.register_job_completed("db")
+    assert launched == ["db", "dbloader"]
+    s.register_job_completed("dbloader")
+    assert launched == ["db", "dbloader", "worker"]
+    assert s.all_scheduled
+
+
+def test_prepare_training_stages():
+    """Reference prepare/training stage edge (Utils.java:372-406)."""
+    conf = TonyTpuConfig({
+        "tony.etl.instances": 1,
+        "tony.worker.instances": 2,
+        "tony.application.prepare-stage": "etl",
+        "tony.application.training-stage": "worker",
+    })
+    launched, launch = collect_launcher()
+    s = GangScheduler(conf, launch)
+    s.schedule_ready()
+    assert launched == ["etl"]
+    s.register_job_completed("etl")
+    assert launched == ["etl", "worker"]
+
+
+def test_cycle_detection():
+    """Reference isDAG :142-178."""
+    conf = TonyTpuConfig({
+        "tony.a.instances": 1, "tony.a.depends-on": "b",
+        "tony.b.instances": 1, "tony.b.depends-on": "a",
+    })
+    with pytest.raises(SchedulerError, match="cycle"):
+        GangScheduler(conf, lambda j: None)
+
+
+def test_dependency_check_passed():
+    conf = TonyTpuConfig({
+        "tony.db.instances": 1,
+        "tony.worker.instances": 1,
+        "tony.worker.depends-on": "db",
+    })
+    s = GangScheduler(conf, lambda j: None)
+    assert not s.dependency_check_passed("db")   # db has dependents
+    assert s.dependency_check_passed("worker")

@@ -1,0 +1,108 @@
+"""Session (task matrix, barrier, failure policy) tests.
+
+Mirrors reference ``TestTonySession.java`` coverage plus the cluster-spec
+barrier semantics of ``ApplicationMaster.java:841-889``.
+"""
+
+from tony_tpu.conf.config import TonyTpuConfig
+from tony_tpu.coordinator.session import Session, SessionStatus, TaskStatus
+
+
+def make_conf(**extra):
+    base = {
+        "tony.worker.instances": 2,
+        "tony.ps.instances": 1,
+    }
+    base.update(extra)
+    return TonyTpuConfig(base)
+
+
+def test_task_matrix_and_tracking():
+    s = Session(make_conf())
+    assert {t.task_id for t in s.all_tasks()} == {"worker:0", "worker:1",
+                                                  "ps:0"}
+    assert not s.get_task("ps:0").tracked  # default untracked jobtype
+    assert s.get_task("worker:0").tracked
+
+
+def test_chief_semantics():
+    """Reference TonySession.isChief :364."""
+    s = Session(make_conf())
+    assert s.is_chief("worker", 0) and not s.is_chief("worker", 1)
+    s2 = Session(TonyTpuConfig({"tony.chief.instances": 1,
+                                "tony.worker.instances": 2}))
+    assert s2.is_chief("chief", 0) and not s2.is_chief("worker", 0)
+
+
+def test_cluster_spec_barrier():
+    s = Session(make_conf())
+    assert s.get_cluster_spec() is None
+    s.register_worker("worker:0", "h0", 1000)
+    s.register_worker("ps:0", "h2", 3000)
+    assert s.get_cluster_spec() is None  # worker:1 missing → barrier holds
+    s.register_worker("worker:1", "h1", 2000)
+    spec = s.get_cluster_spec()
+    assert spec == {"worker": ["h0:1000", "h1:2000"], "ps": ["h2:3000"]}
+
+
+def test_success_reduction():
+    s = Session(make_conf())
+    s.on_task_completed("worker:0", 0)
+    assert s.update_status() == SessionStatus.RUNNING
+    s.on_task_completed("worker:1", 0)
+    # ps is untracked: completion doesn't depend on it.
+    assert s.training_finished()
+    assert s.update_status() == SessionStatus.SUCCEEDED
+
+
+def test_chief_failure_short_circuits():
+    s = Session(make_conf())
+    s.on_task_completed("worker:0", 1)  # worker:0 is chief
+    assert s.status == SessionStatus.FAILED
+    assert "chief" in s.failure_reason
+
+
+def test_non_chief_failure_waits_for_all():
+    """Default policy: a non-chief worker failure fails the job only at final
+    reduction (reference updateSessionStatus :276-330)."""
+    s = Session(make_conf())
+    s.on_task_completed("worker:1", 1)
+    assert s.status == SessionStatus.RUNNING
+    s.on_task_completed("worker:0", 0)
+    assert s.update_status() == SessionStatus.FAILED
+
+
+def test_fail_on_worker_failure_toggle():
+    """Reference fail-on-worker-failure-enabled (TonySession.java:251-271)."""
+    conf = make_conf(**{
+        "tony.application.fail-on-worker-failure-enabled": True})
+    s = Session(conf)
+    s.on_task_completed("worker:1", 1)
+    assert s.status == SessionStatus.FAILED
+
+
+def test_stop_on_failure_jobtypes():
+    conf = TonyTpuConfig({
+        "tony.worker.instances": 1,
+        "tony.evaluator.instances": 2,
+        "tony.application.stop-on-failure-jobtypes": "evaluator",
+    })
+    s = Session(conf)
+    s.on_task_completed("evaluator:1", 1)
+    assert s.status == SessionStatus.FAILED
+    assert "stop-on-failure" in s.failure_reason
+
+
+def test_untracked_crash_fails_job():
+    """Reference untracked-task crash detection
+    (ApplicationMaster.java:1212-1215)."""
+    s = Session(make_conf())
+    s.on_task_completed("ps:0", 1)
+    assert s.status == SessionStatus.FAILED
+    assert "untracked" in s.failure_reason
+
+
+def test_session_id_epochs():
+    """Reference sessionId retry epoch (TonySession.java:51)."""
+    s = Session(make_conf(), session_id=2)
+    assert all(t.session_id == 2 for t in s.all_tasks())

@@ -1,0 +1,2 @@
+from tony_tpu.cluster.base import Backend, TaskLaunchSpec  # noqa: F401
+from tony_tpu.cluster.local import LocalProcessBackend  # noqa: F401

@@ -1,0 +1,58 @@
+"""Backend abstraction: how task processes are actually started.
+
+This replaces the reference's YARN substrate (RM container allocation
+``RMCallbackHandler.onContainersAllocated`` ``ApplicationMaster.java:1051`` +
+NM container launch ``ContainerLauncher.run`` :1108-1175) with a minimal
+lease-style interface the coordinator drives directly:
+
+- ``LocalProcessBackend`` — subprocesses on this host; the MiniCluster
+  analogue (``tony-mini/.../MiniCluster.java:43-63``) and also the real
+  single-TPU-VM path (one process per local chip group).
+- ``TpuSliceBackend`` (``tpu.py``) — provisions/leases Cloud TPU slices and
+  launches per-host agents; gated because this environment has no egress.
+
+A backend launches whole tasks-with-environments and reports exits; it knows
+nothing about rendezvous, heartbeats or failure policy — those live in the
+coordinator, exactly as the AM/YARN split does in the reference.
+"""
+
+from __future__ import annotations
+
+import abc
+import dataclasses
+from typing import Dict, List, Optional, Tuple
+
+
+@dataclasses.dataclass
+class TaskLaunchSpec:
+    task_id: str
+    job_name: str
+    index: int
+    command: str
+    env: Dict[str, str]
+    vcores: int = 1
+    memory: str = "2g"
+    chips: int = 0
+    node_pool: str = ""
+
+
+class Backend(abc.ABC):
+    @abc.abstractmethod
+    def launch_task(self, spec: TaskLaunchSpec) -> object:
+        """Start the task; returns an opaque handle."""
+
+    @abc.abstractmethod
+    def kill_task(self, handle: object, grace_s: float = 0.0) -> None:
+        """Terminate the task (SIGTERM, then SIGKILL after grace)."""
+
+    @abc.abstractmethod
+    def poll_completions(self) -> List[Tuple[str, int]]:
+        """Drain (task_id, exit_code) for tasks that exited since last call.
+
+        The analogue of YARN's ``onContainersCompleted`` callback
+        (``ApplicationMaster.java:1005-1023``) — catches processes that died
+        without reporting their own exit over RPC.
+        """
+
+    def stop(self) -> None:
+        """Release backend resources."""

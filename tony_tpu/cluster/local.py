@@ -1,0 +1,118 @@
+"""Local-process backend: one executor subprocess per task.
+
+Dual role, mirroring the reference:
+- the **test substrate** — in-process fake cluster like
+  ``tony-mini/.../MiniCluster.java:43-63`` (no YARN/HDFS needed);
+- the **single-host production path** — on a TPU VM the coordinator and all
+  task processes are host-local, and JAX device visibility is partitioned per
+  task via env when multiple tasks share the host's chips.
+
+Each task runs ``python -m tony_tpu.executor`` (the TaskExecutor entrypoint)
+in its own working directory with the task-identity environment; stdout/stderr
+are captured per task like YARN container logs
+(``ApplicationMaster.java:1145-1147``).
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+from typing import Dict, List, Optional, Tuple
+
+from tony_tpu.cluster.base import Backend, TaskLaunchSpec
+
+log = logging.getLogger(__name__)
+
+
+class _Proc:
+    def __init__(self, task_id: str, popen: subprocess.Popen, workdir: str):
+        self.task_id = task_id
+        self.popen = popen
+        self.workdir = workdir
+        self.reported = False
+
+
+class LocalProcessBackend(Backend):
+    def __init__(self, workdir: str, python: str = sys.executable,
+                 inherit_env: bool = True):
+        self.workdir = workdir
+        self.python = python
+        self.inherit_env = inherit_env
+        self._procs: Dict[str, _Proc] = {}
+        self._lock = threading.Lock()
+        os.makedirs(workdir, exist_ok=True)
+
+    def launch_task(self, spec: TaskLaunchSpec) -> object:
+        task_dir = os.path.join(self.workdir,
+                                spec.task_id.replace(":", "_"))
+        os.makedirs(task_dir, exist_ok=True)
+        env = dict(os.environ) if self.inherit_env else {}
+        env.update(spec.env)
+        # Make `import tony_tpu` resolvable in the child regardless of cwd.
+        repo_root = os.path.dirname(os.path.dirname(
+            os.path.dirname(os.path.abspath(__file__))))
+        env["PYTHONPATH"] = (repo_root + os.pathsep + env.get("PYTHONPATH", "")
+                             ).rstrip(os.pathsep)
+        stdout = open(os.path.join(task_dir, "stdout.log"), "ab")
+        stderr = open(os.path.join(task_dir, "stderr.log"), "ab")
+        popen = subprocess.Popen(
+            [self.python, "-m", "tony_tpu.executor"],
+            cwd=task_dir, env=env, stdout=stdout, stderr=stderr,
+            start_new_session=True)
+        proc = _Proc(spec.task_id, popen, task_dir)
+        with self._lock:
+            self._procs[spec.task_id] = proc
+        log.info("launched %s pid=%d dir=%s", spec.task_id, popen.pid, task_dir)
+        return proc
+
+    def kill_task(self, handle: object, grace_s: float = 0.0) -> None:
+        proc = handle
+        if not isinstance(proc, _Proc) or proc.popen.poll() is not None:
+            return
+        try:
+            # Kill the whole process group (executor + user child).
+            os.killpg(proc.popen.pid, signal.SIGTERM)
+        except (ProcessLookupError, PermissionError):
+            return
+        deadline = time.time() + grace_s
+        while time.time() < deadline:
+            if proc.popen.poll() is not None:
+                return
+            time.sleep(0.05)
+        try:
+            os.killpg(proc.popen.pid, signal.SIGKILL)
+        except (ProcessLookupError, PermissionError):
+            pass
+
+    def poll_completions(self) -> List[Tuple[str, int]]:
+        done: List[Tuple[str, int]] = []
+        with self._lock:
+            for proc in self._procs.values():
+                if proc.reported:
+                    continue
+                rc = proc.popen.poll()
+                if rc is not None:
+                    proc.reported = True
+                    # Negative returncode = killed by signal N.
+                    exit_code = 128 - rc if rc < 0 else rc
+                    done.append((proc.task_id, exit_code))
+        return done
+
+    def task_log_paths(self, task_id: str) -> Optional[Tuple[str, str]]:
+        with self._lock:
+            proc = self._procs.get(task_id)
+        if proc is None:
+            return None
+        return (os.path.join(proc.workdir, "stdout.log"),
+                os.path.join(proc.workdir, "stderr.log"))
+
+    def stop(self) -> None:
+        with self._lock:
+            procs = list(self._procs.values())
+        for proc in procs:
+            self.kill_task(proc, grace_s=0.5)

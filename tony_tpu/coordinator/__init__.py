@@ -1,0 +1,3 @@
+from tony_tpu.coordinator.session import Session, Task, TaskStatus, SessionStatus  # noqa: F401
+from tony_tpu.coordinator.scheduler import GangScheduler, SchedulerError  # noqa: F401
+from tony_tpu.coordinator.coordinator import Coordinator  # noqa: F401

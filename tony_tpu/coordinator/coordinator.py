@@ -1,0 +1,442 @@
+"""The job coordinator: per-job controller process.
+
+Reference model: ``ApplicationMaster.java`` (1238 LoC) — lifecycle
+prepare→start→monitor→(reset/retry)→stop (:296-297, ``run`` :312):
+registers RPC servers (:402-413), writes the frozen config + event stream to
+the history dir (:456-457), launches executors, runs the heartbeat liveness
+monitor (:188-208), applies whole-job retry by resetting the session with a
+bumped session id (:356-371, :559-575), and waits for the client's finish
+signal before tearing down (:684).
+
+TPU-first deltas:
+- No container-allocation matching: the backend launches whole gangs (slice
+  leases are atomic — SURVEY.md §7 hard part (a)).
+- One RPC server carries the application + metrics surfaces.
+- The rendezvous the coordinator brokers doubles as the JAX coordination
+  bootstrap: task 0's spec becomes ``JAX_COORDINATOR_ADDRESS`` downstream.
+
+Fault-injection hooks honoured here (reference ``Constants.java:116-121``,
+SURVEY.md §4.1): TEST_COORDINATOR_CRASH (AM crash analogue,
+``ApplicationMaster.java:338-343``), TEST_WORKER_TERMINATION (:1224-1235),
+TEST_COMPLETION_DELAY (:1029-1038).
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import threading
+import time
+from typing import Dict, List, Optional
+
+from tony_tpu import constants
+from tony_tpu.cluster.base import Backend, TaskLaunchSpec
+from tony_tpu.conf.config import TonyTpuConfig
+from tony_tpu.conf import keys as K
+from tony_tpu.coordinator.scheduler import GangScheduler
+from tony_tpu.coordinator.session import (Session, SessionStatus, Task,
+                                          TaskStatus)
+from tony_tpu.events.events import Event, EventHandler, EventType
+from tony_tpu.events import history
+from tony_tpu.rpc.wire import RpcServer
+
+log = logging.getLogger(__name__)
+
+
+class CoordinatorCrash(RuntimeError):
+    """Raised by the TEST_COORDINATOR_CRASH hook."""
+
+
+class _RpcService:
+    """The 7-method application surface + metrics, dispatched by RpcServer.
+
+    Reference: ``tensorflow_cluster_service_protos.proto:11-19`` —
+    getTaskInfos / getClusterSpec / registerWorkerSpec / registerTensorBoardUrl
+    / registerExecutionResult / finishApplication / taskExecutorHeartbeat —
+    plus the Writable metrics channel (``rpc/MetricsRpc.java``).
+    """
+
+    def __init__(self, coord: "Coordinator"):
+        self._c = coord
+
+    def get_task_infos(self) -> List[dict]:
+        return [t.to_info() for t in self._c.session.all_tasks()]
+
+    def get_cluster_spec(self, task_id: str) -> Optional[dict]:
+        return self._c.session.get_cluster_spec()
+
+    def register_worker_spec(self, task_id: str, host: str,
+                             port: int) -> Optional[dict]:
+        return self._c.register_worker_spec(task_id, host, port)
+
+    def register_tensorboard_url(self, task_id: str, url: str) -> bool:
+        return self._c.register_tensorboard_url(task_id, url)
+
+    def register_execution_result(self, task_id: str, exit_code: int) -> int:
+        return self._c.register_execution_result(task_id, exit_code)
+
+    def finish_application(self) -> str:
+        self._c.client_signalled_finish.set()
+        return self._c.final_status.value
+
+    def task_executor_heartbeat(self, task_id: str) -> bool:
+        return self._c.heartbeat(task_id)
+
+    def get_application_report(self) -> dict:
+        return self._c.application_report()
+
+    def kill_application(self) -> bool:
+        """Client-initiated force kill (reference
+        ``TonyClient.forceKillApplication`` :959)."""
+        self._c.request_stop("killed by client")
+        return True
+
+    def metrics__push(self, task_id: str, metrics: dict) -> bool:
+        self._c.metrics_store[task_id] = metrics
+        return True
+
+    def metrics__get(self, task_id: str) -> Optional[dict]:
+        return self._c.metrics_store.get(task_id)
+
+
+class Coordinator:
+    def __init__(self, conf: TonyTpuConfig, app_id: str, backend: Backend,
+                 history_root: str, user: str = "",
+                 rpc_token: Optional[str] = None):
+        self.conf = conf
+        self.app_id = app_id
+        self.backend = backend
+        self.user = user or os.environ.get("USER", "unknown")
+        self.history_root = history_root
+        self.session = Session(conf, session_id=0)
+        self.scheduler: Optional[GangScheduler] = None
+        self.metrics_store: Dict[str, dict] = {}
+        self.tb_url: str = ""
+        self.client_signalled_finish = threading.Event()
+        self.final_status = SessionStatus.RUNNING
+        self._stop_requested = threading.Event()
+        self._stop_reason = ""
+        self._started_ms = int(time.time() * 1000)
+        self._last_hb: Dict[str, float] = {}
+        self._hb_lock = threading.Lock()
+        self._schedule_start: float = 0.0
+        self._worker_termination_done = False
+        self._final_conf_path = ""
+
+        if rpc_token is None and conf.get_bool(K.APPLICATION_SECURITY_ENABLED):
+            import secrets
+            rpc_token = secrets.token_hex(16)
+        self.rpc_token = rpc_token
+        self.rpc = RpcServer(
+            _RpcService(self),
+            host=str(conf.get(K.COORDINATOR_HOST_KEY)),
+            port=conf.get_int(K.COORDINATOR_PORT_KEY, 0),
+            token=rpc_token)
+
+        job_dir = history.intermediate_dir(history_root, app_id)
+        self.job_dir = job_dir
+        self.events = EventHandler(
+            job_dir, history.in_progress_name(app_id, self._started_ms,
+                                              self.user))
+
+        hb_interval = conf.get_int(K.TASK_HEARTBEAT_INTERVAL_MS, 1000)
+        max_missed = conf.get_int(K.TASK_MAX_MISSED_HEARTBEATS, 25)
+        # Reference expiry formula: hbInterval * max(3, maxMisses)
+        # (ApplicationMaster.java:205).
+        self._hb_expiry_s = hb_interval * max(3, max_missed) / 1000.0
+
+    # ------------------------------------------------------------------
+    # Launching
+    # ------------------------------------------------------------------
+    def _task_env(self, task: Task) -> Dict[str, str]:
+        """Identity env contract (reference ApplicationMaster.java:1129-1141)."""
+        job = self.session.jobs[task.job_name]
+        host, port = self.rpc.address
+        env = {
+            constants.JOB_NAME: task.job_name,
+            constants.TASK_INDEX: str(task.index),
+            constants.TASK_NUM: str(job.instances),
+            constants.IS_CHIEF: str(
+                self.session.is_chief(task.job_name, task.index)).lower(),
+            constants.SESSION_ID: str(self.session.session_id),
+            constants.APP_ID: self.app_id,
+            constants.TASK_ID: task.task_id,
+            constants.COORDINATOR_HOST: host,
+            constants.COORDINATOR_PORT: str(port),
+            constants.METRICS_PORT: str(port),
+            constants.TASK_COMMAND: job.command,
+        }
+        if self.rpc_token:
+            env["TONY_RPC_TOKEN"] = self.rpc_token
+        if self._final_conf_path:
+            env[constants.EXECUTOR_CONF] = self._final_conf_path
+        for kv in self.conf.get_list(K.EXECUTION_ENV):
+            if "=" in kv:
+                k, v = kv.split("=", 1)
+                env[k] = v
+        env.update(job.env)
+        return env
+
+    def _launch_job(self, job_name: str) -> None:
+        job = self.session.jobs[job_name]
+        for i in range(job.instances):
+            task = self.session.get_task(f"{job_name}:{i}")
+            if task is None or task.status != TaskStatus.NEW:
+                continue
+            spec = TaskLaunchSpec(
+                task_id=task.task_id, job_name=job_name, index=i,
+                command=job.command, env=self._task_env(task),
+                vcores=job.vcores, memory=job.memory, chips=job.chips,
+                node_pool=job.node_pool)
+            task.handle = self.backend.launch_task(spec)
+            task.status = TaskStatus.SCHEDULED
+            self.events.emit(Event(EventType.TASK_STARTED, {
+                "task": task.task_id, "session_id": self.session.session_id}))
+
+    # ------------------------------------------------------------------
+    # RPC-surface behaviour
+    # ------------------------------------------------------------------
+    def register_worker_spec(self, task_id: str, host: str,
+                             port: int) -> Optional[dict]:
+        """Gang barrier: record the spec, return the full cluster spec only
+        once ALL tasks registered (reference ApplicationMaster.java:841-889)."""
+        ok = self.session.register_worker(task_id, host, port)
+        if ok:
+            with self._hb_lock:
+                self._last_hb[task_id] = time.monotonic()
+            self._maybe_test_worker_termination(task_id)
+        return self.session.get_cluster_spec()
+
+    def _maybe_test_worker_termination(self, task_id: str) -> None:
+        """TEST_WORKER_TERMINATION hook: once the chief registers, kill one
+        task of the configured jobtype (reference :1224-1235)."""
+        target_type = os.environ.get(constants.TEST_WORKER_TERMINATION, "")
+        if not target_type or self._worker_termination_done:
+            return
+        job, _, idx = task_id.partition(":")
+        if not self.session.is_chief(job, int(idx)):
+            return
+        for t in self.session.all_tasks():
+            if t.job_name == target_type and t.handle is not None:
+                log.warning("TEST hook: terminating %s", t.task_id)
+                self.backend.kill_task(t.handle, grace_s=0.0)
+                self._worker_termination_done = True
+                return
+
+    def register_tensorboard_url(self, task_id: str, url: str) -> bool:
+        t = self.session.get_task(task_id)
+        if t is None:
+            return False
+        t.tb_url = url
+        self.tb_url = url
+        return True
+
+    def register_execution_result(self, task_id: str, exit_code: int) -> int:
+        """Executor self-report; unregisters from the liveness monitor so a
+        completed task can't be deemed dead (reference design note
+        ``ApplicationMaster.java:891-919``)."""
+        with self._hb_lock:
+            self._last_hb.pop(task_id, None)
+        self._process_completion(task_id, exit_code)
+        return 0
+
+    def heartbeat(self, task_id: str) -> bool:
+        with self._hb_lock:
+            if task_id in self._last_hb:
+                self._last_hb[task_id] = time.monotonic()
+        return True
+
+    def application_report(self) -> dict:
+        status = (self.final_status if self.final_status != SessionStatus.RUNNING
+                  else self.session.status)
+        return {
+            "app_id": self.app_id,
+            "status": status.value,
+            "failure_reason": self.session.failure_reason or self._stop_reason,
+            "session_id": self.session.session_id,
+            "tb_url": self.tb_url,
+            "tasks": [t.to_info() for t in self.session.all_tasks()],
+        }
+
+    def request_stop(self, reason: str) -> None:
+        self._stop_reason = reason
+        self._stop_requested.set()
+
+    # ------------------------------------------------------------------
+    # Completion plumbing
+    # ------------------------------------------------------------------
+    def _process_completion(self, task_id: str, exit_code: int) -> None:
+        """Reference ``processFinishedContainer`` :1187-1220: apply failure
+        policy, notify scheduler, emit TASK_FINISHED with last metrics."""
+        delay = float(os.environ.get(constants.TEST_COMPLETION_DELAY, "0") or 0)
+        if delay:
+            time.sleep(delay)
+        t = self.session.get_task(task_id)
+        if t is None or t.status.terminal:
+            return
+        self.session.on_task_completed(task_id, exit_code)
+        self.events.emit(Event(EventType.TASK_FINISHED, {
+            "task": task_id, "exit_code": exit_code,
+            "status": t.status.value,
+            "metrics": self.metrics_store.get(task_id, {}),
+            "session_id": self.session.session_id}))
+        if self.scheduler is not None and t.tracked:
+            job = self.session.jobs[t.job_name]
+            done = [self.session.get_task(f"{t.job_name}:{i}")
+                    for i in range(job.instances)]
+            if all(x is not None and x.status == TaskStatus.SUCCEEDED
+                   for x in done):
+                self.scheduler.register_job_completed(t.job_name)
+
+    def _check_heartbeats(self) -> None:
+        """Liveness monitor (reference AbstractLivelinessMonitor usage
+        :188-208; expiry → ``onTaskDeemedDead`` :1178-1185)."""
+        now = time.monotonic()
+        expired: List[str] = []
+        with self._hb_lock:
+            for task_id, last in list(self._last_hb.items()):
+                if now - last > self._hb_expiry_s:
+                    expired.append(task_id)
+                    del self._last_hb[task_id]
+        for task_id in expired:
+            t = self.session.get_task(task_id)
+            if t is None or t.status.terminal:
+                continue
+            log.error("task %s missed heartbeats for %.1fs — deemed dead",
+                      task_id, self._hb_expiry_s)
+            if t.handle is not None:
+                self.backend.kill_task(t.handle, grace_s=0.0)
+            self.session.on_task_completed(task_id, constants.EXIT_KILLED)
+            self.session.fail(f"task {task_id} deemed dead "
+                              f"(missed heartbeats)")
+
+    # ------------------------------------------------------------------
+    # Lifecycle
+    # ------------------------------------------------------------------
+    def run(self) -> SessionStatus:
+        """prepare → [start → monitor → reset?]* → stop
+        (reference ``ApplicationMaster.run`` :312 + retry loop :337-371)."""
+        self.rpc.start()
+        self.events.start()
+        self.events.emit(Event(EventType.APPLICATION_INITED, {
+            "app_id": self.app_id, "user": self.user,
+            "conf": {k: v for k, v in self.conf.as_dict().items()
+                     if not k.startswith("_")}}))
+        self._final_conf_path = self.conf.freeze(
+            os.path.join(self.job_dir, constants.FINAL_CONFIG_FILE))
+
+        if os.environ.get(constants.TEST_COORDINATOR_CRASH) == "true":
+            # Reference TEST_AM_CRASH aborts the AM after startup (:338-343).
+            self.events.stop(history.final_name(
+                self.app_id, self._started_ms, int(time.time() * 1000),
+                self.user, "FAILED"))
+            self.rpc.stop()
+            raise CoordinatorCrash("TEST_COORDINATOR_CRASH requested")
+
+        retries = self.conf.get_int(K.APPLICATION_RETRY_COUNT, 0)
+        attempt = 0
+        try:
+            while True:
+                self._start_session(attempt)
+                status = self._monitor()
+                if status == SessionStatus.SUCCEEDED or attempt >= retries \
+                        or self._stop_requested.is_set():
+                    break
+                log.warning("session %d failed (%s); retrying (%d left)",
+                            attempt, self.session.failure_reason,
+                            retries - attempt)
+                self._reset_session()
+                attempt += 1
+        finally:
+            self.final_status = self.session.update_status()
+            if self._stop_requested.is_set() and \
+                    self.final_status == SessionStatus.RUNNING:
+                self.final_status = SessionStatus.KILLED
+            self._stop()
+        return self.final_status
+
+    def _start_session(self, attempt: int) -> None:
+        if attempt > 0:
+            # Rebuild the task matrix under a new epoch (reference
+            # ``reset`` :559-575 — sessionId++ and re-request everything).
+            self.session = Session(self.conf, session_id=attempt)
+            with self._hb_lock:
+                self._last_hb.clear()
+            self._worker_termination_done = False
+        self.scheduler = GangScheduler(self.conf, self._launch_job)
+        self._schedule_start = time.monotonic()
+        self.scheduler.schedule_ready()
+
+    def _monitor(self) -> SessionStatus:
+        """Reference ``monitor()`` :581-650 — 5 s loop; 500 ms here."""
+        interval = self.conf.get_int(K.COORDINATOR_MONITOR_INTERVAL_MS,
+                                     500) / 1000.0
+        timeout_s = self.conf.get_int(K.APPLICATION_TIMEOUT_S, 0)
+        reg_timeout_s = self.conf.get_int(K.TASK_REGISTRATION_TIMEOUT_S, 900)
+        while True:
+            if self._stop_requested.is_set():
+                self.session.fail(self._stop_reason or "stop requested")
+                for t in self.session.all_tasks():
+                    if t.handle is not None and not t.status.terminal:
+                        self.backend.kill_task(t.handle, grace_s=0.0)
+                        self.session.mark_killed(t.task_id)
+                return self.session.status
+            if timeout_s and (time.monotonic() - self._schedule_start
+                              > timeout_s):
+                self.session.fail(f"application timed out after {timeout_s}s")
+                return self.session.status
+            if not self.session.all_registered() and reg_timeout_s and \
+                    self.scheduler is not None and self.scheduler.all_scheduled \
+                    and (time.monotonic() - self._schedule_start
+                         > reg_timeout_s):
+                # Gang rendezvous timed out (reference registration timeout
+                # kills stuck allocations, ApplicationMaster.java:791-888).
+                self.session.fail(
+                    f"registration timeout: {self.session.num_registered}/"
+                    f"{self.session.num_expected} tasks registered within "
+                    f"{reg_timeout_s}s")
+                return self.session.status
+            for task_id, exit_code in self.backend.poll_completions():
+                self._process_completion(task_id, exit_code)
+            self._check_heartbeats()
+            if self.session.status != SessionStatus.RUNNING:
+                return self.session.status
+            if self.session.training_finished():
+                return self.session.update_status()
+            time.sleep(interval)
+
+    def _reset_session(self) -> None:
+        grace = self.conf.get_int(K.COORDINATOR_STOP_GRACE_S, 15)
+        for t in self.session.all_tasks():
+            if t.handle is not None and not t.status.terminal:
+                self.backend.kill_task(t.handle, grace_s=min(grace, 1))
+        # Drain exits so the new epoch's poll doesn't see stale completions.
+        deadline = time.time() + 5
+        while time.time() < deadline:
+            if not self.backend.poll_completions():
+                break
+            time.sleep(0.1)
+
+    def _stop(self) -> None:
+        """Reference ``stop()`` :670-711 — stop running tasks with grace,
+        wait for the client finish signal, finalize history."""
+        grace = self.conf.get_int(K.COORDINATOR_STOP_GRACE_S, 15)
+        for t in self.session.all_tasks():
+            if t.handle is not None and not t.status.terminal:
+                self.backend.kill_task(t.handle, grace_s=min(grace, 2))
+                if not t.tracked:
+                    t.status = TaskStatus.SUCCEEDED  # ps-style normal teardown
+                else:
+                    self.session.mark_killed(t.task_id)
+        if self.conf.get_bool(K.APPLICATION_NUM_CLIENTS_TO_WAIT, True):
+            self.client_signalled_finish.wait(
+                timeout=self.conf.get_int(K.COORDINATOR_STOP_GRACE_S, 15))
+        self.events.emit(Event(EventType.APPLICATION_FINISHED, {
+            "app_id": self.app_id, "status": self.final_status.value,
+            "failure_reason": self.session.failure_reason or "",
+        }))
+        self.events.stop(history.final_name(
+            self.app_id, self._started_ms, int(time.time() * 1000), self.user,
+            self.final_status.value))
+        self.backend.stop()
+        self.rpc.stop()

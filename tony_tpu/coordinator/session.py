@@ -1,0 +1,226 @@
+"""In-coordinator job state: the task matrix, cluster spec and failure policy.
+
+Reference model: ``tensorflow/TonySession.java`` (561 LoC) —
+- jobName → TonyTask[] matrix (:54) with a per-task state machine (:410-551);
+- cluster spec {job: [host:port, ...]} built from registered workers
+  (``getClusterSpec`` :226-246);
+- chief semantics: the ``chief`` jobtype, else worker:0 (``isChief`` :364);
+- failure policy on task completion (:251-271): chief failure fails the job;
+  ``stop-on-failure-jobtypes`` short-circuit; optional fail-on-any-worker;
+- final-status reduction over tracked tasks (``updateSessionStatus`` :276-330);
+- ``sessionId`` retry epoch incremented on whole-job retry (:51).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+import threading
+from typing import Dict, List, Optional, Tuple
+
+from tony_tpu import constants
+from tony_tpu.conf.config import JobType, TonyTpuConfig
+from tony_tpu.conf import keys as K
+
+
+class TaskStatus(str, enum.Enum):
+    NEW = "NEW"                # defined, not yet handed to the backend
+    SCHEDULED = "SCHEDULED"    # launch requested from the backend
+    RUNNING = "RUNNING"        # process up (registered or heartbeating)
+    SUCCEEDED = "SUCCEEDED"
+    FAILED = "FAILED"
+    KILLED = "KILLED"
+
+    @property
+    def terminal(self) -> bool:
+        return self in (TaskStatus.SUCCEEDED, TaskStatus.FAILED,
+                        TaskStatus.KILLED)
+
+
+class SessionStatus(str, enum.Enum):
+    RUNNING = "RUNNING"
+    SUCCEEDED = "SUCCEEDED"
+    FAILED = "FAILED"
+    KILLED = "KILLED"
+
+
+@dataclasses.dataclass
+class Task:
+    """One gang member (reference ``TonySession.TonyTask`` :410-551)."""
+
+    job_name: str
+    index: int
+    session_id: int = 0
+    status: TaskStatus = TaskStatus.NEW
+    host: str = ""
+    port: int = 0
+    exit_code: Optional[int] = None
+    tracked: bool = True
+    registered: bool = False
+    tb_url: str = ""
+    handle: object = None  # backend-specific process/lease handle
+
+    @property
+    def task_id(self) -> str:
+        return f"{self.job_name}:{self.index}"
+
+    @property
+    def spec(self) -> str:
+        return f"{self.host}:{self.port}"
+
+    def to_info(self) -> Dict[str, object]:
+        """Wire form of TaskInfo (reference ``rpc/TaskInfo.java``)."""
+        return {
+            "name": self.job_name, "index": self.index,
+            "status": self.status.value, "url": self.tb_url,
+            "host": self.host, "port": self.port,
+            "exit_code": self.exit_code, "session_id": self.session_id,
+        }
+
+
+class Session:
+    """Task matrix + rendezvous barrier + failure policy."""
+
+    def __init__(self, conf: TonyTpuConfig, session_id: int = 0):
+        self.conf = conf
+        self.session_id = session_id
+        self.jobs: Dict[str, JobType] = conf.job_types()
+        untracked = set(conf.untracked_jobtypes())
+        self.stop_on_failure = set(
+            conf.get_list(K.APPLICATION_STOP_ON_FAILURE_JOBTYPES))
+        self.fail_on_worker_failure = conf.get_bool(
+            K.APPLICATION_FAIL_ON_WORKER_FAILURE)
+        self._lock = threading.RLock()
+        self.tasks: Dict[str, Task] = {}
+        for job in self.jobs.values():
+            for i in range(job.instances):
+                t = Task(job.name, i, session_id=session_id,
+                         tracked=job.name not in untracked)
+                self.tasks[t.task_id] = t
+        self.status = SessionStatus.RUNNING
+        self.failure_reason: Optional[str] = None
+
+    # -- queries ----------------------------------------------------------
+    def get_task(self, task_id: str) -> Optional[Task]:
+        return self.tasks.get(task_id)
+
+    def all_tasks(self) -> List[Task]:
+        return list(self.tasks.values())
+
+    def tracked_tasks(self) -> List[Task]:
+        return [t for t in self.tasks.values() if t.tracked]
+
+    def is_chief(self, job_name: str, index: int) -> bool:
+        """Reference ``TonySession.isChief`` :364 — the ``chief`` jobtype if it
+        exists, else worker:0."""
+        if constants.CHIEF_JOB_NAME in self.jobs:
+            return job_name == constants.CHIEF_JOB_NAME
+        return job_name == constants.WORKER_JOB_NAME and index == 0
+
+    @property
+    def num_expected(self) -> int:
+        return len(self.tasks)
+
+    @property
+    def num_registered(self) -> int:
+        with self._lock:
+            return sum(1 for t in self.tasks.values() if t.registered)
+
+    def all_registered(self) -> bool:
+        return self.num_registered == self.num_expected
+
+    def get_cluster_spec(self) -> Optional[Dict[str, List[str]]]:
+        """{job: ["host:port", ...]} once ALL tasks registered, else None —
+        this None is the gang barrier the executors poll on (reference
+        ``ApplicationMaster.java:856-888`` returns null until every one of
+        numExpectedTasks has registered; spec built by
+        ``TonySession.getClusterSpec`` :226-246)."""
+        with self._lock:
+            if not self.all_registered():
+                return None
+            spec: Dict[str, List[str]] = {}
+            for job_name, job in self.jobs.items():
+                members = [self.tasks[f"{job_name}:{i}"].spec
+                           for i in range(job.instances)]
+                spec[job_name] = members
+            return spec
+
+    # -- mutations --------------------------------------------------------
+    def register_worker(self, task_id: str, host: str, port: int) -> bool:
+        with self._lock:
+            t = self.tasks.get(task_id)
+            if t is None or t.status.terminal:
+                return False
+            t.host, t.port = host, int(port)
+            t.registered = True
+            if t.status in (TaskStatus.NEW, TaskStatus.SCHEDULED):
+                t.status = TaskStatus.RUNNING
+            return True
+
+    def on_task_completed(self, task_id: str, exit_code: int) -> None:
+        """Apply completion + failure policy (reference
+        ``TonySession.onTaskCompleted`` :251-271)."""
+        with self._lock:
+            t = self.tasks.get(task_id)
+            if t is None or t.status.terminal:
+                return
+            t.exit_code = exit_code
+            if exit_code == 0:
+                t.status = TaskStatus.SUCCEEDED
+                return
+            t.status = (TaskStatus.KILLED
+                        if exit_code == constants.EXIT_KILLED
+                        else TaskStatus.FAILED)
+            if not t.tracked:
+                # Untracked (ps-style) crash is still a job failure when it
+                # dies on its own (reference ApplicationMaster.java:1212-1215).
+                self._fail(f"untracked task {task_id} crashed "
+                           f"(exit {exit_code})")
+                return
+            if self.is_chief(t.job_name, t.index):
+                self._fail(f"chief task {task_id} failed (exit {exit_code})")
+            elif t.job_name in self.stop_on_failure:
+                self._fail(f"stop-on-failure jobtype {t.job_name}: task "
+                           f"{task_id} failed (exit {exit_code})")
+            elif self.fail_on_worker_failure:
+                self._fail(f"task {task_id} failed (exit {exit_code}) and "
+                           f"fail-on-worker-failure is enabled")
+
+    def mark_killed(self, task_id: str, reason: str = "") -> None:
+        with self._lock:
+            t = self.tasks.get(task_id)
+            if t and not t.status.terminal:
+                t.status = TaskStatus.KILLED
+                t.exit_code = constants.EXIT_KILLED
+
+    def _fail(self, reason: str) -> None:
+        if self.status == SessionStatus.RUNNING:
+            self.status = SessionStatus.FAILED
+            self.failure_reason = reason
+
+    def fail(self, reason: str) -> None:
+        with self._lock:
+            self._fail(reason)
+
+    # -- reduction --------------------------------------------------------
+    def update_status(self) -> SessionStatus:
+        """Reduce tracked-task states to a session status (reference
+        ``TonySession.updateSessionStatus`` :276-330)."""
+        with self._lock:
+            if self.status != SessionStatus.RUNNING:
+                return self.status
+            tracked = self.tracked_tasks()
+            if tracked and all(t.status.terminal for t in tracked):
+                failed = [t for t in tracked
+                          if t.status in (TaskStatus.FAILED, TaskStatus.KILLED)]
+                if failed:
+                    self._fail(
+                        f"{len(failed)} tracked task(s) failed: "
+                        + ", ".join(t.task_id for t in failed[:5]))
+                else:
+                    self.status = SessionStatus.SUCCEEDED
+            return self.status
+
+    def training_finished(self) -> bool:
+        tracked = self.tracked_tasks()
+        return bool(tracked) and all(t.status.terminal for t in tracked)

@@ -1,0 +1,1 @@
+from tony_tpu.executor.executor import TaskExecutor  # noqa: F401

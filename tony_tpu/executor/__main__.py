@@ -1,0 +1,8 @@
+"""`python -m tony_tpu.executor` — the per-task agent entrypoint
+(reference ``TaskExecutor.main`` :211)."""
+
+import sys
+
+from tony_tpu.executor.executor import main
+
+sys.exit(main())

@@ -1,0 +1,221 @@
+"""Per-task agent: registers with the coordinator, waits on the gang barrier,
+wires the framework env, supervises the user process.
+
+Reference model: ``TaskExecutor.java`` (393 LoC) — identity from env
+(``initConfigs`` :255), RPC proxies to the AM (:140-145), port reservation
+(:83-95), ``registerAndGetClusterSpec`` poll-until-non-null barrier
+(:295-309), framework env switch (:161-207), user exec + exit-code report
+(:239-243), background heartbeater (:330-370) and metrics pump (:146-150).
+
+Fault hooks honoured: TEST_NUM_HB_MISS (skip first N heartbeats, reference
+:330-357), TEST_EXECUTOR_SKEW (post-exit straggler sleep, reference :372-392).
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import socket
+import sys
+import threading
+import time
+from typing import Dict, Optional
+
+from tony_tpu import constants
+from tony_tpu.conf.config import TonyTpuConfig
+from tony_tpu.conf import keys as K
+from tony_tpu.executor.monitor import TaskMonitor
+from tony_tpu.executor.ports import ReservedPort
+from tony_tpu.rpc.wire import RpcClient
+from tony_tpu.runtimes.base import TaskIdentity, get_runtime
+from tony_tpu.utils import proc as procutil
+
+log = logging.getLogger(__name__)
+
+
+class Heartbeater(threading.Thread):
+    """Reference ``TaskExecutor`` heartbeat thread :330-370."""
+
+    def __init__(self, client: RpcClient, task_id: str, interval_s: float):
+        super().__init__(name="tony-heartbeater", daemon=True)
+        self._client = client
+        self._task_id = task_id
+        self._interval_s = interval_s
+        self._stop = threading.Event()
+        self._skip = int(os.environ.get(constants.TEST_NUM_HB_MISS, "0") or 0)
+
+    def run(self) -> None:
+        while not self._stop.wait(self._interval_s):
+            if self._skip > 0:
+                self._skip -= 1
+                log.warning("TEST hook: skipping heartbeat (%d more)",
+                            self._skip)
+                continue
+            try:
+                self._client.call("task_executor_heartbeat",
+                                  task_id=self._task_id)
+            except Exception as e:  # noqa: BLE001
+                log.warning("heartbeat failed: %s", e)
+
+    def stop(self) -> None:
+        self._stop.set()
+
+
+class TaskExecutor:
+    def __init__(self, env: Optional[Dict[str, str]] = None):
+        e = env or os.environ
+        self.job_name = e[constants.JOB_NAME]
+        self.index = int(e[constants.TASK_INDEX])
+        self.task_num = int(e[constants.TASK_NUM])
+        self.is_chief = e.get(constants.IS_CHIEF, "false") == "true"
+        self.session_id = int(e.get(constants.SESSION_ID, "0"))
+        self.task_id = e.get(constants.TASK_ID,
+                             f"{self.job_name}:{self.index}")
+        self.coordinator_host = e[constants.COORDINATOR_HOST]
+        self.coordinator_port = int(e[constants.COORDINATOR_PORT])
+        self.command = e.get(constants.TASK_COMMAND, "")
+        conf_path = e.get(constants.EXECUTOR_CONF, "")
+        self.conf = (TonyTpuConfig.load_final(conf_path)
+                     if conf_path and os.path.exists(conf_path)
+                     else TonyTpuConfig())
+        self.client = RpcClient(
+            self.coordinator_host, self.coordinator_port,
+            token=e.get("TONY_RPC_TOKEN") or None,
+            max_retries=10, retry_sleep_s=2.0)
+        self.hostname = e.get("TONY_ADVERTISED_HOST") or socket.gethostname()
+        try:
+            socket.getaddrinfo(self.hostname, None)
+        except OSError:
+            self.hostname = "127.0.0.1"
+        self.rendezvous_port: Optional[ReservedPort] = None
+        self.tb_port: Optional[ReservedPort] = None
+
+    # -- setup ----------------------------------------------------------
+    def setup_ports(self) -> None:
+        """Reserve the rendezvous port (+ TensorBoard port if chief);
+        reference ``TaskExecutor.setupPorts`` :83-95."""
+        reuse = self.conf.get_bool(K.TASK_REUSE_PORT) or \
+            os.environ.get("TF_GRPC_REUSE_PORT", "").lower() == "true"
+        try:
+            self.rendezvous_port = ReservedPort(reuse=reuse)
+        except OSError:
+            self.rendezvous_port = ReservedPort(reuse=False)
+        if self.is_chief:
+            self.tb_port = ReservedPort(reuse=False)
+            try:
+                self.client.call(
+                    "register_tensorboard_url", task_id=self.task_id,
+                    url=f"http://{self.hostname}:{self.tb_port.port}")
+            except Exception as e:  # noqa: BLE001
+                log.warning("TB registration failed: %s", e)
+        port_file = str(self.conf.get(K.TASK_PORT_FILE, "") or "")
+        if port_file:
+            with open(port_file, "w") as f:
+                f.write(str(self.rendezvous_port.port))
+
+    def register_and_get_cluster_spec(self) -> Optional[dict]:
+        """The gang barrier (reference :295-309): re-register every 3 s until
+        the coordinator returns the complete spec."""
+        timeout_s = self.conf.get_int(K.TASK_REGISTRATION_TIMEOUT_S, 900)
+
+        def attempt() -> Optional[dict]:
+            try:
+                return self.client.call(
+                    "register_worker_spec", task_id=self.task_id,
+                    host=self.hostname, port=self.rendezvous_port.port)
+            except Exception as e:  # noqa: BLE001
+                log.warning("register_worker_spec failed: %s", e)
+                return None
+
+        return procutil.poll_till_non_null(
+            attempt, interval_s=0.3, timeout_s=timeout_s)
+
+    # -- run ------------------------------------------------------------
+    def run(self) -> int:
+        if not self.command:
+            log.error("no task command configured for %s", self.task_id)
+            return constants.EXIT_FAILURE
+        self.setup_ports()
+        hb = Heartbeater(
+            self.client, self.task_id,
+            self.conf.get_int(K.TASK_HEARTBEAT_INTERVAL_MS, 1000) / 1000.0)
+        hb.start()
+        monitor = TaskMonitor(
+            self.task_id,
+            push=lambda tid, m: self.client.call("metrics.push", task_id=tid,
+                                                 metrics=m),
+            interval_s=self.conf.get_int(K.TASK_METRICS_INTERVAL_MS,
+                                         5000) / 1000.0)
+
+        cluster_spec = self.register_and_get_cluster_spec()
+        if cluster_spec is None:
+            log.error("registration barrier timed out for %s", self.task_id)
+            return constants.EXIT_FAILURE
+        log.info("cluster spec: %s", cluster_spec)
+
+        framework = str(self.conf.get(K.APPLICATION_FRAMEWORK, "jax"))
+        runtime = get_runtime(framework)
+        me = TaskIdentity(self.job_name, self.index, self.task_num,
+                          self.is_chief, self.rendezvous_port.port)
+        env = runtime.build_env(cluster_spec, me, self.conf)
+        if self.tb_port is not None:
+            env[constants.TB_PORT] = str(self.tb_port.port)
+
+        # Release-before-exec dance (reference :224-249): ephemeral ports must
+        # be free for the user process to bind; reusable ports stay held.
+        child_pid: list = [None]
+        if not self.rendezvous_port.reuse:
+            self.rendezvous_port.release()
+        if self.tb_port is not None:
+            self.tb_port.release()
+
+        monitor._pid_fn = lambda: child_pid[0] or os.getpid()
+        monitor.start()
+        try:
+            exit_code = procutil.execute_shell(
+                self.command,
+                timeout_s=self.conf.get_int(
+                    K.TASK_EXECUTOR_EXECUTION_TIMEOUT_S, 0),
+                env=env,
+                on_start=lambda p: child_pid.__setitem__(0, p.pid))
+        finally:
+            monitor.stop()
+            if self.rendezvous_port.reuse:
+                self.rendezvous_port.release()
+        log.info("user process for %s exited with %d", self.task_id, exit_code)
+
+        try:
+            self.client.call("register_execution_result",
+                             task_id=self.task_id, exit_code=exit_code)
+        except Exception as e:  # noqa: BLE001
+            log.warning("failed to report execution result: %s", e)
+        hb.stop()
+        self._maybe_skew_sleep()
+        return exit_code
+
+    def _maybe_skew_sleep(self) -> None:
+        """TEST_EXECUTOR_SKEW='job#idx#seconds' straggler simulation
+        (reference :372-392)."""
+        spec = os.environ.get(constants.TEST_EXECUTOR_SKEW, "")
+        if not spec:
+            return
+        try:
+            job, idx, seconds = spec.split("#")
+            if job == self.job_name and int(idx) == self.index:
+                log.warning("TEST hook: skew sleep %ss", seconds)
+                time.sleep(float(seconds))
+        except ValueError:
+            log.warning("bad %s spec: %r", constants.TEST_EXECUTOR_SKEW, spec)
+
+
+def main() -> int:
+    logging.basicConfig(
+        level=logging.INFO,
+        format="%(asctime)s %(levelname)s %(name)s: %(message)s")
+    executor = TaskExecutor()
+    code = executor.run()
+    return code
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,133 @@
+"""Per-task resource metrics sampler.
+
+Reference model: ``TaskMonitor.java`` (192 LoC) — samples process-tree RSS via
+YARN's ResourceCalculatorProcessTree (:71,:109-114) and GPU utilization via
+``nvidia-smi -x -q`` (``GpuDiscoverer.java:88-131``), keeps max/avg aggregates
+(:172-186), and pushes MetricsWritable to the AM every
+``tony.task.metrics-interval-ms`` (:92-99).
+
+TPU deltas: RSS comes from /proc (no YARN); accelerator telemetry comes from
+the TPU runtime when present — libtpu exposes device metrics through JAX
+(``jax.local_devices()[i].memory_stats()``) instead of an ``nvidia-smi``
+subprocess. Sampling is best-effort and never fails the task.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from typing import Callable, Dict, List, Optional
+
+MAX_MEMORY_BYTES = "MAX_MEMORY_BYTES"
+AVG_MEMORY_BYTES = "AVG_MEMORY_BYTES"
+MAX_TPU_HBM_BYTES = "MAX_TPU_HBM_BYTES"
+AVG_TPU_HBM_BYTES = "AVG_TPU_HBM_BYTES"
+
+
+def _proc_tree_rss_bytes(root_pid: int) -> int:
+    """Sum VmRSS over root_pid and its descendants (the
+    ResourceCalculatorProcessTree analogue)."""
+    children: Dict[int, List[int]] = {}
+    try:
+        for entry in os.listdir("/proc"):
+            if not entry.isdigit():
+                continue
+            try:
+                with open(f"/proc/{entry}/stat") as f:
+                    parts = f.read().rsplit(") ", 1)[-1].split()
+                ppid = int(parts[1])
+                children.setdefault(ppid, []).append(int(entry))
+            except (OSError, ValueError, IndexError):
+                continue
+    except OSError:
+        return 0
+    total = 0
+    stack = [root_pid]
+    seen = set()
+    while stack:
+        pid = stack.pop()
+        if pid in seen:
+            continue
+        seen.add(pid)
+        try:
+            with open(f"/proc/{pid}/status") as f:
+                for line in f:
+                    if line.startswith("VmRSS:"):
+                        total += int(line.split()[1]) * 1024
+                        break
+        except (OSError, ValueError):
+            pass
+        stack.extend(children.get(pid, []))
+    return total
+
+
+def tpu_hbm_in_use_bytes() -> int:
+    """Best-effort HBM usage of locally visible TPU devices; 0 when no TPU
+    runtime is attached to *this* process (the usual case — the user process
+    owns the chips)."""
+    try:
+        import jax
+
+        total = 0
+        for d in jax.local_devices():
+            stats = getattr(d, "memory_stats", lambda: None)()
+            if stats:
+                total += int(stats.get("bytes_in_use", 0))
+        return total
+    except Exception:  # noqa: BLE001 — telemetry must never break the task
+        return 0
+
+
+class TaskMonitor:
+    """Background sampler pushing metrics to the coordinator."""
+
+    def __init__(self, task_id: str, push: Callable[[str, dict], None],
+                 interval_s: float = 5.0,
+                 pid_fn: Optional[Callable[[], Optional[int]]] = None):
+        self.task_id = task_id
+        self._push = push
+        self._interval_s = interval_s
+        self._pid_fn = pid_fn or (lambda: os.getpid())
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._samples = 0
+        self._metrics: Dict[str, float] = {
+            MAX_MEMORY_BYTES: 0.0, AVG_MEMORY_BYTES: 0.0,
+            MAX_TPU_HBM_BYTES: 0.0, AVG_TPU_HBM_BYTES: 0.0,
+        }
+
+    def sample_once(self) -> Dict[str, float]:
+        pid = self._pid_fn()
+        rss = _proc_tree_rss_bytes(pid) if pid else 0
+        hbm = tpu_hbm_in_use_bytes()
+        self._samples += 1
+        n = self._samples
+        # max/avg aggregation (reference TaskMonitor.java:172-186).
+        m = self._metrics
+        m[MAX_MEMORY_BYTES] = max(m[MAX_MEMORY_BYTES], rss)
+        m[AVG_MEMORY_BYTES] += (rss - m[AVG_MEMORY_BYTES]) / n
+        m[MAX_TPU_HBM_BYTES] = max(m[MAX_TPU_HBM_BYTES], hbm)
+        m[AVG_TPU_HBM_BYTES] += (hbm - m[AVG_TPU_HBM_BYTES]) / n
+        return dict(m)
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self._interval_s):
+            try:
+                self._push(self.task_id, self.sample_once())
+            except Exception:  # noqa: BLE001
+                pass
+
+    def start(self) -> None:
+        self._thread = threading.Thread(target=self._loop, daemon=True,
+                                        name="tony-task-monitor")
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread:
+            self._thread.join(timeout=2)
+        try:
+            self._push(self.task_id, dict(self._metrics))
+        except Exception:  # noqa: BLE001
+            pass

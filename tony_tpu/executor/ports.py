@@ -1,0 +1,51 @@
+"""Rendezvous-port reservation.
+
+Reference model (``ServerPort.java``/``EphemeralPort.java``/``ReusablePort.java``
++ ``resources/reserve_reusable_port.py``): the executor must pick the port it
+advertises to the coordinator *before* the user process exists, then hand that
+port over. Two strategies:
+
+- **Ephemeral** (default): bind port 0, read the assigned port, close before
+  exec — small race window, identical to ``EphemeralPort`` semantics and the
+  release-before-exec dance (``TaskExecutor.java:224-249``).
+- **Reusable**: bind with SO_REUSEPORT and *keep holding* while the user
+  process binds the same port with SO_REUSEPORT too — no race. The reference
+  needed a Python child process to do this from Java
+  (``reserve_reusable_port.py:61-89``); in-process here since the executor is
+  already Python.
+"""
+
+from __future__ import annotations
+
+import socket
+from typing import Optional
+
+
+class ReservedPort:
+    def __init__(self, reuse: bool = False):
+        self.reuse = reuse
+        self._sock: Optional[socket.socket] = socket.socket(
+            socket.AF_INET, socket.SOCK_STREAM)
+        if reuse:
+            if not hasattr(socket, "SO_REUSEPORT"):
+                raise OSError("SO_REUSEPORT not supported on this platform")
+            self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEPORT, 1)
+        self._sock.bind(("", 0))
+        self._sock.listen(1)
+        self.port: int = self._sock.getsockname()[1]
+
+    def release(self) -> None:
+        """Close the holding socket. For ephemeral ports call this just before
+        exec'ing the user process; for reusable ports call after the user
+        process has had a chance to bind (or at executor exit)."""
+        if self._sock is not None:
+            try:
+                self._sock.close()
+            finally:
+                self._sock = None
+
+    def __enter__(self) -> "ReservedPort":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.release()

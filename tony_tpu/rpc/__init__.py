@@ -1,0 +1,1 @@
+from tony_tpu.rpc.wire import RpcServer, RpcClient, RpcError  # noqa: F401

@@ -1,0 +1,211 @@
+"""Control-plane RPC: length-framed msgpack request/response over TCP.
+
+Fills the role of the reference's Hadoop-IPC + protobuf2 control plane
+(``ApplicationRpcServer.java:116-135`` server thread; retry-wrapped singleton
+client ``ApplicationRpcClient.java:47-76``; 7-method service
+``tensorflow_cluster_service_protos.proto:11-19`` plus the Writable metrics
+channel ``rpc/MetricsRpc.java``). Differences, on purpose:
+
+- One transport for both the application and metrics surfaces (namespaced
+  methods) instead of two RPC engines on two ports — there is no Hadoop
+  Writable legacy to carry here.
+- msgpack framing instead of protobuf: no codegen step, and the control plane
+  moves kilobytes, not tensors — the data plane is XLA collectives over
+  ICI/DCN, never this channel (SURVEY.md §2.4).
+- Optional shared-secret auth replaces the ClientToAMToken secret manager
+  (``ApplicationMaster.java:433-452``).
+
+Frame format: 4-byte big-endian length, then a msgpack map.
+Request:  {"id": int, "method": str, "args": {...}, "token": str?}
+Response: {"id": int, "ok": bool, "result": any} or {"id", "ok": False, "error": str}
+"""
+
+from __future__ import annotations
+
+import logging
+import socket
+import socketserver
+import struct
+import threading
+import time
+from typing import Any, Callable, Dict, Optional, Tuple
+
+import msgpack
+
+log = logging.getLogger(__name__)
+
+_MAX_FRAME = 64 * 1024 * 1024
+
+
+class RpcError(RuntimeError):
+    pass
+
+
+class AuthError(RpcError):
+    pass
+
+
+def _send_frame(sock: socket.socket, obj: Any) -> None:
+    data = msgpack.packb(obj, use_bin_type=True)
+    sock.sendall(struct.pack(">I", len(data)) + data)
+
+
+def _recv_exact(sock: socket.socket, n: int) -> bytes:
+    buf = b""
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            raise ConnectionError("peer closed connection")
+        buf += chunk
+    return buf
+
+
+def _recv_frame(sock: socket.socket) -> Any:
+    (length,) = struct.unpack(">I", _recv_exact(sock, 4))
+    if length > _MAX_FRAME:
+        raise RpcError(f"frame too large: {length}")
+    return msgpack.unpackb(_recv_exact(sock, length), raw=False)
+
+
+class RpcServer:
+    """Threaded TCP server dispatching methods on a service object.
+
+    Reference: ``ApplicationRpcServer`` runs as a daemon thread inside the AM
+    (``ApplicationMaster.java:402``); here likewise inside the coordinator.
+    Any public method of ``service`` becomes callable; a method named
+    ``ns__method`` is addressed as ``"ns.method"``.
+    """
+
+    def __init__(self, service: Any, host: str = "127.0.0.1", port: int = 0,
+                 token: Optional[str] = None):
+        self._service = service
+        self._token = token
+        outer = self
+
+        class Handler(socketserver.BaseRequestHandler):
+            def handle(self) -> None:  # one connection, many requests
+                sock = self.request
+                sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+                while True:
+                    try:
+                        req = _recv_frame(sock)
+                    except (ConnectionError, OSError):
+                        return
+                    resp = outer._dispatch(req)
+                    try:
+                        _send_frame(sock, resp)
+                    except OSError:
+                        return
+
+        class Server(socketserver.ThreadingTCPServer):
+            daemon_threads = True
+            allow_reuse_address = True
+
+        self._server = Server((host, port), Handler)
+        self._thread: Optional[threading.Thread] = None
+
+    def _dispatch(self, req: Dict[str, Any]) -> Dict[str, Any]:
+        rid = req.get("id", 0)
+        try:
+            if self._token is not None and req.get("token") != self._token:
+                raise AuthError("invalid or missing auth token")
+            method = str(req.get("method", "")).replace(".", "__")
+            if method.startswith("_"):
+                raise RpcError(f"no such method: {req.get('method')}")
+            fn = getattr(self._service, method, None)
+            if fn is None or not callable(fn):
+                raise RpcError(f"no such method: {req.get('method')}")
+            result = fn(**(req.get("args") or {}))
+            return {"id": rid, "ok": True, "result": result}
+        except Exception as e:  # noqa: BLE001 — must never kill the server loop
+            if not isinstance(e, RpcError):
+                log.exception("rpc handler error in %s", req.get("method"))
+            return {"id": rid, "ok": False, "error": f"{type(e).__name__}: {e}"}
+
+    @property
+    def address(self) -> Tuple[str, int]:
+        return self._server.server_address  # type: ignore[return-value]
+
+    @property
+    def port(self) -> int:
+        return self.address[1]
+
+    def start(self) -> None:
+        self._thread = threading.Thread(
+            target=self._server.serve_forever, name="tony-rpc-server",
+            daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._server.shutdown()
+        self._server.server_close()
+        if self._thread:
+            self._thread.join(timeout=5)
+
+
+class RpcClient:
+    """Persistent-connection client with bounded reconnect retries.
+
+    Reference retry policy: up to 10 attempts, 2 s fixed sleep
+    (``ApplicationRpcClient.java:66-76``); configurable here because tests
+    want fast failure.
+    """
+
+    def __init__(self, host: str, port: int, token: Optional[str] = None,
+                 max_retries: int = 10, retry_sleep_s: float = 2.0,
+                 connect_timeout_s: float = 10.0):
+        self._addr = (host, port)
+        self._token = token
+        self._max_retries = max_retries
+        self._retry_sleep_s = retry_sleep_s
+        self._connect_timeout_s = connect_timeout_s
+        self._sock: Optional[socket.socket] = None
+        self._id = 0
+        self._lock = threading.Lock()
+
+    def _connect(self) -> socket.socket:
+        sock = socket.create_connection(self._addr,
+                                        timeout=self._connect_timeout_s)
+        sock.settimeout(None)
+        sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        return sock
+
+    def call(self, method: str, **args: Any) -> Any:
+        last_err: Optional[Exception] = None
+        with self._lock:
+            for attempt in range(self._max_retries):
+                try:
+                    if self._sock is None:
+                        self._sock = self._connect()
+                    self._id += 1
+                    req = {"id": self._id, "method": method, "args": args}
+                    if self._token is not None:
+                        req["token"] = self._token
+                    _send_frame(self._sock, req)
+                    resp = _recv_frame(self._sock)
+                    if not resp.get("ok"):
+                        err = resp.get("error", "unknown rpc error")
+                        if err.startswith("AuthError"):
+                            raise AuthError(err)
+                        raise RpcError(err)
+                    return resp.get("result")
+                except (ConnectionError, OSError) as e:
+                    last_err = e
+                    self._close_locked()
+                    if attempt < self._max_retries - 1:
+                        time.sleep(self._retry_sleep_s)
+        raise RpcError(
+            f"rpc {method} to {self._addr} failed after "
+            f"{self._max_retries} attempts: {last_err}")
+
+    def _close_locked(self) -> None:
+        if self._sock is not None:
+            try:
+                self._sock.close()
+            except OSError:
+                pass
+            self._sock = None
+
+    def close(self) -> None:
+        with self._lock:
+            self._close_locked()

@@ -1,0 +1,1 @@
+from tony_tpu.runtimes.base import Runtime, TaskIdentity, get_runtime  # noqa: F401

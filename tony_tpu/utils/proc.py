@@ -1,0 +1,65 @@
+"""Process execution helpers (reference ``Utils.executeShell`` :294-323)."""
+
+from __future__ import annotations
+
+import logging
+import os
+import shlex
+import signal
+import subprocess
+import time
+from typing import Callable, Dict, Optional
+
+log = logging.getLogger(__name__)
+
+
+def execute_shell(command: str, timeout_s: float = 0,
+                  env: Optional[Dict[str, str]] = None,
+                  cwd: Optional[str] = None,
+                  on_start: Optional[Callable[[subprocess.Popen], None]] = None,
+                  ) -> int:
+    """Run a shell command, inheriting stdout/stderr (container logs pattern,
+    ``ApplicationMaster.java:1145-1147``). Returns the exit code; a timeout
+    kills the whole process group and returns 137.
+
+    The reference unsets MALLOC_ARENA_MAX before exec (``Utils.java:312``) —
+    a YARN-ism we do not need; we instead leave JAX/XLA env untouched so
+    the user process sees exactly what the runtime exported.
+    """
+    full_env = dict(os.environ)
+    if env:
+        full_env.update(env)
+    log.info("executing: %s", command)
+    proc = subprocess.Popen(
+        ["/bin/sh", "-c", command], env=full_env, cwd=cwd,
+        start_new_session=True)
+    if on_start:
+        on_start(proc)
+    try:
+        return proc.wait(timeout=timeout_s or None)
+    except subprocess.TimeoutExpired:
+        log.error("command timed out after %ss; killing process group",
+                  timeout_s)
+        try:
+            os.killpg(proc.pid, signal.SIGTERM)
+            time.sleep(1)
+            os.killpg(proc.pid, signal.SIGKILL)
+        except ProcessLookupError:
+            pass
+        proc.wait()
+        return 137
+
+
+def poll_till_non_null(fn: Callable[[], Optional[object]],
+                       interval_s: float = 3.0,
+                       timeout_s: float = 0) -> Optional[object]:
+    """Reference ``Utils.pollTillNonNull`` :91-145 — the executor's
+    registration barrier poll."""
+    deadline = time.monotonic() + timeout_s if timeout_s else None
+    while True:
+        result = fn()
+        if result is not None:
+            return result
+        if deadline and time.monotonic() > deadline:
+            return None
+        time.sleep(interval_s)
