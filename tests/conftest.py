@@ -14,5 +14,13 @@ if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8").strip()
 
+# Some images pre-import jax via sitecustomize and pin jax_platforms to the
+# real accelerator; the env var above is then too late. Override at the
+# config level as well (backends are initialized lazily, so XLA_FLAGS still
+# applies as long as no jax computation ran at site time).
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
 # Make `import tony_tpu` work no matter where pytest is invoked from.
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))

@@ -186,8 +186,10 @@ TPU_TOPOLOGY = _key(
     "locally visible devices. The mesh builder consumes this (SURVEY.md §7.7).")
 TPU_MESH_SHAPE = _key(
     "tony.tpu.mesh-shape", "", str,
-    "Logical mesh axes as 'name:size,name:size', e.g. "
-    "'data:4,model:2'. Empty = 1-D data mesh over all devices.")
+    "Logical mesh axes as 'name=size,name=size' over the canonical axes "
+    "dp/fsdp/pp/ep/sp/tp (tony_tpu.parallel.MeshSpec.from_string), e.g. "
+    "'fsdp=4,tp=2'. One size may be -1 (inferred). Empty = pure-dp mesh "
+    "over all devices.")
 
 # --- portal ---------------------------------------------------------------
 PORTAL_PORT = _key(

@@ -1,0 +1,20 @@
+"""Parallelism library: device meshes, sharding rules, distributed transforms.
+
+This subsystem is **new work relative to the reference**: TonY has no
+tensor/pipeline/sequence/expert parallelism anywhere (verified in SURVEY.md
+§2.3 — the reference only orchestrates process gangs and delegates all
+sharding to the user's ML framework). In a TPU-native design the framework
+owns the device mesh and the sharding of every tensor, because the data plane
+(XLA collectives over ICI/DCN) and the orchestration plane meet in the same
+compiled program.
+"""
+
+from tony_tpu.parallel.mesh import (  # noqa: F401
+    MESH_AXES, MeshSpec, batch_sharding, build_mesh, replicated_sharding,
+)
+from tony_tpu.parallel.sharding import (  # noqa: F401
+    DEFAULT_RULES, logical_sharding, param_shardings, with_rules,
+)
+from tony_tpu.parallel.train import (  # noqa: F401
+    TrainState, init_sharded_state, jit_train_step,
+)

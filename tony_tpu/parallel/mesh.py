@@ -1,0 +1,120 @@
+"""Device-mesh construction over TPU ICI/DCN topology.
+
+The reference framework's unit of placement is the YARN container matched to a
+task by priority (``TonySession.java:208``); tensors never cross its mind. Here
+the unit of placement is a **mesh axis**: every parallelism strategy is a named
+axis of a `jax.sharding.Mesh`, and XLA inserts the collectives (psum /
+all_gather / reduce_scatter / ppermute) that ride ICI within a slice and DCN
+across slices.
+
+Axis order encodes the physical hierarchy (scaling-book recipe): the outermost
+axes change slowest across the device array, so we put DCN-friendly,
+low-traffic axes (``dp``, then ``pp``) outermost and bandwidth-hungry axes
+(``tp``) innermost where neighbours share ICI links.
+
+Axes:
+    dp    pure data parallelism (gradient psum only — cheapest, DCN-safe)
+    fsdp  data parallelism with sharded params/optimizer (all_gather weights)
+    pp    pipeline stages (point-to-point ppermute between neighbours)
+    ep    expert parallelism for MoE (all_to_all dispatch)
+    sp    sequence/context parallelism (ring ppermute / all_to_all)
+    tp    tensor parallelism (activation all_reduce every layer — ICI only)
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+# Outermost (slow, DCN-tolerant) → innermost (fast, wants ICI neighbours).
+MESH_AXES = ("dp", "fsdp", "pp", "ep", "sp", "tp")
+
+
+@dataclasses.dataclass(frozen=True)
+class MeshSpec:
+    """Sizes for each mesh axis. At most one axis may be -1 (inferred so the
+    product equals the device count). Unused axes stay 1 — they are kept in
+    the mesh so sharding rules are uniform across strategies."""
+
+    dp: int = -1
+    fsdp: int = 1
+    pp: int = 1
+    ep: int = 1
+    sp: int = 1
+    tp: int = 1
+
+    def sizes(self) -> Sequence[int]:
+        return tuple(getattr(self, a) for a in MESH_AXES)
+
+    def resolve(self, n_devices: int) -> "MeshSpec":
+        sizes = list(self.sizes())
+        bad = [s for s in sizes if s < 1 and s != -1]
+        if bad:
+            raise ValueError(
+                f"axis sizes must be positive or -1 (inferred), got {self}")
+        unknown = [i for i, s in enumerate(sizes) if s == -1]
+        if len(unknown) > 1:
+            raise ValueError(f"at most one axis may be -1, got {self}")
+        known = math.prod(s for s in sizes if s != -1)
+        if unknown:
+            if n_devices % known:
+                raise ValueError(
+                    f"{n_devices} devices not divisible by fixed axes "
+                    f"product {known} in {self}")
+            sizes[unknown[0]] = n_devices // known
+        elif known != n_devices:
+            raise ValueError(
+                f"mesh {self} wants {known} devices, have {n_devices}")
+        return MeshSpec(**dict(zip(MESH_AXES, sizes)))
+
+    @classmethod
+    def from_string(cls, s: str) -> "MeshSpec":
+        """Parse ``"dp=2,tp=4"`` — the config-file form
+        (key ``tony.tpu.mesh-shape``, see ``conf/keys.py``)."""
+        kwargs = {}
+        for part in filter(None, (p.strip() for p in s.split(","))):
+            k, sep, v = part.partition("=")
+            if k not in MESH_AXES:
+                raise ValueError(f"unknown mesh axis {k!r} (not in "
+                                 f"{MESH_AXES})")
+            if not sep or not v.lstrip("-").isdigit():
+                raise ValueError(
+                    f"expected axis=size in {part!r} (e.g. 'tp=4')")
+            kwargs[k] = int(v)
+        if "dp" not in kwargs:
+            kwargs["dp"] = -1
+        return cls(**kwargs)
+
+
+def build_mesh(spec: Optional[MeshSpec] = None,
+               devices: Optional[Sequence[jax.Device]] = None) -> Mesh:
+    """Build a Mesh whose axis layout respects physical topology.
+
+    On real TPU slices `mesh_utils.create_device_mesh` maps axes onto the
+    torus so innermost axes land on ICI neighbours; on a host-platform
+    (CPU test) mesh the devices are virtual and a plain reshape suffices.
+    """
+    devices = list(devices if devices is not None else jax.devices())
+    spec = (spec or MeshSpec()).resolve(len(devices))
+    sizes = spec.sizes()
+    if devices[0].platform == "tpu":
+        from jax.experimental import mesh_utils
+        dev_array = mesh_utils.create_device_mesh(sizes, devices=devices)
+    else:
+        dev_array = np.asarray(devices).reshape(sizes)
+    return Mesh(dev_array, MESH_AXES)
+
+
+def batch_sharding(mesh: Mesh, extra_dims: int = 1) -> NamedSharding:
+    """Sharding for a [batch, ...] input: batch split over every
+    data-parallel-ish axis (dp and fsdp both consume batch)."""
+    return NamedSharding(mesh, P(("dp", "fsdp"), *([None] * extra_dims)))
+
+
+def replicated_sharding(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())

@@ -1,0 +1,118 @@
+"""Sharded training-state construction and jit'd train steps.
+
+Everything here compiles to ONE XLA program per step: forward, backward,
+optimizer update, and every collective (gradient psum over dp/fsdp, weight
+all_gathers for FSDP, activation all_reduces for TP) — traced once, fused by
+XLA, no Python in the hot loop. This replaces the reference's entire "data
+plane is someone else's problem" stance (SURVEY.md §2.4).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional, Sequence, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import optax
+from flax import struct
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from tony_tpu.parallel.mesh import batch_sharding
+from tony_tpu.parallel.sharding import DEFAULT_RULES, param_shardings
+
+
+@struct.dataclass
+class TrainState:
+    """Minimal train state (flax train_state analogue, kept dependency-light
+    so checkpointing sees a plain pytree)."""
+    step: jax.Array
+    params: Any
+    opt_state: Any
+    tx: optax.GradientTransformation = struct.field(pytree_node=False)
+
+    def apply_gradients(self, grads: Any) -> "TrainState":
+        updates, new_opt = self.tx.update(grads, self.opt_state, self.params)
+        return self.replace(step=self.step + 1,
+                            params=optax.apply_updates(self.params, updates),
+                            opt_state=new_opt)
+
+
+def init_sharded_state(
+    model: nn.Module,
+    sample_batch: Any,
+    tx: optax.GradientTransformation,
+    mesh: Mesh,
+    rng: Optional[jax.Array] = None,
+    rules: Sequence[Tuple[str, Any]] = DEFAULT_RULES,
+) -> Tuple[TrainState, TrainState]:
+    """Initialize params *already sharded*: eval_shape under logical rules →
+    compute NamedShardings → jit init with out_shardings so no device ever
+    materializes the full model (essential at 8B+ params).
+
+    Returns ``(state, state_shardings)``; the latter mirrors the state tree
+    with a NamedSharding at every leaf (optimizer-slot shardings come from
+    XLA's sharding propagation through ``tx.init`` on sharded params).
+    """
+    rng = rng if rng is not None else jax.random.key(0)
+
+    def boxed_init(rng):
+        # Params stay wrapped in LogicallyPartitioned metadata boxes here, so
+        # tx.init's tree_maps produce *boxed optimizer slots* too — the slots
+        # inherit each param's logical axes and therefore its sharding.
+        params = model.init(rng, sample_batch)["params"]
+        return TrainState(step=jnp.zeros((), jnp.int32), params=params,
+                          opt_state=tx.init(params), tx=tx)
+
+    with nn.logical_axis_rules(list(rules)):
+        abstract = jax.eval_shape(boxed_init, rng)
+    state_sh = param_shardings(mesh, abstract, rules)
+
+    def init_fn(rng):
+        return nn.meta.unbox(boxed_init(rng))
+
+    with jax.set_mesh(mesh), nn.logical_axis_rules(list(rules)):
+        state = jax.jit(init_fn, out_shardings=state_sh)(rng)
+    return state, state_sh
+
+
+def jit_train_step(
+    loss_fn: Callable[[Any, Any, jax.Array], Tuple[jax.Array, dict]],
+    mesh: Mesh,
+    state_shardings: TrainState,
+    sample_batch: Any,
+    rules: Sequence[Tuple[str, Any]] = DEFAULT_RULES,
+    donate: bool = True,
+):
+    """Build the canonical step function. ``loss_fn(params, batch, rng)``
+    must be pure/jit-safe and return ``(loss, aux_metrics)``.
+
+    Returns ``step(state, batch, rng) -> (state, metrics)`` compiled with
+    explicit in/out shardings: batch sharded over (dp, fsdp) on dim 0, state
+    per ``state_shardings`` — XLA derives every collective from there.
+    """
+    def step(state: TrainState, batch: Any, rng: jax.Array):
+        with nn.logical_axis_rules(list(rules)):
+            (loss, aux), grads = jax.value_and_grad(
+                loss_fn, has_aux=True)(state.params, batch, rng)
+        new_state = state.apply_gradients(grads)
+        metrics = {"loss": loss, "step": new_state.step, **aux}
+        return new_state, metrics
+
+    # Scalar (0-d) leaves can't carry a batch dim — replicate those.
+    batch_sh = jax.tree.map(
+        lambda leaf: (batch_sharding(mesh, extra_dims=jnp.ndim(leaf) - 1)
+                      if jnp.ndim(leaf) > 0
+                      else NamedSharding(mesh, P())),
+        sample_batch)
+    jitted = jax.jit(
+        step,
+        in_shardings=(state_shardings, batch_sh, NamedSharding(mesh, P())),
+        out_shardings=(state_shardings, NamedSharding(mesh, P())),
+        donate_argnums=(0,) if donate else ())
+
+    def wrapped(state, batch, rng):
+        with jax.set_mesh(mesh):
+            return jitted(state, batch, rng)
+
+    return wrapped
