@@ -1,0 +1,17 @@
+"""Hot-path TPU ops: Pallas kernels + distributed attention patterns.
+
+New work relative to the reference (SURVEY.md §2.3/§5 "long-context —
+absent"): TonY never touches a tensor; here the framework owns the flash /
+ring / Ulysses attention paths that make long-context training possible on
+TPU slices.
+"""
+
+from tony_tpu.ops.attention import (  # noqa: F401
+    flash_attention, reference_attention,
+)
+from tony_tpu.ops.ring import (  # noqa: F401
+    ring_attention, ring_attention_sharded,
+)
+from tony_tpu.ops.ulysses import (  # noqa: F401
+    ulysses_attention, ulysses_attention_sharded,
+)

@@ -1,0 +1,381 @@
+"""Blockwise (flash) attention as Pallas TPU kernels.
+
+Memory-bound attention is the canonical HBM-bandwidth problem
+(pallas_guide.md): materializing the [S, S] score matrix is O(S²) HBM
+traffic, while the blockwise online-softmax formulation streams K/V tiles
+through VMEM and keeps the running (max, sum, acc) state on-chip, so HBM
+traffic stays O(S·D). Forward and backward are custom kernels under a
+``jax.custom_vjp``; the forward saves only O and the row logsumexp L.
+
+Public layout is ``[batch, seq, heads, head_dim]`` (the layout the models
+use); kernels run per (batch·head) slice. On non-TPU backends the kernels
+run in Pallas interpret mode so the exact same code path is unit-tested on
+the virtual CPU mesh (SURVEY.md §4 test strategy).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+NEG_INF = -1e30
+LANES = 128  # TPU lane width; per-row stats are stored lane-broadcast
+
+
+def _load2d(ref, block_idx, block_rows, seq):
+    """Load a [1, block, d] block as f32 with out-of-range rows zeroed.
+    Pallas pads partial edge blocks with undefined memory (NaN in interpret
+    mode); a zero row is inert in every matmul below, undefined is not."""
+    x = ref[0].astype(jnp.float32)
+    rows = block_idx * block_rows + jax.lax.broadcasted_iota(
+        jnp.int32, x.shape, 0)
+    return jnp.where(rows < seq, x, 0.0)
+
+
+def _load1d(ref, block_idx, block_rows, seq):
+    """Load a per-row statistic stored as [1, block, LANES] (all lanes
+    identical — see _finalize) and return the [block] vector, zero past
+    ``seq``."""
+    x = ref[0][:, 0]
+    rows = block_idx * block_rows + jax.lax.iota(jnp.int32, x.shape[0])
+    return jnp.where(rows < seq, x, 0.0)
+
+
+def _mask_scores(s, qi, kj, block_q, block_k, causal, seq_q, seq_k):
+    """Mask invalid scores: keys/queries past the true sequence ends (grid
+    padding when seq % block != 0) and, for causal, keys after the query.
+    Padded-q rows are masked too so backward passes can't scatter garbage
+    into dk/dv (forward writes of padded rows are dropped by pallas)."""
+    rows = qi * block_q + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
+    cols = kj * block_k + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+    valid = (cols < seq_k) & (rows < seq_q)
+    if causal:
+        valid = valid & (rows >= cols)
+    return jnp.where(valid, s, NEG_INF), valid
+
+
+def reference_attention(q: jax.Array, k: jax.Array, v: jax.Array,
+                        causal: bool = True,
+                        scale: Optional[float] = None) -> jax.Array:
+    """Plain XLA attention ([B,S,H,D] layout) — the correctness oracle."""
+    scale = scale if scale is not None else q.shape[-1] ** -0.5
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) * scale
+    if causal:
+        sq, sk = q.shape[1], k.shape[1]
+        mask = jnp.tril(jnp.ones((sq, sk), bool), k=sk - sq)
+        s = jnp.where(mask, s, NEG_INF)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bhqk,bkhd->bqhd", p.astype(v.dtype), v)
+
+
+# ---------------------------------------------------------------------------
+# Forward kernel
+# ---------------------------------------------------------------------------
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
+                *, scale: float, causal: bool, block_q: int, block_k: int,
+                num_k_blocks: int, seq_q: int, seq_k: int):
+    qi = pl.program_id(1)
+    kj = pl.program_id(2)
+
+    @pl.when(kj == 0)
+    def _init():
+        m_scr[:] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[:] = jnp.zeros_like(l_scr)
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    # Causal: skip fully-masked tiles (k strictly after the q tile's end).
+    run = True
+    if causal:
+        run = kj * block_k <= qi * block_q + block_q - 1
+
+    @pl.when(run)
+    def _compute():
+        q = _load2d(q_ref, qi, block_q, seq_q)    # [block_q, d]
+        k = _load2d(k_ref, kj, block_k, seq_k)    # [block_k, d]
+        v = _load2d(v_ref, kj, block_k, seq_k)    # [block_k, d]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale  # [block_q, block_k]
+        s, _ = _mask_scores(s, qi, kj, block_q, block_k, causal, seq_q,
+                            seq_k)
+        m_prev = m_scr[:]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=1))
+        alpha = jnp.exp(m_prev - m_new)
+        p = jnp.exp(s - m_new[:, None])
+        l_scr[:] = l_scr[:] * alpha + jnp.sum(p, axis=1)
+        acc_scr[:] = acc_scr[:] * alpha[:, None] + jax.lax.dot(
+            p, v, preferred_element_type=jnp.float32)
+        m_scr[:] = m_new
+
+    @pl.when(kj == num_k_blocks - 1)
+    def _finalize():
+        l = jnp.maximum(l_scr[:], 1e-30)
+        o_ref[0] = (acc_scr[:] / l[:, None]).astype(o_ref.dtype)
+        # lse is [block_q, LANES] with identical lanes: Mosaic needs the
+        # last two block dims tileable (8x128), so a 1-D [block_q] output
+        # does not lower — same trick as jax's own TPU flash kernel.
+        lse_ref[0] = jnp.broadcast_to((m_scr[:] + jnp.log(l))[:, None],
+                                      lse_ref.shape[1:])
+
+
+# ---------------------------------------------------------------------------
+# Backward kernels (standard flash backward, two passes)
+# ---------------------------------------------------------------------------
+def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
+                   acc_scr, *, scale: float, causal: bool, block_q: int,
+                   block_k: int, num_k_blocks: int, seq_q: int, seq_k: int):
+    qi = pl.program_id(1)
+    kj = pl.program_id(2)
+
+    @pl.when(kj == 0)
+    def _init():
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    run = True
+    if causal:
+        run = kj * block_k <= qi * block_q + block_q - 1
+
+    @pl.when(run)
+    def _compute():
+        q = _load2d(q_ref, qi, block_q, seq_q)
+        k = _load2d(k_ref, kj, block_k, seq_k)
+        v = _load2d(v_ref, kj, block_k, seq_k)
+        do = _load2d(do_ref, qi, block_q, seq_q)
+        lse = _load1d(lse_ref, qi, block_q, seq_q)
+        delta = _load1d(delta_ref, qi, block_q, seq_q)
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale
+        s, valid = _mask_scores(s, qi, kj, block_q, block_k, causal, seq_q,
+                                seq_k)
+        # Explicit zero (not just -inf scores): padded lse/do reads are
+        # undefined memory on TPU, so exp(s - lse) can't be trusted there.
+        p = jnp.where(valid, jnp.exp(s - lse[:, None]), 0.0)  # [bq, bk]
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)            # [bq, bk]
+        ds = p * (dp - delta[:, None]) * scale
+        acc_scr[:] += jax.lax.dot(ds, k,
+                                  preferred_element_type=jnp.float32)
+
+    @pl.when(kj == num_k_blocks - 1)
+    def _finalize():
+        dq_ref[0] = acc_scr[:].astype(dq_ref.dtype)
+
+
+def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                    dk_ref, dv_ref, dk_scr, dv_scr, *, scale: float,
+                    causal: bool, block_q: int, block_k: int,
+                    num_q_blocks: int, seq_q: int, seq_k: int):
+    kj = pl.program_id(1)
+    qi = pl.program_id(2)
+
+    @pl.when(qi == 0)
+    def _init():
+        dk_scr[:] = jnp.zeros_like(dk_scr)
+        dv_scr[:] = jnp.zeros_like(dv_scr)
+
+    run = True
+    if causal:
+        # q tiles strictly before the k tile's start contribute nothing.
+        run = qi * block_q + block_q - 1 >= kj * block_k
+
+    @pl.when(run)
+    def _compute():
+        q = _load2d(q_ref, qi, block_q, seq_q)
+        k = _load2d(k_ref, kj, block_k, seq_k)
+        v = _load2d(v_ref, kj, block_k, seq_k)
+        do = _load2d(do_ref, qi, block_q, seq_q)
+        lse = _load1d(lse_ref, qi, block_q, seq_q)
+        delta = _load1d(delta_ref, qi, block_q, seq_q)
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale
+        s, valid = _mask_scores(s, qi, kj, block_q, block_k, causal, seq_q,
+                                seq_k)
+        p = jnp.where(valid, jnp.exp(s - lse[:, None]), 0.0)  # [bq, bk]
+        dv_scr[:] += jax.lax.dot_general(
+            p, do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)            # [bk, d]
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        ds = p * (dp - delta[:, None]) * scale
+        dk_scr[:] += jax.lax.dot_general(
+            ds, q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)            # [bk, d]
+
+    @pl.when(qi == num_q_blocks - 1)
+    def _finalize():
+        dk_ref[0] = dk_scr[:].astype(dk_ref.dtype)
+        dv_ref[0] = dv_scr[:].astype(dv_ref.dtype)
+
+
+# ---------------------------------------------------------------------------
+# pallas_call plumbing
+# ---------------------------------------------------------------------------
+def _interpret() -> bool:
+    return jax.default_backend() != "tpu"
+
+
+def _round_up(x: int, m: int) -> int:
+    """Blocks must honour TPU sublane tiling (8 f32 / 16 bf16 rows);
+    a block clamped to a ragged seq length would not lower."""
+    return -(-x // m) * m
+
+
+def _fwd_impl(q, k, v, scale, causal, block_q, block_k):
+    bh, sq, d = q.shape
+    sk = k.shape[1]
+    block_q = min(block_q, _round_up(sq, 16))
+    block_k = min(block_k, _round_up(sk, 16))
+    nq = pl.cdiv(sq, block_q)
+    nk = pl.cdiv(sk, block_k)
+    from jax.experimental.pallas import tpu as pltpu
+    kernel = functools.partial(
+        _fwd_kernel, scale=scale, causal=causal, block_q=block_q,
+        block_k=block_k, num_k_blocks=nk, seq_q=sq, seq_k=sk)
+    o, lse = pl.pallas_call(
+        kernel,
+        grid=(bh, nq, nk),
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_q, LANES), lambda b, i, j: (b, i, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, sq, d), q.dtype),
+            jax.ShapeDtypeStruct((bh, sq, LANES), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_q,), jnp.float32),
+            pltpu.VMEM((block_q,), jnp.float32),
+            pltpu.VMEM((block_q, d), jnp.float32),
+        ],
+        interpret=_interpret(),
+    )(q, k, v)
+    return o, lse
+
+
+def _bwd_impl(q, k, v, o, lse, do, scale, causal, block_q, block_k):
+    bh, sq, d = q.shape
+    sk = k.shape[1]
+    block_q = min(block_q, _round_up(sq, 16))
+    block_k = min(block_k, _round_up(sk, 16))
+    nq = pl.cdiv(sq, block_q)
+    nk = pl.cdiv(sk, block_k)
+    from jax.experimental.pallas import tpu as pltpu
+    delta = jnp.broadcast_to(
+        jnp.sum(o.astype(jnp.float32) * do.astype(jnp.float32),
+                axis=-1)[:, :, None],
+        (bh, sq, LANES))                     # lane-broadcast like lse
+    dq = pl.pallas_call(
+        functools.partial(_bwd_dq_kernel, scale=scale, causal=causal,
+                          block_q=block_q, block_k=block_k, num_k_blocks=nk,
+                          seq_q=sq, seq_k=sk),
+        grid=(bh, nq, nk),
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_q, LANES), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_q, LANES), lambda b, i, j: (b, i, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((bh, sq, d), q.dtype),
+        scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
+        interpret=_interpret(),
+    )(q, k, v, do, lse, delta)
+    dk, dv = pl.pallas_call(
+        functools.partial(_bwd_dkv_kernel, scale=scale, causal=causal,
+                          block_q=block_q, block_k=block_k, num_q_blocks=nq,
+                          seq_q=sq, seq_k=sk),
+        grid=(bh, nk, nq),
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda b, j, i: (b, i, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0)),
+            pl.BlockSpec((1, block_q, d), lambda b, j, i: (b, i, 0)),
+            pl.BlockSpec((1, block_q, LANES), lambda b, j, i: (b, i, 0)),
+            pl.BlockSpec((1, block_q, LANES), lambda b, j, i: (b, i, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, sk, d), k.dtype),
+            jax.ShapeDtypeStruct((bh, sk, d), v.dtype),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_k, d), jnp.float32),
+            pltpu.VMEM((block_k, d), jnp.float32),
+        ],
+        interpret=_interpret(),
+    )(q, k, v, do, lse, delta)
+    return dq, dk, dv
+
+
+# ---------------------------------------------------------------------------
+# Public API with custom VJP
+# ---------------------------------------------------------------------------
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def _flash(q, k, v, scale, causal, block_q, block_k):
+    o, _ = _fwd_impl(q, k, v, scale, causal, block_q, block_k)
+    return o
+
+
+def _flash_fwd(q, k, v, scale, causal, block_q, block_k):
+    o, lse = _fwd_impl(q, k, v, scale, causal, block_q, block_k)
+    return o, (q, k, v, o, lse)
+
+
+def _flash_bwd(scale, causal, block_q, block_k, res, do):
+    q, k, v, o, lse = res
+    return _bwd_impl(q, k, v, o, lse, do, scale, causal, block_q, block_k)
+
+
+_flash.defvjp(_flash_fwd, _flash_bwd)
+
+
+def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
+                    causal: bool = True, scale: Optional[float] = None,
+                    block_q: int = 128, block_k: int = 128) -> jax.Array:
+    """Flash attention, layout ``[B, S, H, D]`` (GQA: H_kv may divide H).
+
+    Differentiable (custom flash backward); numerics in f32 accumulation
+    regardless of input dtype (bf16 in, bf16 out, f32 on-chip).
+    """
+    b, sq, h, d = q.shape
+    hk = k.shape[2]
+    if causal and sq != k.shape[1]:
+        raise ValueError(
+            f"causal flash attention requires seq_q == seq_k, got {sq} vs "
+            f"{k.shape[1]} (the kernel's mask is top-left aligned; for "
+            f"decode-style offsets use ring attention or causal=False with "
+            f"an explicit mask)")
+    if k.shape[2] != v.shape[2]:
+        raise ValueError(f"k heads ({k.shape[2]}) != v heads "
+                         f"({v.shape[2]})")
+    if h != hk:
+        if h % hk:
+            raise ValueError(f"q heads {h} not a multiple of kv heads {hk}")
+        k = jnp.repeat(k, h // hk, axis=2)
+        v = jnp.repeat(v, h // hk, axis=2)
+    sk = k.shape[1]
+    scale = scale if scale is not None else d ** -0.5
+    # [B,S,H,D] → [B·H, S, D]
+    qf = q.transpose(0, 2, 1, 3).reshape(b * h, sq, d)
+    kf = k.transpose(0, 2, 1, 3).reshape(b * h, sk, d)
+    vf = v.transpose(0, 2, 1, 3).reshape(b * h, sk, d)
+    of = _flash(qf, kf, vf, scale, causal, block_q, block_k)
+    return of.reshape(b, h, sq, d).transpose(0, 2, 1, 3)

@@ -1,0 +1,86 @@
+"""Ring attention: exact attention over a sequence sharded on the ``sp`` axis.
+
+Long-context support is absent from the reference (SURVEY.md §5 "long-context
+— absent"); here it is first-class. Each device holds a [B, S/n, H, D] shard
+of Q/K/V. K/V chunks rotate around the ``sp`` ring via ``ppermute`` (nearest-
+neighbour ICI traffic only) while each device accumulates its Q shard's
+online-softmax state — after n steps every Q block has seen every K/V block
+and the K/V shards are back home. Compute at step i overlaps the transfer for
+step i+1 (XLA schedules the ppermute DMA asynchronously with the einsums).
+
+`ring_attention` is the *per-shard* function, for use inside `shard_map`
+(this is how model code composes it with other sharded ops);
+`ring_attention_sharded` wraps it for global arrays.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+from jax import shard_map
+
+NEG_INF = -1e30
+
+
+def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array,
+                   axis_name: str = "sp", causal: bool = True,
+                   scale: Optional[float] = None) -> jax.Array:
+    """Per-shard ring attention ([B, S_local, H, D] in/out). Call inside
+    shard_map with the sequence dim sharded over ``axis_name``."""
+    b, s_loc, h, d = q.shape
+    n = jax.lax.psum(1, axis_name)
+    my = jax.lax.axis_index(axis_name)
+    scale = scale if scale is not None else d ** -0.5
+    perm = [(j, (j + 1) % n) for j in range(n)]
+
+    q_f = q.astype(jnp.float32).transpose(0, 2, 1, 3)      # [B,H,Sq,D]
+
+    def step(carry, i):
+        k_c, v_c, m, l, acc = carry
+        # After i forward rotations we hold the chunk originally on (my - i).
+        kv_idx = (my - i) % n
+        s = jax.lax.dot_general(
+            q_f, k_c.astype(jnp.float32).transpose(0, 2, 1, 3),
+            (((3,), (3,)), ((0, 1), (0, 1))),
+            preferred_element_type=jnp.float32) * scale     # [B,H,Sq,Sk]
+        if causal:
+            rows = my * s_loc + jax.lax.broadcasted_iota(
+                jnp.int32, s.shape, 2)
+            cols = kv_idx * s_loc + jax.lax.broadcasted_iota(
+                jnp.int32, s.shape, 3)
+            s = jnp.where(rows >= cols, s, NEG_INF)
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1))
+        alpha = jnp.exp(m - m_new)
+        p = jnp.exp(s - m_new[..., None])
+        l_new = l * alpha + jnp.sum(p, axis=-1)
+        acc_new = acc * alpha[..., None] + jax.lax.dot_general(
+            p, v_c.astype(jnp.float32).transpose(0, 2, 1, 3),
+            (((3,), (2,)), ((0, 1), (0, 1))),
+            preferred_element_type=jnp.float32)             # [B,H,Sq,D]
+        k_c, v_c = jax.lax.ppermute((k_c, v_c), axis_name, perm)
+        return (k_c, v_c, m_new, l_new, acc_new), None
+
+    m0 = jnp.full((b, h, s_loc), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((b, h, s_loc), jnp.float32)
+    acc0 = jnp.zeros((b, h, s_loc, d), jnp.float32)
+    (_, _, _, l, acc), _ = jax.lax.scan(
+        step, (k, v, m0, l0, acc0), jnp.arange(n))
+    out = acc / jnp.maximum(l, 1e-30)[..., None]
+    return out.transpose(0, 2, 1, 3).astype(q.dtype)
+
+
+def ring_attention_sharded(mesh: Mesh, q: jax.Array, k: jax.Array,
+                           v: jax.Array, causal: bool = True,
+                           scale: Optional[float] = None,
+                           axis_name: str = "sp") -> jax.Array:
+    """Global-array wrapper: [B, S, H, D] with S sharded over ``axis_name``,
+    batch over (dp, fsdp), heads replicated along sp."""
+    spec = P(("dp", "fsdp"), axis_name, None, None)
+    fn = functools.partial(ring_attention, axis_name=axis_name,
+                           causal=causal, scale=scale)
+    return shard_map(fn, mesh=mesh, in_specs=(spec, spec, spec),
+                     out_specs=spec, check_vma=False)(q, k, v)
