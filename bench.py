@@ -1,0 +1,116 @@
+"""Benchmark: flagship transformer training throughput on one TPU chip.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+The reference repo publishes no performance numbers (SURVEY.md §6 — verified
+absence), so this bench ESTABLISHES the baseline; vs_baseline is reported
+against the first recorded value in BENCH_BASELINE.json if present, else 1.0.
+"""
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+import jax  # noqa: E402
+
+if os.environ.get("JAX_PLATFORMS", "").strip().lower() == "cpu":
+    jax.config.update("jax_platforms", "cpu")
+
+import jax.numpy as jnp  # noqa: E402
+import optax  # noqa: E402
+
+
+def main():
+    on_tpu = jax.default_backend() == "tpu"
+    from tony_tpu.models import Transformer, TransformerConfig
+    from tony_tpu.models.transformer import causal_lm_loss
+    from tony_tpu.parallel import (MeshSpec, build_mesh, init_sharded_state,
+                                   jit_train_step)
+
+    if on_tpu:
+        # ~300M-param model, bf16 activations, remat — sized for one chip.
+        cfg = TransformerConfig(
+            vocab_size=32000, dim=1024, n_layers=16, n_heads=16,
+            n_kv_heads=8, mlp_dim=4096, max_seq_len=2048, remat=True)
+        batch, seq, steps = 4, 2048, 10
+    else:
+        cfg = TransformerConfig.tiny()
+        batch, seq, steps = 4, 64, 3
+
+    import functools
+
+    import flax.linen as nn
+
+    from tony_tpu.parallel.sharding import DEFAULT_RULES
+
+    mesh = build_mesh(MeshSpec())  # dp over whatever is visible (1 real chip)
+    model = Transformer(cfg)
+    tokens = jax.random.randint(jax.random.key(0), (batch, seq), 0,
+                                cfg.vocab_size)
+
+    state, state_sh = init_sharded_state(
+        model, tokens, optax.adamw(3e-4), mesh)
+    n_params = sum(x.size for x in jax.tree.leaves(state.params))
+
+    # K steps chained in ONE compiled program via lax.scan: host dispatch
+    # (and, through a remoted TPU, a ~100ms roundtrip) is paid once per K
+    # steps, not per step — the TPU-idiomatic training loop shape.
+    def one_step(state, rng):
+        def loss(p):
+            with nn.logical_axis_rules(list(DEFAULT_RULES)):
+                return causal_lm_loss(
+                    model.apply({"params": p}, tokens), tokens)
+        l, grads = jax.value_and_grad(loss)(state.params)
+        return state.apply_gradients(grads), l
+
+    @functools.partial(jax.jit, donate_argnums=0)
+    def run_steps(state, rngs):
+        return jax.lax.scan(one_step, state, rngs)
+
+    # Warmup with the SAME scan length: a different length is a different
+    # program and would put the compile inside the timed region.
+    state, losses = run_steps(state, jax.random.split(jax.random.key(1),
+                                                      steps))
+    float(losses[-1])  # value fetch = true synchronization
+
+    rngs = jax.random.split(jax.random.key(2), steps)
+    t0 = time.perf_counter()
+    state, losses = run_steps(state, rngs)
+    final_loss = float(losses[-1])
+    dt = time.perf_counter() - t0
+
+    tokens_per_sec = batch * seq * steps / dt
+    # Model FLOPs: 6·params per token (fwd+bwd) + causal attention term
+    # (12·L·dim·S/2, fwd+bwd, causal halves the score matrix).
+    flops_per_token = 6 * n_params + 12 * cfg.n_layers * cfg.dim * seq // 2
+    mfu_denom = 394e12 if on_tpu else None  # v5e nominal peak bf16 FLOP/s
+    mfu = (tokens_per_sec * flops_per_token / mfu_denom) if mfu_denom else 0.0
+
+    baseline_path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                 "BENCH_BASELINE.json")
+    vs_baseline = 1.0
+    if os.path.exists(baseline_path):
+        try:
+            with open(baseline_path) as f:
+                vs_baseline = tokens_per_sec / float(json.load(f)["value"])
+        except Exception:
+            pass
+
+    print(json.dumps({
+        "metric": "transformer_train_tokens_per_sec_per_chip",
+        "value": round(tokens_per_sec, 2),
+        "unit": "tokens/s",
+        "vs_baseline": round(vs_baseline, 4),
+        "detail": {
+            "params": n_params, "batch": batch, "seq": seq,
+            "backend": jax.default_backend(),
+            "loss": round(final_loss, 4),
+            "mfu_vs_v5e_peak": round(mfu, 4),
+        },
+    }))
+
+
+if __name__ == "__main__":
+    main()

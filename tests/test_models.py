@@ -1,0 +1,158 @@
+"""Model zoo on the 8-device virtual mesh: shapes, sharding, learning."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from tony_tpu.models import (MnistMLP, ResNet, ResNetConfig, Transformer,
+                             TransformerConfig)
+from tony_tpu.models.mlp import classification_loss
+from tony_tpu.models.transformer import causal_lm_loss
+from tony_tpu.parallel import (MeshSpec, build_mesh, init_sharded_state,
+                               jit_train_step)
+
+
+def test_transformer_forward_shapes():
+    cfg = TransformerConfig.tiny()
+    model = Transformer(cfg)
+    tokens = jnp.zeros((2, 16), jnp.int32)
+    import flax.linen as nn
+    from tony_tpu.parallel.sharding import DEFAULT_RULES
+    with nn.logical_axis_rules(list(DEFAULT_RULES)):
+        variables = model.init(jax.random.key(0), tokens)
+        logits = model.apply(variables, tokens)
+    assert logits.shape == (2, 16, cfg.vocab_size)
+    assert logits.dtype == jnp.float32
+
+
+def test_transformer_trains_sharded_tp_fsdp():
+    mesh = build_mesh(MeshSpec(dp=2, fsdp=2, tp=2))
+    cfg = TransformerConfig.tiny(attn_impl="flash")
+    model = Transformer(cfg)
+    tokens = jax.random.randint(jax.random.key(0), (8, 32), 0,
+                                cfg.vocab_size)
+    batch = {"tokens": tokens}
+
+    def loss_fn(params, batch, rng):
+        logits = model.apply({"params": params}, batch["tokens"])
+        return causal_lm_loss(logits, batch["tokens"]), {}
+
+    state, state_sh = init_sharded_state(model, tokens, optax.adam(1e-3),
+                                         mesh)
+    # lm_head should shard vocab over tp and embed over fsdp.
+    from jax.sharding import PartitionSpec as P
+    lm = state.params["lm_head"]["kernel"]
+    assert lm.sharding.spec == P("fsdp", "tp")
+    step = jit_train_step(loss_fn, mesh, state_sh, batch)
+    losses = []
+    for i in range(10):
+        state, m = step(state, batch, jax.random.key(i))
+        losses.append(float(m["loss"]))
+    assert losses[-1] < losses[0]
+    assert np.isfinite(losses).all()
+
+
+def test_transformer_ring_attention_seq_parallel():
+    """Long-context path: sequence sharded over sp, ring attention inside
+    the model, loss identical to the flash path."""
+    mesh_sp = build_mesh(MeshSpec(dp=2, sp=4))
+    cfg_ring = TransformerConfig.tiny(attn_impl="ring")
+    cfg_flash = TransformerConfig.tiny(attn_impl="xla")
+    tokens = jax.random.randint(jax.random.key(0), (2, 64), 0, 256)
+
+    import flax.linen as nn
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    from tony_tpu.parallel.sharding import DEFAULT_RULES
+    from jax import shard_map
+
+    with nn.logical_axis_rules(list(DEFAULT_RULES)):
+        variables = Transformer(cfg_flash).init(jax.random.key(1), tokens)
+    variables = nn.meta.unbox(variables)
+
+    ref_logits = Transformer(cfg_flash).apply(variables, tokens)
+
+    # Ring path: tokens sharded over sp on the seq dim; params replicated;
+    # the model's internal ring_attention runs inside shard_map.
+    def fwd(params, tokens):
+        return Transformer(cfg_ring).apply({"params": params}, tokens)
+
+    ring_fn = shard_map(
+        fwd, mesh=mesh_sp,
+        in_specs=(P(), P(("dp", "fsdp"), "sp")),
+        out_specs=P(("dp", "fsdp"), "sp", None), check_vma=False)
+    ring_logits = ring_fn(variables["params"], tokens)
+    np.testing.assert_allclose(ring_logits, ref_logits, atol=2e-4,
+                               rtol=2e-4)
+
+
+def test_mnist_mlp_learns():
+    mesh = build_mesh(MeshSpec(dp=4, tp=2))
+    model = MnistMLP(hidden=64)
+    x = jax.random.normal(jax.random.key(0), (64, 28, 28, 1))
+    w = jax.random.normal(jax.random.key(1), (784, 10))
+    labels = jnp.argmax(x.reshape(64, -1) @ w, axis=-1)
+    batch = {"x": x, "y": labels}
+
+    def loss_fn(params, batch, rng):
+        logits = model.apply({"params": params}, batch["x"])
+        acc = jnp.mean((jnp.argmax(logits, -1) == batch["y"]).astype(
+            jnp.float32))
+        return classification_loss(logits, batch["y"]), {"acc": acc}
+
+    state, state_sh = init_sharded_state(model, x, optax.adam(1e-2), mesh)
+    step = jit_train_step(loss_fn, mesh, state_sh, batch)
+    first = last = None
+    for i in range(30):
+        state, m = step(state, batch, jax.random.key(i))
+        if first is None:
+            first = float(m["loss"])
+        last = float(m["loss"])
+    assert last < first * 0.5
+
+
+def test_ring_config_init_outside_shard_map():
+    """Regression: ring/ulysses models must init via init_sharded_state
+    (no bound sp axis there — _sp_offset falls back to 0)."""
+    mesh = build_mesh(MeshSpec(dp=4, tp=2))
+    cfg = TransformerConfig.tiny(attn_impl="ring")
+    tokens = jnp.zeros((4, 16), jnp.int32)
+    # init traces the model with the xla-equivalent single-shard semantics.
+    import flax.linen as nn
+    from tony_tpu.parallel.sharding import DEFAULT_RULES
+    with nn.logical_axis_rules(list(DEFAULT_RULES)):
+        variables = Transformer(cfg).init(jax.random.key(0), tokens)
+    assert "params" in variables
+
+
+def test_resnet_init_sharded_on_fsdp_mesh():
+    """Regression: the 3-channel stem conv must not claim a sharded
+    in-channel axis."""
+    mesh = build_mesh(MeshSpec(dp=2, fsdp=2, tp=2))
+    cfg = ResNetConfig.tiny()
+    x = jnp.ones((4, 32, 32, 3))
+    state, state_sh = init_sharded_state(ResNet(cfg), x, optax.adam(1e-3),
+                                         mesh)
+    assert int(state.step) == 0
+
+
+def test_resnet_forward_and_grad():
+    cfg = ResNetConfig.tiny()
+    model = ResNet(cfg)
+    x = jnp.ones((2, 32, 32, 3))
+    import flax.linen as nn
+    from tony_tpu.parallel.sharding import DEFAULT_RULES
+    with nn.logical_axis_rules(list(DEFAULT_RULES)):
+        variables = model.init(jax.random.key(0), x)
+        logits = model.apply(variables, x)
+    assert logits.shape == (2, cfg.num_classes)
+
+    def loss(params):
+        out = model.apply({"params": params}, x)
+        return jnp.mean(out ** 2)
+
+    with nn.logical_axis_rules(list(DEFAULT_RULES)):
+        g = jax.grad(loss)(nn.meta.unbox(variables)["params"])
+    flat = jax.tree.leaves(g)
+    assert all(np.isfinite(leaf).all() for leaf in flat)

@@ -1,0 +1,15 @@
+"""Model zoo: the workloads the reference ran as opaque user scripts.
+
+The reference shipped example models as user Python (MNIST TF/PyTorch, MXNet
+linear regression — ``tony-examples/*``, SURVEY.md §2.2) and never looked
+inside them. Here the flagship models are part of the framework, built
+TPU-first: flax modules annotated with logical axes so the parallel library
+can shard them onto any mesh, bf16 compute, flash/ring attention from
+`tony_tpu.ops`.
+"""
+
+from tony_tpu.models.transformer import (  # noqa: F401
+    Transformer, TransformerConfig,
+)
+from tony_tpu.models.mlp import MnistMLP  # noqa: F401
+from tony_tpu.models.resnet import ResNet, ResNetConfig  # noqa: F401

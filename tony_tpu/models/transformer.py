@@ -1,0 +1,232 @@
+"""Decoder-only transformer (llama-family architecture), TPU-first.
+
+The flagship model for the Llama-3-8B-on-TPU target (BASELINE.json
+"new JAXRuntime: Llama-3-8B multi-host SPMD"). Design choices map straight
+onto TPU hardware:
+
+- every weight carries logical axes (``embed``/``mlp``/``heads``/``vocab``)
+  so `tony_tpu.parallel` can lay it out on any dp/fsdp/tp/sp mesh;
+- bf16 activations (MXU-native), f32 params and softmax statistics;
+- attention is pluggable: Pallas flash kernel (default), ring attention for
+  sequence-parallel long context, Ulysses, or the XLA reference;
+- static shapes and `remat`-friendly block structure (scan over layers is
+  deliberately NOT used so pipeline stages can slice layers later).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from tony_tpu.ops.attention import flash_attention, reference_attention
+from tony_tpu.ops.ring import ring_attention
+from tony_tpu.ops.ulysses import ulysses_attention
+
+
+@dataclasses.dataclass(frozen=True)
+class TransformerConfig:
+    vocab_size: int = 32000
+    dim: int = 4096
+    n_layers: int = 32
+    n_heads: int = 32
+    n_kv_heads: int = 8
+    mlp_dim: int = 14336
+    max_seq_len: int = 8192
+    rope_theta: float = 500000.0
+    norm_eps: float = 1e-5
+    dtype: jnp.dtype = jnp.bfloat16          # activations
+    param_dtype: jnp.dtype = jnp.float32
+    attn_impl: str = "flash"                 # flash | ring | ulysses | xla
+    remat: bool = True
+    tie_embeddings: bool = False
+
+    @classmethod
+    def llama3_8b(cls, **kw) -> "TransformerConfig":
+        """Llama-3-8B geometry (public: 32L, 4096d, 32h/8kv, 14336 mlp,
+        128k vocab)."""
+        return cls(vocab_size=128256, dim=4096, n_layers=32, n_heads=32,
+                   n_kv_heads=8, mlp_dim=14336, rope_theta=500000.0, **kw)
+
+    @classmethod
+    def tiny(cls, **kw) -> "TransformerConfig":
+        """CI-sized config for the fake mesh (SURVEY.md §4 test strategy)."""
+        defaults = dict(vocab_size=256, dim=64, n_layers=2, n_heads=4,
+                        n_kv_heads=2, mlp_dim=128, max_seq_len=128,
+                        dtype=jnp.float32, remat=False)
+        defaults.update(kw)
+        return cls(**defaults)
+
+
+def _dense(cfg: TransformerConfig, feats: int, axes, name: str) -> nn.Dense:
+    return nn.Dense(
+        feats, use_bias=False, dtype=cfg.dtype, param_dtype=cfg.param_dtype,
+        name=name,
+        kernel_init=nn.with_logical_partitioning(
+            nn.initializers.lecun_normal(), axes))
+
+
+def _sp_offset() -> jax.Array:
+    """Shard index on the sp axis, or 0 when not under shard_map (init /
+    single-shard apply trace the model outside any mesh axis context)."""
+    try:
+        return jax.lax.axis_index("sp")
+    except NameError:
+        return jnp.zeros((), jnp.int32)
+
+
+def _rope(x: jax.Array, positions: jax.Array, theta: float) -> jax.Array:
+    """Rotary position embedding on [B, S, H, D]; f32 trig, cast back."""
+    d = x.shape[-1]
+    freqs = theta ** (-jnp.arange(0, d, 2, dtype=jnp.float32) / d)
+    angles = positions[:, :, None, None].astype(jnp.float32) \
+        * freqs[None, None, None, :]                    # [B, S, 1, D/2]
+    cos, sin = jnp.cos(angles), jnp.sin(angles)
+    x1, x2 = jnp.split(x.astype(jnp.float32), 2, axis=-1)
+    out = jnp.concatenate([x1 * cos - x2 * sin, x1 * sin + x2 * cos],
+                          axis=-1)
+    return out.astype(x.dtype)
+
+
+class RMSNorm(nn.Module):
+    eps: float
+    param_dtype: jnp.dtype
+
+    @nn.compact
+    def __call__(self, x):
+        scale = self.param(
+            "scale", nn.with_logical_partitioning(nn.initializers.ones,
+                                                  ("norm",)),
+            (x.shape[-1],), self.param_dtype)
+        var = jnp.mean(jnp.square(x.astype(jnp.float32)), axis=-1,
+                       keepdims=True)
+        y = x.astype(jnp.float32) * jax.lax.rsqrt(var + self.eps)
+        return (y * scale).astype(x.dtype)
+
+
+class Attention(nn.Module):
+    cfg: TransformerConfig
+
+    @nn.compact
+    def __call__(self, x, positions):
+        cfg = self.cfg
+        head_dim = cfg.dim // cfg.n_heads
+        b, s, _ = x.shape
+        # Plain Dense with a fused (heads·head_dim) output: the fused dim is
+        # heads-major, so sharding it over tp == sharding heads over tp.
+        # (DenseGeneral flattens multi-dim kernels before calling
+        # kernel_init, which breaks 3-axis logical metadata.)
+        q = _dense(cfg, cfg.n_heads * head_dim, ("embed", "heads"), "wq")(
+            x).reshape(b, s, cfg.n_heads, head_dim)
+        k = _dense(cfg, cfg.n_kv_heads * head_dim, ("embed", "kv_heads"),
+                   "wk")(x).reshape(b, s, cfg.n_kv_heads, head_dim)
+        v = _dense(cfg, cfg.n_kv_heads * head_dim, ("embed", "kv_heads"),
+                   "wv")(x).reshape(b, s, cfg.n_kv_heads, head_dim)
+        q = _rope(q, positions, cfg.rope_theta)
+        k = _rope(k, positions, cfg.rope_theta)
+        q = nn.with_logical_constraint(q, ("batch", "seq", "heads", "kv"))
+        k = nn.with_logical_constraint(k, ("batch", "seq", "kv_heads", "kv"))
+        v = nn.with_logical_constraint(v, ("batch", "seq", "kv_heads", "kv"))
+
+        if cfg.attn_impl == "flash":
+            o = flash_attention(q, k, v, causal=True)
+        elif cfg.attn_impl == "xla":
+            g = cfg.n_heads // cfg.n_kv_heads
+            o = reference_attention(q, jnp.repeat(k, g, axis=2),
+                                    jnp.repeat(v, g, axis=2), causal=True)
+        elif cfg.attn_impl == "ring":
+            g = cfg.n_heads // cfg.n_kv_heads
+            o = ring_attention(q, jnp.repeat(k, g, axis=2),
+                               jnp.repeat(v, g, axis=2), axis_name="sp",
+                               causal=True)
+        elif cfg.attn_impl == "ulysses":
+            o = ulysses_attention(q, k, v, axis_name="sp", causal=True)
+        else:
+            raise ValueError(f"unknown attn_impl {cfg.attn_impl!r}")
+        o = nn.with_logical_constraint(o, ("batch", "seq", "heads", "kv"))
+        o = o.reshape(b, s, cfg.n_heads * head_dim)
+        return _dense(cfg, cfg.dim, ("heads", "embed"), "wo")(o)
+
+
+class MLP(nn.Module):
+    cfg: TransformerConfig
+
+    @nn.compact
+    def __call__(self, x):
+        cfg = self.cfg
+        gate = _dense(cfg, cfg.mlp_dim, ("embed", "mlp"), "gate")(x)
+        up = _dense(cfg, cfg.mlp_dim, ("embed", "mlp"), "up")(x)
+        h = nn.silu(gate) * up
+        h = nn.with_logical_constraint(h, ("batch", "seq", "mlp"))
+        return _dense(cfg, cfg.dim, ("mlp", "embed"), "down")(h)
+
+
+class Block(nn.Module):
+    cfg: TransformerConfig
+
+    @nn.compact
+    def __call__(self, x, positions):
+        cfg = self.cfg
+        h = x + Attention(cfg, name="attn")(
+            RMSNorm(cfg.norm_eps, cfg.param_dtype, name="attn_norm")(x),
+            positions)
+        out = h + MLP(cfg, name="mlp")(
+            RMSNorm(cfg.norm_eps, cfg.param_dtype, name="mlp_norm")(h))
+        return nn.with_logical_constraint(out, ("batch", "seq", "embed"))
+
+
+class Transformer(nn.Module):
+    """Causal LM: tokens [B, S] int32 → logits [B, S, vocab]."""
+    cfg: TransformerConfig
+
+    @nn.compact
+    def __call__(self, tokens, positions=None):
+        cfg = self.cfg
+        if positions is None:
+            pos = jnp.arange(tokens.shape[1], dtype=jnp.int32)
+            if cfg.attn_impl in ("ring", "ulysses"):
+                # Sequence-parallel: the model runs inside shard_map over
+                # "sp" and sees only its local chunk — RoPE needs global
+                # positions, offset by the shard index (0 under init or a
+                # single-shard apply, where no sp axis is bound).
+                pos = pos + _sp_offset() * tokens.shape[1]
+            positions = jnp.broadcast_to(pos[None, :], tokens.shape)
+        emb = self.param(
+            "embedding", nn.with_logical_partitioning(
+                nn.initializers.normal(0.02), ("vocab", "embed")),
+            (cfg.vocab_size, cfg.dim), cfg.param_dtype)
+        x = emb[tokens].astype(cfg.dtype)
+        x = nn.with_logical_constraint(x, ("batch", "seq", "embed"))
+        block = Block
+        if cfg.remat:
+            block = nn.remat(Block, prevent_cse=False)
+        for i in range(cfg.n_layers):
+            x = block(cfg, name=f"layer_{i}")(x, positions)
+        x = RMSNorm(cfg.norm_eps, cfg.param_dtype, name="final_norm")(x)
+        if cfg.tie_embeddings:
+            logits = jnp.einsum("bsd,vd->bsv", x.astype(jnp.float32),
+                                emb.astype(jnp.float32))
+        else:
+            logits = nn.Dense(
+                cfg.vocab_size, use_bias=False, dtype=jnp.float32,
+                param_dtype=cfg.param_dtype, name="lm_head",
+                kernel_init=nn.with_logical_partitioning(
+                    nn.initializers.lecun_normal(), ("embed", "vocab")))(
+                        x.astype(jnp.float32))
+        return logits
+
+
+def causal_lm_loss(logits: jax.Array, tokens: jax.Array,
+                   mask: Optional[jax.Array] = None) -> jax.Array:
+    """Next-token cross entropy; logits [B,S,V] predict tokens shifted."""
+    targets = tokens[:, 1:]
+    logits = logits[:, :-1]
+    logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+    nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+    if mask is not None:
+        m = mask[:, 1:].astype(jnp.float32)
+        return jnp.sum(nll * m) / jnp.maximum(jnp.sum(m), 1.0)
+    return jnp.mean(nll)

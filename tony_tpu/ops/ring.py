@@ -32,7 +32,13 @@ def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     """Per-shard ring attention ([B, S_local, H, D] in/out). Call inside
     shard_map with the sequence dim sharded over ``axis_name``."""
     b, s_loc, h, d = q.shape
-    n = jax.lax.psum(1, axis_name)
+    try:
+        n = jax.lax.psum(1, axis_name)
+    except NameError:
+        # No bound axis (model init / single-shard apply): the "ring" is a
+        # single chunk — plain causal attention.
+        from tony_tpu.ops.attention import reference_attention
+        return reference_attention(q, k, v, causal=causal, scale=scale)
     my = jax.lax.axis_index(axis_name)
     scale = scale if scale is not None else d ** -0.5
     perm = [(j, (j + 1) % n) for j in range(n)]

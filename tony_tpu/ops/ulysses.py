@@ -29,6 +29,13 @@ def ulysses_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     inside shard_map. Requires both q and k/v head counts divisible by the
     axis size."""
 
+    try:
+        jax.lax.psum(1, axis_name)
+    except NameError:
+        # No bound axis (model init / single-shard apply): no swap needed.
+        return flash_attention(q, k, v, causal=causal, scale=scale,
+                               block_q=block_q, block_k=block_k)
+
     def seq_to_heads(x):
         # [B, S/n, H, D] → [B, S, H/n, D]
         return jax.lax.all_to_all(x, axis_name, split_axis=2, concat_axis=1,
