@@ -94,7 +94,13 @@ def main():
     if os.path.exists(baseline_path):
         try:
             with open(baseline_path) as f:
-                vs_baseline = tokens_per_sec / float(json.load(f)["value"])
+                base = json.load(f)
+            # Only compare like with like: a CPU smoke run against the TPU
+            # baseline would report a meaningless ratio.
+            if base.get("backend", "tpu") == jax.default_backend():
+                vs_baseline = tokens_per_sec / float(base["value"])
+            else:
+                vs_baseline = None
         except Exception:
             pass
 
@@ -102,7 +108,8 @@ def main():
         "metric": "transformer_train_tokens_per_sec_per_chip",
         "value": round(tokens_per_sec, 2),
         "unit": "tokens/s",
-        "vs_baseline": round(vs_baseline, 4),
+        "vs_baseline": round(vs_baseline, 4) if vs_baseline is not None
+        else None,
         "detail": {
             "params": n_params, "batch": batch, "seq": seq,
             "backend": jax.default_backend(),

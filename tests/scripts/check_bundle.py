@@ -1,0 +1,13 @@
+"""Assert the staged src-dir bundle was localized into the task cwd
+(reference check_archive_file_localization.py)."""
+import os
+import sys
+
+if not os.path.exists("data.txt"):
+    print(f"data.txt not localized into {os.getcwd()}", file=sys.stderr)
+    sys.exit(2)
+with open("data.txt") as f:
+    if f.read().strip() != "bundled-data":
+        sys.exit(3)
+print("bundle ok")
+sys.exit(0)

@@ -1,0 +1,26 @@
+"""Assert the executor's identity + JAX rendezvous env contract
+(reference exit_0_check_env.py / exit_0_check_pytorchenv.py)."""
+import os
+import sys
+
+required = [
+    "JOB_NAME", "TASK_INDEX", "TASK_NUM", "IS_CHIEF", "SESSION_ID",
+    "JAX_COORDINATOR_ADDRESS", "JAX_NUM_PROCESSES", "JAX_PROCESS_ID",
+]
+missing = [k for k in required if k not in os.environ]
+if missing:
+    print(f"missing env: {missing}", file=sys.stderr)
+    sys.exit(2)
+
+idx = int(os.environ["TASK_INDEX"])
+rank = int(os.environ["JAX_PROCESS_ID"])
+world = int(os.environ["JAX_NUM_PROCESSES"])
+if not (0 <= rank < world):
+    print(f"bad rank {rank}/{world}", file=sys.stderr)
+    sys.exit(3)
+addr = os.environ["JAX_COORDINATOR_ADDRESS"]
+if ":" not in addr:
+    print(f"bad coordinator address {addr}", file=sys.stderr)
+    sys.exit(4)
+print(f"env ok: task {os.environ['JOB_NAME']}:{idx} rank {rank}/{world}")
+sys.exit(0)

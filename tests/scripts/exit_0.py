@@ -1,0 +1,4 @@
+"""Trivially-succeeding workload (reference tony-core test script exit_0.py)."""
+import sys
+
+sys.exit(0)

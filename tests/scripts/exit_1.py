@@ -1,0 +1,4 @@
+"""Trivially-failing workload (reference exit_1.py)."""
+import sys
+
+sys.exit(1)

@@ -1,0 +1,151 @@
+"""End-to-end: client → coordinator subprocess → executor subprocesses →
+user python — the whole stack, no hardware.
+
+Reference model: ``TestTonyE2E.java`` (17 scenarios against MiniCluster(3),
+SURVEY.md §4.1). Scripts live in tests/scripts/ like the reference's
+``src/test/resources/scripts/``.
+"""
+
+import os
+import sys
+
+import pytest
+
+from tony_tpu import constants
+from tony_tpu.client import TaskUpdateListener, TonyTpuClient
+from tony_tpu.conf.config import TonyTpuConfig
+from tony_tpu.conf import keys as K
+from tony_tpu.events import history
+
+SCRIPTS = os.path.join(os.path.dirname(os.path.abspath(__file__)), "scripts")
+
+
+def make_conf(tmp_path, script, workers=2, extra=None):
+    conf = TonyTpuConfig()
+    conf.set("tony.worker.instances", workers)
+    conf.set("tony.worker.command",
+             f"{sys.executable} {os.path.join(SCRIPTS, script)}")
+    conf.set(K.APPLICATION_FRAMEWORK, "jax")
+    conf.set(K.TASK_REGISTRATION_TIMEOUT_S, 60)
+    conf.set(K.APPLICATION_TIMEOUT_S, 120)
+    conf.set(K.HISTORY_LOCATION, str(tmp_path / "history"))
+    for k, v in (extra or {}).items():
+        conf.set(k, v)
+    return conf
+
+
+class Recorder(TaskUpdateListener):
+    def __init__(self):
+        self.app_id = None
+        self.updates = []
+        self.finished = None
+
+    def on_application_id_received(self, app_id):
+        self.app_id = app_id
+
+    def on_task_infos_updated(self, infos):
+        self.updates.append(infos)
+
+    def on_application_finished(self, status, report):
+        self.finished = (status, report)
+
+
+def submit(conf, tmp_path):
+    client = TonyTpuClient(conf, workdir=str(tmp_path / "work"))
+    rec = Recorder()
+    client.add_listener(rec)
+    code = client.start()
+    return client, rec, code
+
+
+def test_e2e_success_two_workers(tmp_path):
+    conf = make_conf(tmp_path, "exit_0.py")
+    client, rec, code = submit(conf, tmp_path)
+    assert code == 0
+    assert rec.app_id and rec.finished[0] == "SUCCEEDED"
+    # every task reported SUCCEEDED to the listeners
+    final = {f"{t['name']}:{t['index']}": t["status"]
+             for t in rec.updates[-1]}
+    assert final == {"worker:0": "SUCCEEDED", "worker:1": "SUCCEEDED"}
+    # history finalized with SUCCEEDED in the filename
+    jobs = history.list_jobs(str(tmp_path / "history"))
+    assert [j.status for j in jobs if j.app_id == rec.app_id] == ["SUCCEEDED"]
+
+
+def test_e2e_worker_failure_fails_job(tmp_path):
+    conf = make_conf(tmp_path, "exit_1.py", workers=2,
+                     extra={K.APPLICATION_FAIL_ON_WORKER_FAILURE: True})
+    client, rec, code = submit(conf, tmp_path)
+    assert code == constants.EXIT_FAILURE
+    assert rec.finished[0] == "FAILED"
+
+
+def test_e2e_env_contract_and_gang_barrier(tmp_path):
+    """check_env.py exits nonzero unless the full identity + JAX rendezvous
+    env is present — which requires the cluster-spec barrier to complete."""
+    conf = make_conf(tmp_path, "check_env.py", workers=3)
+    client, rec, code = submit(conf, tmp_path)
+    assert code == 0, _dump_task_logs(client)
+
+
+def test_e2e_bundle_localization(tmp_path):
+    src = tmp_path / "src"
+    src.mkdir()
+    (src / "data.txt").write_text("bundled-data\n")
+    conf = make_conf(tmp_path, "check_bundle.py", workers=1,
+                     extra={K.SRC_DIR: str(src)})
+    client, rec, code = submit(conf, tmp_path)
+    assert code == 0, _dump_task_logs(client)
+
+
+def test_e2e_events_stream_complete(tmp_path):
+    conf = make_conf(tmp_path, "exit_0.py", workers=2)
+    client, rec, code = submit(conf, tmp_path)
+    events = history.read_job_events(str(tmp_path / "history"), rec.app_id)
+    types = [e.type for e in events]
+    from tony_tpu.events.events import EventType
+    assert types[0] == EventType.APPLICATION_INITED
+    assert types[-1] == EventType.APPLICATION_FINISHED
+    assert types.count(EventType.TASK_STARTED) == 2
+    assert types.count(EventType.TASK_FINISHED) == 2
+
+
+def test_cli_submit_with_executable(tmp_path):
+    """LocalSubmitter-style zero-config path: --executable only."""
+    from tony_tpu.cli.main import main
+
+    code = main([
+        "submit",
+        "--executable", os.path.join(SCRIPTS, "exit_0.py"),
+        "--instances", "1",
+        "--workdir", str(tmp_path / "work"),
+        "--conf", f"{K.HISTORY_LOCATION}={tmp_path / 'history'}",
+        "--conf", f"{K.TASK_REGISTRATION_TIMEOUT_S}=60",
+    ])
+    assert code == 0
+
+
+@pytest.mark.slow
+def test_e2e_distributed_jax_training(tmp_path):
+    """The §7.5 milestone: 2 processes jax.distributed.initialize over the
+    tony-tpu rendezvous, global 4-device mesh, pjit DP training."""
+    conf = make_conf(tmp_path, "distributed_mnist.py", workers=2)
+    client, rec, code = submit(conf, tmp_path)
+    assert code == 0, _dump_task_logs(client)
+
+
+def _dump_task_logs(client):
+    out = []
+    tasks_dir = os.path.join(client.job_dir, "tasks")
+    if os.path.isdir(tasks_dir):
+        for d in sorted(os.listdir(tasks_dir)):
+            for f in ("stdout.log", "stderr.log"):
+                p = os.path.join(tasks_dir, d, f)
+                if os.path.exists(p):
+                    with open(p) as fh:
+                        out.append(f"--- {d}/{f} ---\n{fh.read()}")
+    coord = os.path.join(client.job_dir, "coordinator.log")
+    if os.path.exists(coord):
+        with open(coord) as fh:
+            out.append(f"--- coordinator.log ---\n{fh.read()}")
+    return "\n".join(out)[-8000:]

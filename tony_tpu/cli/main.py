@@ -1,0 +1,148 @@
+"""CLI: submit and inspect jobs.
+
+Reference model: ``tony-cli`` — ``ClusterSubmitter`` (stage + delegate to the
+client with a kill-on-exit hook, :49-74), ``LocalSubmitter`` (zero-install
+demo against an in-process cluster, :47-68). The history subcommand covers
+the portal's jobs-index view for terminals (``tony-portal/conf/routes:1``).
+
+Usage:
+    python -m tony_tpu.cli submit --conf-file job.yaml [--conf k=v ...]
+    python -m tony_tpu.cli submit --executable train.py --instances 2
+    python -m tony_tpu.cli history [--history-root DIR]
+    python -m tony_tpu.cli events <app_id>
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+import os
+import signal
+import sys
+from typing import List, Optional
+
+from tony_tpu.client import TaskUpdateListener, TonyTpuClient
+from tony_tpu.conf import keys as K
+
+
+class _LogListener(TaskUpdateListener):
+    def on_application_id_received(self, app_id: str) -> None:
+        print(f"submitted application {app_id}")
+
+    def on_task_infos_updated(self, task_infos) -> None:
+        states = {}
+        for t in task_infos:
+            states.setdefault(t.get("status", "?"), []).append(
+                f"{t.get('name', '?')}:{t.get('index', '?')}")
+        print("tasks:", "  ".join(
+            f"{s}={','.join(ids)}" for s, ids in sorted(states.items())))
+
+    def on_application_finished(self, status: str, report: dict) -> None:
+        print(f"application finished: {status}")
+        if report.get("failure_reason"):
+            print(f"reason: {report['failure_reason']}")
+
+
+def _cmd_submit(args: argparse.Namespace) -> int:
+    overrides = list(args.conf or [])
+    if args.executable:
+        overrides.append(f"{K.APPLICATION_EXECUTABLE}={args.executable}")
+    if args.task_params:
+        overrides.append(f"{K.APPLICATION_TASK_PARAMS}={args.task_params}")
+    if args.src_dir:
+        overrides.append(f"{K.SRC_DIR}={args.src_dir}")
+    if args.instances is not None:
+        overrides.append(f"tony.worker.instances={args.instances}")
+    client = TonyTpuClient.from_args(config_file=args.conf_file,
+                                     overrides=tuple(overrides),
+                                     workdir=args.workdir)
+    client.add_listener(_LogListener())
+
+    # Kill-on-exit hook (reference ClusterSubmitter.java:69).
+    def on_signal(signum, frame):
+        print(f"signal {signum}: killing application", file=sys.stderr)
+        client.force_kill()
+        sys.exit(130)
+
+    signal.signal(signal.SIGINT, on_signal)
+    signal.signal(signal.SIGTERM, on_signal)
+    return client.start()
+
+
+def _cmd_history(args: argparse.Namespace) -> int:
+    from tony_tpu.events import history
+
+    root = args.history_root or os.path.join(
+        os.environ.get("TONY_TPU_WORKDIR",
+                       os.path.join(os.path.expanduser("~"), ".tony-tpu")),
+        "history")
+    rows = history.list_jobs(root)
+    if not rows:
+        print(f"no job history under {root}")
+        return 0
+    fmt = "{:<32} {:<10} {:<12} {:<20}"
+    print(fmt.format("APP_ID", "STATUS", "USER", "STARTED"))
+    for r in rows:
+        print(fmt.format(r.app_id, r.status or "RUNNING", r.user,
+                         r.started_iso))
+    return 0
+
+
+def _cmd_events(args: argparse.Namespace) -> int:
+    from tony_tpu.events import history
+
+    root = args.history_root or os.path.join(
+        os.environ.get("TONY_TPU_WORKDIR",
+                       os.path.join(os.path.expanduser("~"), ".tony-tpu")),
+        "history")
+    events = history.read_job_events(root, args.app_id)
+    if events is None:
+        print(f"no history for {args.app_id} under {root}", file=sys.stderr)
+        return 1
+    for ev in events:
+        print(ev)
+    return 0
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(prog="tony-tpu")
+    sub = p.add_subparsers(dest="cmd", required=True)
+
+    s = sub.add_parser("submit", help="submit a job and monitor it")
+    s.add_argument("--conf-file", help="job config (json/yaml)")
+    s.add_argument("--conf", action="append", metavar="K=V",
+                   help="config override (repeatable)")
+    s.add_argument("--executable", help="training script (python_binary is "
+                   "prepended; reference -executes)")
+    s.add_argument("--task-params", help="args appended to the default "
+                   "command (reference -task_params)")
+    s.add_argument("--src-dir", help="directory staged to every task "
+                   "(reference -src_dir)")
+    s.add_argument("--instances", type=int,
+                   help="shortcut for tony.worker.instances")
+    s.add_argument("--workdir", help="client workdir (default ~/.tony-tpu)")
+    s.set_defaults(fn=_cmd_submit)
+
+    h = sub.add_parser("history", help="list finished jobs")
+    h.add_argument("--history-root")
+    h.set_defaults(fn=_cmd_history)
+
+    e = sub.add_parser("events", help="dump a job's event stream")
+    e.add_argument("app_id")
+    e.add_argument("--history-root")
+    e.set_defaults(fn=_cmd_events)
+    return p
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    logging.basicConfig(
+        level=logging.INFO,
+        format="%(asctime)s %(levelname)s %(name)s: %(message)s")
+    args = build_parser().parse_args(argv)
+    from tony_tpu.conf.config import ConfigError
+
+    try:
+        return args.fn(args)
+    except (ConfigError, FileNotFoundError) as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 2

@@ -1,0 +1,3 @@
+from tony_tpu.client.client import (  # noqa: F401
+    TaskUpdateListener, TonyTpuClient,
+)

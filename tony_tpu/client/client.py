@@ -1,0 +1,243 @@
+"""Client library: submit a job, monitor it, mirror task state to listeners.
+
+Reference model: ``TonyClient.java`` (1107 LoC) — merge config layers
+(``initTonyConf`` :483), validate quotas (:598-667), stage the job bundle
+(``processFinalTonyConf`` :189-228), build default task commands
+(``buildTaskCommand`` :454-475), launch the per-job controller, poll the app
+report and mirror task status to listeners (``monitorApplication`` :838,
+``updateTaskInfos`` :894), signal shutdown (``finishApplication`` :886), and
+force-kill on demand (:959). Callback surface mirrors
+``client/CallbackHandler.java`` + ``client/TaskUpdateListener.java``.
+
+TPU-first deltas: the "cluster" is a slice/host inventory rather than YARN —
+the coordinator is spawned directly (locally today; a TPU-VM provisioner
+backend slots in behind the same interface), and staging copies to a local
+bundle dir instead of HDFS.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import shutil
+import subprocess
+import sys
+import time
+import uuid
+from typing import Dict, List, Optional
+
+from tony_tpu import constants
+from tony_tpu.conf.config import ConfigError, TonyTpuConfig
+from tony_tpu.conf import keys as K
+from tony_tpu.rpc.wire import RpcClient
+from tony_tpu.utils import proc as procutil
+
+log = logging.getLogger(__name__)
+
+
+class TaskUpdateListener:
+    """Programmatic-embedding hooks (reference ``TaskUpdateListener.java:14``
+    + ``CallbackHandler.java:16``)."""
+
+    def on_application_id_received(self, app_id: str) -> None:  # noqa: B027
+        pass
+
+    def on_task_infos_updated(self, task_infos: List[dict]) -> None:  # noqa: B027
+        pass
+
+    def on_application_finished(self, status: str, report: dict) -> None:  # noqa: B027
+        pass
+
+
+class TonyTpuClient:
+    def __init__(self, conf: TonyTpuConfig,
+                 workdir: Optional[str] = None):
+        self.conf = conf
+        self.workdir = workdir or os.environ.get(
+            "TONY_TPU_WORKDIR",
+            os.path.join(os.path.expanduser("~"), ".tony-tpu"))
+        self.app_id: str = ""
+        self.job_dir: str = ""
+        self.listeners: List[TaskUpdateListener] = []
+        self._coord_proc: Optional[subprocess.Popen] = None
+        self._rpc: Optional[RpcClient] = None
+        self._last_task_infos: List[dict] = []
+
+    # -- construction ----------------------------------------------------
+    @classmethod
+    def from_args(cls, config_file: Optional[str] = None,
+                  overrides: tuple = (),
+                  workdir: Optional[str] = None) -> "TonyTpuClient":
+        """Reference ``TonyClient.init(args)`` :346 — parse layers, validate."""
+        conf = TonyTpuConfig.from_layers(config_file=config_file,
+                                         overrides=overrides)
+        return cls(conf, workdir=workdir)
+
+    def add_listener(self, listener: TaskUpdateListener) -> None:
+        self.listeners.append(listener)
+
+    # -- submit-time processing ------------------------------------------
+    def _build_default_commands(self) -> None:
+        """Jobtypes without a command get '<python> <executable> <params>'
+        (reference ``buildTaskCommand`` :454-475)."""
+        executable = str(self.conf.get(K.APPLICATION_EXECUTABLE, "") or "")
+        params = str(self.conf.get(K.APPLICATION_TASK_PARAMS, "") or "")
+        python = str(self.conf.get(K.PYTHON_BINARY_PATH, "") or "") \
+            or sys.executable
+        for job in self.conf.job_types().values():
+            if job.command:
+                continue
+            if not executable:
+                raise ConfigError(
+                    f"jobtype {job.name!r} has no command and no "
+                    f"{K.APPLICATION_EXECUTABLE} is set")
+            cmd = f"{python} {executable}"
+            if params:
+                cmd += f" {params}"
+            self.conf.set(K.COMMAND_FORMAT.format(job=job.name), cmd)
+
+    def _stage_bundle(self) -> None:
+        """Copy src-dir into the job dir (the HDFS-upload analogue,
+        ``processFinalTonyConf`` :189-228); executors localize it into each
+        task working dir."""
+        src = str(self.conf.get(K.SRC_DIR, "") or "")
+        if not src:
+            return
+        if not os.path.isdir(src):
+            raise ConfigError(f"{K.SRC_DIR}={src!r} is not a directory")
+        bundle = os.path.join(self.job_dir, "bundle")
+        shutil.copytree(src, bundle, dirs_exist_ok=True)
+        self.conf.set(K.INTERNAL_BUNDLE_DIR, bundle)
+
+    # -- lifecycle -------------------------------------------------------
+    def start(self) -> int:
+        """Submit + monitor to completion; returns a process exit code
+        (reference ``run`` :155)."""
+        self.conf.validate()
+        self._build_default_commands()
+        # Underscore-separated like YARN's application_<ts>_<n>: the history
+        # filename grammar (history.py) uses '-' as its field separator.
+        self.app_id = "app_%s_%s" % (time.strftime("%Y%m%d_%H%M%S"),
+                                     uuid.uuid4().hex[:6])
+        self.job_dir = os.path.join(self.workdir, "jobs", self.app_id)
+        os.makedirs(self.job_dir, exist_ok=True)
+        for lst in self.listeners:
+            lst.on_application_id_received(self.app_id)
+        self._stage_bundle()
+        self.conf.set(K.INTERNAL_APP_ID, self.app_id)
+        frozen = self.conf.freeze(
+            os.path.join(self.job_dir, constants.FINAL_CONFIG_FILE))
+
+        history_root = str(self.conf.get(K.HISTORY_LOCATION, "") or "") \
+            or os.path.join(self.workdir, "history")
+        addr_file = os.path.join(self.job_dir, "coordinator.addr")
+        cmd = [sys.executable, "-m", "tony_tpu.coordinator",
+               "--conf", frozen, "--app-id", self.app_id,
+               "--history-root", history_root,
+               "--workdir", os.path.join(self.job_dir, "tasks"),
+               "--addr-file", addr_file,
+               "--user", os.environ.get("USER", "unknown")]
+        coord_log = open(os.path.join(self.job_dir, "coordinator.log"), "wb")
+        env = dict(os.environ)
+        repo_root = os.path.dirname(os.path.dirname(
+            os.path.dirname(os.path.abspath(__file__))))
+        env["PYTHONPATH"] = (repo_root + os.pathsep +
+                             env.get("PYTHONPATH", "")).rstrip(os.pathsep)
+        self._coord_proc = subprocess.Popen(
+            cmd, stdout=coord_log, stderr=subprocess.STDOUT, env=env)
+        coord_log.close()
+        try:
+            return self._monitor(addr_file)
+        finally:
+            self._cleanup()
+
+    def _connect(self, addr_file: str) -> RpcClient:
+        """Poll for the coordinator endpoint (the RM-report analogue)."""
+        def read_addr() -> Optional[dict]:
+            if self._coord_proc and self._coord_proc.poll() is not None:
+                raise RuntimeError(
+                    f"coordinator exited early with "
+                    f"{self._coord_proc.returncode}; see "
+                    f"{os.path.join(self.job_dir, 'coordinator.log')}")
+            if os.path.exists(addr_file):
+                with open(addr_file, encoding="utf-8") as f:
+                    return json.load(f)
+            return None
+
+        addr = procutil.poll_till_non_null(read_addr, interval_s=0.1,
+                                           timeout_s=60)
+        if addr is None:
+            raise RuntimeError("coordinator address never appeared")
+        return RpcClient(addr["host"], addr["port"],
+                         token=addr.get("token") or None)
+
+    def _monitor(self, addr_file: str) -> int:
+        """Reference ``monitorApplication`` :838-892 (1 s poll; task-info
+        diffs to listeners; terminal status → finishApplication)."""
+        self._rpc = self._connect(addr_file)
+        interval = self.conf.get_int(K.CLIENT_POLL_INTERVAL_MS, 1000) / 1000.0
+        while True:
+            try:
+                report = self._rpc.call("get_application_report")
+            except Exception as e:  # noqa: BLE001
+                if self._coord_proc and self._coord_proc.poll() is not None:
+                    log.error("coordinator died: %s", e)
+                    return constants.EXIT_FAILURE
+                time.sleep(interval)
+                continue
+            tasks = report.get("tasks", [])
+            if tasks != self._last_task_infos:
+                self._last_task_infos = tasks
+                for lst in self.listeners:
+                    lst.on_task_infos_updated(tasks)
+            status = report.get("status", "")
+            if status in ("SUCCEEDED", "FAILED", "KILLED"):
+                for lst in self.listeners:
+                    lst.on_application_finished(status, report)
+                try:
+                    self._rpc.call("finish_application")
+                except Exception:  # noqa: BLE001
+                    pass
+                # Let the coordinator finalize events/history before we
+                # return (it tears down after the finish signal,
+                # reference stop() :670-688).
+                if self._coord_proc is not None:
+                    try:
+                        self._coord_proc.wait(timeout=30)
+                    except subprocess.TimeoutExpired:
+                        log.warning("coordinator slow to exit; killing")
+                if status != "SUCCEEDED" and report.get("failure_reason"):
+                    log.error("application %s: %s", status,
+                              report["failure_reason"])
+                return 0 if status == "SUCCEEDED" else constants.EXIT_FAILURE
+            time.sleep(interval)
+
+    def force_kill(self) -> None:
+        """Reference ``forceKillApplication`` :959 + the CLI kill-on-exit
+        shutdown hook (``ClusterSubmitter.java:69``)."""
+        try:
+            if self._rpc is not None:
+                self._rpc.call("kill_application")
+        except Exception:  # noqa: BLE001
+            pass
+        if self._coord_proc is not None and self._coord_proc.poll() is None:
+            try:
+                self._coord_proc.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                self._coord_proc.terminate()
+
+    def _cleanup(self) -> None:
+        if self._rpc is not None:
+            self._rpc.close()
+        if self._coord_proc is not None and self._coord_proc.poll() is None:
+            self._coord_proc.terminate()
+            try:
+                self._coord_proc.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                self._coord_proc.kill()
+
+    # -- introspection ---------------------------------------------------
+    @property
+    def task_infos(self) -> List[dict]:
+        return list(self._last_task_infos)

@@ -120,6 +120,15 @@ class TonyTpuConfig:
 
     # -- access -----------------------------------------------------------
     def set(self, name: str, value: Any) -> None:
+        if (name.startswith("tony.") and name not in K.registry()
+                and K.parse_job_key(name) is None):
+            # Arbitrary keys pass through (reference Hadoop Configuration
+            # semantics), but a tony.* key that matches nothing is almost
+            # always a typo — say so instead of silently ignoring it.
+            import logging
+            logging.getLogger(__name__).warning(
+                "config key %r matches no registered key or jobtype "
+                "pattern — possible typo (value kept as passthrough)", name)
         value = K.coerce(name, value)
         if K.is_multi_value(name) and self._conf.get(name):
             existing = str(self._conf[name])

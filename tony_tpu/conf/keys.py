@@ -196,6 +196,23 @@ PORTAL_PORT = _key(
     "tony.portal.port", 19886, int,
     "History web portal port (reference tony-portal Play app).")
 
+APPLICATION_EXECUTABLE = _key(
+    "tony.application.executable", "", str,
+    "User training script; jobtypes without an explicit command run "
+    "'<python> <executable> <task-params>' (reference "
+    "TonyClient.buildTaskCommand :454-475).")
+APPLICATION_TASK_PARAMS = _key(
+    "tony.application.task-params", "", str,
+    "Extra arguments appended to the default task command.")
+INTERNAL_BUNDLE_DIR = _key(
+    "tony.internal.bundle-dir", "", str,
+    "Set by the client at submit: staged src-dir bundle that executors "
+    "localize into each task working dir (reference HDFS localization, "
+    "LocalizableResource.java / Utils.extractResources :710-723).")
+INTERNAL_APP_ID = _key(
+    "tony.internal.app-id", "", str,
+    "Set by the client at submit: the application id.")
+
 # --- per-jobtype dynamic keys (reference TonyConfigurationKeys.java:171-239)
 INSTANCES_FORMAT = "tony.{job}.instances"
 COMMAND_FORMAT = "tony.{job}.command"
@@ -213,7 +230,7 @@ _JOB_KEY_RE: Pattern[str] = re.compile(
 
 _RESERVED_NON_JOB_SEGMENTS = {
     "application", "task", "coordinator", "client", "history", "tpu", "portal",
-    "keep-failed-task-dirs",
+    "keep-failed-task-dirs", "internal",
 }
 
 

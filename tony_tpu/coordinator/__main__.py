@@ -1,0 +1,65 @@
+"""Standalone coordinator process entrypoint.
+
+The reference's ApplicationMaster runs as its own JVM in a YARN container
+(``TonyClient`` builds the AM command, :710-729); here the client spawns
+``python -m tony_tpu.coordinator`` and discovers its RPC endpoint through an
+address file in the job dir (the analogue of the RM app report carrying the
+AM host:port, ``TonyClient.initRpcClientAndLogAMUrl`` :922).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import logging
+import os
+import sys
+
+from tony_tpu import constants
+from tony_tpu.cluster.local import LocalProcessBackend
+from tony_tpu.conf.config import TonyTpuConfig
+from tony_tpu.coordinator.coordinator import Coordinator
+from tony_tpu.coordinator.session import SessionStatus
+
+
+def main(argv=None) -> int:
+    logging.basicConfig(
+        level=logging.INFO,
+        format="%(asctime)s %(levelname)s %(name)s: %(message)s")
+    p = argparse.ArgumentParser(prog="tony-tpu-coordinator")
+    p.add_argument("--conf", required=True, help="frozen tony-final.json")
+    p.add_argument("--app-id", required=True)
+    p.add_argument("--history-root", required=True)
+    p.add_argument("--workdir", required=True,
+                   help="task working directories root")
+    p.add_argument("--addr-file", required=True,
+                   help="file to write 'host port token' for the client")
+    p.add_argument("--user", default="")
+    args = p.parse_args(argv)
+
+    conf = TonyTpuConfig.load_final(args.conf)
+    backend = LocalProcessBackend(args.workdir)
+    coord = Coordinator(conf, args.app_id, backend, args.history_root,
+                        user=args.user)
+    host, port = "", 0
+
+    # Start RPC before writing the address file so the client never dials a
+    # dead endpoint; Coordinator.run() starts it too (idempotent).
+    coord.rpc.start()
+    host, port = coord.rpc.address
+    tmp = args.addr_file + ".tmp"
+    with open(tmp, "w", encoding="utf-8") as f:
+        json.dump({"host": host, "port": port,
+                   "token": coord.rpc_token or ""}, f)
+    os.replace(tmp, args.addr_file)
+    try:
+        os.chmod(args.addr_file, 0o600)
+    except OSError:
+        pass
+
+    status = coord.run()
+    return 0 if status == SessionStatus.SUCCEEDED else constants.EXIT_FAILURE
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -105,6 +105,62 @@ def list_job_dirs(history_root: str) -> Dict[str, str]:
     return out
 
 
+@dataclasses.dataclass
+class JobRow:
+    """One row of the jobs index (portal jobs view / CLI history)."""
+
+    app_id: str
+    status: str
+    user: str
+    started_ms: int
+
+    @property
+    def started_iso(self) -> str:
+        return time.strftime("%Y-%m-%d %H:%M:%S",
+                             time.gmtime(self.started_ms / 1000.0))
+
+
+def list_jobs(history_root: str) -> List[JobRow]:
+    """Jobs index across intermediate + finished trees, newest first."""
+    rows: List[JobRow] = []
+    for app, job_dir in list_job_dirs(history_root).items():
+        hist = find_history_file(job_dir)
+        meta = parse_metadata(hist) if hist else None
+        if meta is None:
+            # Fall back to the in-progress file for running jobs.
+            for f in os.listdir(job_dir):
+                if f.endswith(constants.INPROGRESS_SUFFIX):
+                    meta = parse_metadata(
+                        f[: -len(constants.INPROGRESS_SUFFIX)]
+                        + constants.EVENTS_SUFFIX)
+                    break
+        if meta is None:
+            continue
+        rows.append(JobRow(app_id=app, status=meta.status, user=meta.user,
+                           started_ms=meta.started_ms))
+    rows.sort(key=lambda r: -r.started_ms)
+    return rows
+
+
+def read_job_events(history_root: str, app_id: str):
+    """Decoded event list for one job, or None if unknown
+    (reference ``ParserUtils.parseEvents`` :258-287)."""
+    from tony_tpu.events.events import read_events
+
+    job_dir = list_job_dirs(history_root).get(app_id)
+    if job_dir is None:
+        return None
+    hist = find_history_file(job_dir)
+    if hist is None:
+        for f in os.listdir(job_dir):
+            if f.endswith(constants.INPROGRESS_SUFFIX):
+                hist = os.path.join(job_dir, f)
+                break
+    if hist is None:
+        return None
+    return read_events(hist)
+
+
 class HistoryFileMover:
     """Move completed jobs intermediate → finished/yyyy/MM/dd
     (reference ``HistoryFileMover.java:74-121``; KILLED-rename behaviour for

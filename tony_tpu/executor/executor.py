@@ -130,11 +130,21 @@ class TaskExecutor:
         return procutil.poll_till_non_null(
             attempt, interval_s=0.3, timeout_s=timeout_s)
 
+    def _localize_bundle(self) -> None:
+        """Copy the staged job bundle into this task's working dir
+        (reference ``Utils.extractResources`` :710-723 unzipping the
+        HDFS-localized src/venv archives)."""
+        bundle = str(self.conf.get(K.INTERNAL_BUNDLE_DIR, "") or "")
+        if bundle and os.path.isdir(bundle):
+            import shutil
+            shutil.copytree(bundle, os.getcwd(), dirs_exist_ok=True)
+
     # -- run ------------------------------------------------------------
     def run(self) -> int:
         if not self.command:
             log.error("no task command configured for %s", self.task_id)
             return constants.EXIT_FAILURE
+        self._localize_bundle()
         self.setup_ports()
         hb = Heartbeater(
             self.client, self.task_id,
@@ -158,6 +168,16 @@ class TaskExecutor:
         me = TaskIdentity(self.job_name, self.index, self.task_num,
                           self.is_chief, self.rendezvous_port.port)
         env = runtime.build_env(cluster_spec, me, self.conf)
+        # Reference-compat aliases: user scripts written against the
+        # reference read bare names (Constants.java:104-110 env contract —
+        # JOB_NAME/TASK_INDEX/... without the TONY_ prefix).
+        env.update({
+            "JOB_NAME": self.job_name,
+            "TASK_INDEX": str(self.index),
+            "TASK_NUM": str(self.task_num),
+            "IS_CHIEF": "true" if self.is_chief else "false",
+            "SESSION_ID": str(self.session_id),
+        })
         if self.tb_port is not None:
             env[constants.TB_PORT] = str(self.tb_port.port)
 

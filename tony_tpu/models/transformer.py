@@ -71,11 +71,14 @@ def _dense(cfg: TransformerConfig, feats: int, axes, name: str) -> nn.Dense:
 
 def _sp_offset() -> jax.Array:
     """Shard index on the sp axis, or 0 when not under shard_map (init /
-    single-shard apply trace the model outside any mesh axis context)."""
-    try:
-        return jax.lax.axis_index("sp")
-    except NameError:
+    single-shard apply trace the model outside any mesh axis context). A
+    shard_map with a differently-named sequence axis raises instead of
+    silently restarting positions at 0 (see ops.ring.bound_axis_size)."""
+    from tony_tpu.ops.ring import bound_axis_size
+
+    if bound_axis_size("sp") is None:
         return jnp.zeros((), jnp.int32)
+    return jax.lax.axis_index("sp")
 
 
 def _rope(x: jax.Array, positions: jax.Array, theta: float) -> jax.Array:
@@ -185,6 +188,10 @@ class Transformer(nn.Module):
     @nn.compact
     def __call__(self, tokens, positions=None):
         cfg = self.cfg
+        if tokens.shape[1] > cfg.max_seq_len:
+            raise ValueError(
+                f"sequence length {tokens.shape[1]} exceeds max_seq_len "
+                f"{cfg.max_seq_len} (RoPE would extrapolate)")
         if positions is None:
             pos = jnp.arange(tokens.shape[1], dtype=jnp.int32)
             if cfg.attn_impl in ("ring", "ulysses"):

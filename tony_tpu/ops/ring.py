@@ -26,17 +26,39 @@ from jax import shard_map
 NEG_INF = -1e30
 
 
+def bound_axis_size(axis_name: str):
+    """Size of a bound mesh axis, None when NO axes are bound (init or
+    single-shard trace — callers fall back to local semantics), and a loud
+    NameError when other axes ARE bound but this one isn't (a misnamed axis
+    under shard_map must not silently degrade to shard-local attention)."""
+    try:
+        from jax._src import core
+
+        sizes = dict(getattr(core.get_axis_env(), "axis_sizes", {}) or {})
+    except Exception:  # private API moved: fall back to probing
+        try:
+            return jax.lax.psum(1, axis_name)
+        except NameError:
+            return None
+    if axis_name in sizes:
+        return jax.lax.psum(1, axis_name)
+    if sizes:
+        raise NameError(
+            f"axis {axis_name!r} is not bound under this shard_map; bound "
+            f"axes: {sorted(sizes)} — pass the right axis_name")
+    return None
+
+
 def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array,
                    axis_name: str = "sp", causal: bool = True,
                    scale: Optional[float] = None) -> jax.Array:
     """Per-shard ring attention ([B, S_local, H, D] in/out). Call inside
     shard_map with the sequence dim sharded over ``axis_name``."""
     b, s_loc, h, d = q.shape
-    try:
-        n = jax.lax.psum(1, axis_name)
-    except NameError:
-        # No bound axis (model init / single-shard apply): the "ring" is a
-        # single chunk — plain causal attention.
+    n = bound_axis_size(axis_name)
+    if n is None:
+        # No axes bound at all (model init / single-shard apply): the
+        # "ring" is a single chunk — plain causal attention.
         from tony_tpu.ops.attention import reference_attention
         return reference_attention(q, k, v, causal=causal, scale=scale)
     my = jax.lax.axis_index(axis_name)

@@ -29,10 +29,10 @@ def ulysses_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     inside shard_map. Requires both q and k/v head counts divisible by the
     axis size."""
 
-    try:
-        jax.lax.psum(1, axis_name)
-    except NameError:
-        # No bound axis (model init / single-shard apply): no swap needed.
+    from tony_tpu.ops.ring import bound_axis_size
+
+    if bound_axis_size(axis_name) is None:
+        # No axes bound at all (model init / single-shard apply): no swap.
         return flash_attention(q, k, v, causal=causal, scale=scale,
                                block_q=block_q, block_k=block_k)
 

@@ -131,6 +131,8 @@ class RpcServer:
         return self.address[1]
 
     def start(self) -> None:
+        if self._thread is not None:  # idempotent
+            return
         self._thread = threading.Thread(
             target=self._server.serve_forever, name="tony-rpc-server",
             daemon=True)
@@ -141,6 +143,10 @@ class RpcServer:
         self._server.server_close()
         if self._thread:
             self._thread.join(timeout=5)
+        # A stopped server cannot be restarted (socket closed); reset the
+        # idempotence guard so a future start() fails loudly in serve_forever
+        # rather than silently no-op'ing.
+        self._thread = None
 
 
 class RpcClient:
