@@ -1,0 +1,1 @@
+from tony_tpu.checkpoint.manager import CheckpointManager  # noqa: F401
