@@ -1,0 +1,10 @@
+"""Training-stage script: exits 0 only if the prepare stage's marker exists —
+i.e. the DAG scheduler really ordered db before dbloader."""
+import os
+import sys
+
+marker = os.environ.get("TONY_TEST_MARKER")
+if not marker or not os.path.exists(marker):
+    print(f"marker missing: {marker}", file=sys.stderr)
+    sys.exit(3)
+sys.exit(0)

@@ -1,0 +1,7 @@
+"""Fails in retry epoch 0, succeeds in epoch 1 — proves whole-job retry
+carries a bumped SESSION_ID into the relaunched tasks (reference AM reset
+``ApplicationMaster.java:356-371,559-575``)."""
+import os
+import sys
+
+sys.exit(1 if os.environ.get("SESSION_ID", "0") == "0" else 0)

@@ -1,0 +1,5 @@
+"""Sleeps 5 s then exits 0 (reference ``sleep_30.py`` analogue, scaled for
+test speed)."""
+import time
+
+time.sleep(5)

@@ -1,0 +1,15 @@
+"""Prepare-stage script: writes the marker file named by TONY_TEST_MARKER.
+
+Paired with check_marker_then_exit_0.py to prove staged-DAG ordering
+(reference db→dbloader scenario, ``TestTonyE2E.java:255-272``).
+"""
+import os
+import sys
+
+marker = os.environ.get("TONY_TEST_MARKER")
+if not marker:
+    print("TONY_TEST_MARKER not set", file=sys.stderr)
+    sys.exit(2)
+with open(marker, "w") as f:
+    f.write("prepared\n")
+sys.exit(0)

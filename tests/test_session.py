@@ -106,3 +106,22 @@ def test_session_id_epochs():
     """Reference sessionId retry epoch (TonySession.java:51)."""
     s = Session(make_conf(), session_id=2)
     assert all(t.session_id == 2 for t in s.all_tasks())
+
+
+def test_barrier_scoped_to_scheduled_jobs():
+    """Staged DAG: the barrier and spec cover only launched jobtypes
+    (reference TonySession.getNumExpectedTasks :193 — "scheduled at current
+    time"); later stages widen the barrier when they launch."""
+    conf = TonyTpuConfig({"tony.db.instances": 1,
+                          "tony.dbloader.instances": 1,
+                          "tony.dbloader.depends-on": "db"})
+    s = Session(conf)
+    s.mark_job_scheduled("db")  # narrows scope to launched gangs only
+    assert s.get_cluster_spec() is None
+    s.register_worker("db:0", "h0", 1000)
+    assert s.get_cluster_spec() == {"db": ["h0:1000"]}
+    s.mark_job_scheduled("dbloader")
+    assert s.get_cluster_spec() is None  # barrier widened to the new gang
+    s.register_worker("dbloader:0", "h1", 2000)
+    assert s.get_cluster_spec() == {"db": ["h0:1000"],
+                                    "dbloader": ["h1:2000"]}

@@ -149,6 +149,11 @@ class TonyTpuClient:
         coord_log.close()
         try:
             return self._monitor(addr_file)
+        except RuntimeError as e:
+            # Coordinator died before/while serving (reference returns -1
+            # from monitorApplication on a failed app report, :838-892).
+            log.error("submission failed: %s", e)
+            return constants.EXIT_FAILURE
         finally:
             self._cleanup()
 

@@ -61,6 +61,10 @@ JAX_PROCESS_ID = "JAX_PROCESS_ID"
 # TensorBoard (reference Constants.java TB_PORT; TaskExecutor.java:83-95)
 TB_PORT = "TB_PORT"
 
+# Shared checkpoint dir for the session-retry resume contract (no reference
+# analogue — checkpointing was user-code-only there, SURVEY.md §5).
+CHECKPOINT_DIR = "TONY_CHECKPOINT_DIR"
+
 # ---------------------------------------------------------------------------
 # Well-known job (task-type) names (reference Constants.java:104-110).
 # ---------------------------------------------------------------------------
@@ -100,6 +104,10 @@ TEST_EXECUTOR_SKEW = "TONY_TEST_EXECUTOR_SKEW"
 # "seconds" — delay the coordinator's completion handling (races the
 # heartbeat-unregister path; reference ApplicationMaster.java:1029-1038).
 TEST_COMPLETION_DELAY = "TONY_TEST_COMPLETION_DELAY"
+# any value — executor never registers (simulates an unreachable executor so
+# the coordinator-side registration timeout is exercisable E2E; reference
+# registration timeout, ApplicationMaster.java:791-888).
+TEST_SKIP_REGISTRATION = "TONY_TEST_SKIP_REGISTRATION"
 
 # Untracked jobtypes: run-forever tasks (parameter servers) whose exit does not
 # gate job completion (reference TonyConfigurationKeys.java:252-253).

@@ -47,15 +47,19 @@ def main(argv=None) -> int:
     # dead endpoint; Coordinator.run() starts it too (idempotent).
     coord.rpc.start()
     host, port = coord.rpc.address
+    # The file carries the RPC auth token: it must be 0600 from its very
+    # first byte, so open the temp file with O_EXCL|0600 before writing
+    # rather than chmod-ing after the rename.
     tmp = args.addr_file + ".tmp"
-    with open(tmp, "w", encoding="utf-8") as f:
+    try:
+        os.unlink(tmp)  # stale leftover from a crashed previous run
+    except FileNotFoundError:
+        pass
+    fd = os.open(tmp, os.O_WRONLY | os.O_CREAT | os.O_EXCL, 0o600)
+    with os.fdopen(fd, "w", encoding="utf-8") as f:
         json.dump({"host": host, "port": port,
                    "token": coord.rpc_token or ""}, f)
     os.replace(tmp, args.addr_file)
-    try:
-        os.chmod(args.addr_file, 0o600)
-    except OSError:
-        pass
 
     status = coord.run()
     return 0 if status == SessionStatus.SUCCEEDED else constants.EXIT_FAILURE

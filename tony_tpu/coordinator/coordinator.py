@@ -168,6 +168,9 @@ class Coordinator:
         }
         if self.rpc_token:
             env["TONY_RPC_TOKEN"] = self.rpc_token
+        ckpt_dir = str(self.conf.get(K.APPLICATION_CHECKPOINT_DIR, "") or "")
+        if ckpt_dir:
+            env[constants.CHECKPOINT_DIR] = ckpt_dir
         if self._final_conf_path:
             env[constants.EXECUTOR_CONF] = self._final_conf_path
         for kv in self.conf.get_list(K.EXECUTION_ENV):
@@ -179,6 +182,11 @@ class Coordinator:
 
     def _launch_job(self, job_name: str) -> None:
         job = self.session.jobs[job_name]
+        # Widen the rendezvous barrier to this gang BEFORE any instance can
+        # register, so a fast first instance never sees a spec missing its
+        # peers (reference adds numExpectedTasks at schedule time,
+        # ``TonySession.addNumExpectedTask`` :197).
+        self.session.mark_job_scheduled(job_name)
         for i in range(job.instances):
             task = self.session.get_task(f"{job_name}:{i}")
             if task is None or task.status != TaskStatus.NEW:
@@ -189,6 +197,10 @@ class Coordinator:
                 vcores=job.vcores, memory=job.memory, chips=job.chips,
                 node_pool=job.node_pool)
             task.handle = self.backend.launch_task(spec)
+            # Each gang launch restarts the registration-timeout clock; the
+            # timeout gates on launched-but-unregistered tasks (scoped like
+            # the barrier), so a long-running earlier DAG stage can't trip it.
+            self._schedule_start = time.monotonic()
             task.status = TaskStatus.SCHEDULED
             self.events.emit(Event(EventType.TASK_STARTED, {
                 "task": task.task_id, "session_id": self.session.session_id}))
@@ -287,6 +299,16 @@ class Coordinator:
             if all(x is not None and x.status == TaskStatus.SUCCEEDED
                    for x in done):
                 self.scheduler.register_job_completed(t.job_name)
+            elif t.status in (TaskStatus.FAILED, TaskStatus.KILLED) and \
+                    not self.scheduler.dependency_check_passed(t.job_name):
+                # A failed jobtype with unlaunched dependents can never let
+                # the DAG progress — fail now instead of waiting on tasks
+                # that will never be launched (reference monitor() DAG check,
+                # ``ApplicationMaster.java:581-650``).
+                self.session.fail(
+                    f"jobtype {t.job_name} failed with unlaunched dependent "
+                    f"jobtypes; DAG cannot make progress (task {task_id} "
+                    f"exit {exit_code})")
 
     def _check_heartbeats(self) -> None:
         """Liveness monitor (reference AbstractLivelinessMonitor usage
@@ -306,9 +328,11 @@ class Coordinator:
                       task_id, self._hb_expiry_s)
             if t.handle is not None:
                 self.backend.kill_task(t.handle, grace_s=0.0)
-            self.session.on_task_completed(task_id, constants.EXIT_KILLED)
+            # Fail first so the recorded reason is the liveness expiry, not
+            # the generic chief/worker-exit policy triggered by the kill.
             self.session.fail(f"task {task_id} deemed dead "
                               f"(missed heartbeats)")
+            self.session.on_task_completed(task_id, constants.EXIT_KILLED)
 
     # ------------------------------------------------------------------
     # Lifecycle
@@ -386,7 +410,7 @@ class Coordinator:
                 self.session.fail(f"application timed out after {timeout_s}s")
                 return self.session.status
             if not self.session.all_registered() and reg_timeout_s and \
-                    self.scheduler is not None and self.scheduler.all_scheduled \
+                    self.session.num_expected > 0 \
                     and (time.monotonic() - self._schedule_start
                          > reg_timeout_s):
                 # Gang rendezvous timed out (reference registration timeout

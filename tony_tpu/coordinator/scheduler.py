@@ -108,8 +108,12 @@ class GangScheduler:
         with self._lock:
             return self._scheduled == set(self.jobs)
 
-    def dependency_check_passed(self, session_failed_job: str) -> bool:
-        """False if a jobtype with dependents failed — the DAG can't make
-        progress (reference ``dependencyCheckPassed`` :43)."""
-        return all(session_failed_job not in deps
-                   for deps in self._deps.values())
+    def dependency_check_passed(self, failed_job: str) -> bool:
+        """False if `failed_job` blocks a jobtype that has not been launched
+        yet — the DAG can't make progress (reference ``dependencyCheckPassed``
+        :43; the AM monitor fails the job on this,
+        ``ApplicationMaster.java:581-650``). Already-scheduled dependents got
+        their launch before the failure and are judged on their own merits."""
+        with self._lock:
+            return all(failed_job not in deps or name in self._scheduled
+                       for name, deps in self._deps.items())

@@ -99,6 +99,15 @@ class Session:
                 self.tasks[t.task_id] = t
         self.status = SessionStatus.RUNNING
         self.failure_reason: Optional[str] = None
+        # Jobtypes whose gang has been handed to the backend. The rendezvous
+        # barrier and cluster spec cover exactly these (reference
+        # ``TonySession.getNumExpectedTasks`` :193 — "scheduled at current
+        # time"); a staged DAG must not make early-stage executors wait on
+        # jobtypes that haven't launched. Starts as ALL jobs so that direct
+        # Session use (unit tests, non-DAG paths) keeps whole-job barrier
+        # semantics; the coordinator narrows it before the first launch.
+        self.scheduled_jobs = set(self.jobs)
+        self._scheduling_narrowed = False
 
     # -- queries ----------------------------------------------------------
     def get_task(self, task_id: str) -> Optional[Task]:
@@ -117,9 +126,24 @@ class Session:
             return job_name == constants.CHIEF_JOB_NAME
         return job_name == constants.WORKER_JOB_NAME and index == 0
 
+    def mark_job_scheduled(self, job_name: str) -> None:
+        """Called by the coordinator before launching a gang. The first call
+        narrows the barrier scope from "all jobs" to "launched jobs" (staged
+        DAGs add later stages as they launch)."""
+        with self._lock:
+            if not self._scheduling_narrowed:
+                self.scheduled_jobs = set()
+                self._scheduling_narrowed = True
+            self.scheduled_jobs.add(job_name)
+
+    def _expected_tasks(self) -> List[Task]:
+        return [t for t in self.tasks.values()
+                if t.job_name in self.scheduled_jobs]
+
     @property
     def num_expected(self) -> int:
-        return len(self.tasks)
+        with self._lock:
+            return len(self._expected_tasks())
 
     @property
     def num_registered(self) -> int:
@@ -127,22 +151,28 @@ class Session:
             return sum(1 for t in self.tasks.values() if t.registered)
 
     def all_registered(self) -> bool:
-        return self.num_registered == self.num_expected
+        with self._lock:
+            expected = self._expected_tasks()
+            return bool(expected) and all(t.registered for t in expected)
 
     def get_cluster_spec(self) -> Optional[Dict[str, List[str]]]:
-        """{job: ["host:port", ...]} once ALL tasks registered, else None —
-        this None is the gang barrier the executors poll on (reference
-        ``ApplicationMaster.java:856-888`` returns null until every one of
-        numExpectedTasks has registered; spec built by
-        ``TonySession.getClusterSpec`` :226-246)."""
+        """{job: ["host:port", ...]} once all *scheduled* tasks registered,
+        else None — this None is the gang barrier the executors poll on
+        (reference ``ApplicationMaster.java:856-888`` returns null until every
+        one of numExpectedTasks has registered; spec built by
+        ``TonySession.getClusterSpec`` :226-246). Only jobs whose gang has
+        launched appear; later DAG stages join the spec when they launch."""
         with self._lock:
             if not self.all_registered():
                 return None
             spec: Dict[str, List[str]] = {}
             for job_name, job in self.jobs.items():
+                if job_name not in self.scheduled_jobs:
+                    continue
                 members = [self.tasks[f"{job_name}:{i}"].spec
                            for i in range(job.instances)]
-                spec[job_name] = members
+                if members:
+                    spec[job_name] = members
             return spec
 
     # -- mutations --------------------------------------------------------

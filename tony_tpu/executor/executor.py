@@ -117,6 +117,14 @@ class TaskExecutor:
         """The gang barrier (reference :295-309): re-register every 3 s until
         the coordinator returns the complete spec."""
         timeout_s = self.conf.get_int(K.TASK_REGISTRATION_TIMEOUT_S, 900)
+        if os.environ.get(constants.TEST_SKIP_REGISTRATION):
+            # Simulates an executor that never reaches the coordinator so the
+            # coordinator-side registration timeout can be exercised E2E
+            # (reference kills stuck allocations after the timeout,
+            # ``ApplicationMaster.java:791-888``).
+            log.warning("TEST hook: skipping registration; sleeping")
+            time.sleep(timeout_s * 4)
+            return None
 
         def attempt() -> Optional[dict]:
             try:
