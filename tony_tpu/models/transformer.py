@@ -43,6 +43,10 @@ class TransformerConfig:
     attn_impl: str = "flash"                 # flash | ring | ulysses | xla
     remat: bool = True
     tie_embeddings: bool = False
+    # LM-head matmul dtype; None → activation dtype (bf16 on TPU: the
+    # [dim, vocab] projection is ~20% of model FLOPs and f32 runs at half
+    # the MXU rate — loss softmax stays f32 downstream either way).
+    lm_head_dtype: Optional[jnp.dtype] = None
 
     @classmethod
     def llama3_8b(cls, **kw) -> "TransformerConfig":
@@ -188,9 +192,19 @@ class Transformer(nn.Module):
     @nn.compact
     def __call__(self, tokens, positions=None):
         cfg = self.cfg
-        if tokens.shape[1] > cfg.max_seq_len:
+        global_seq = tokens.shape[1]
+        if cfg.attn_impl in ("ring", "ulysses"):
+            # Under sequence-parallel shard_map this trace sees only the
+            # local chunk; the RoPE-extrapolation guard must apply to the
+            # GLOBAL sequence = local · sp-shards.
+            from tony_tpu.ops.ring import bound_axis_size
+
+            n_sp = bound_axis_size("sp")
+            if n_sp is not None:
+                global_seq = global_seq * n_sp
+        if global_seq > cfg.max_seq_len:
             raise ValueError(
-                f"sequence length {tokens.shape[1]} exceeds max_seq_len "
+                f"global sequence length {global_seq} exceeds max_seq_len "
                 f"{cfg.max_seq_len} (RoPE would extrapolate)")
         if positions is None:
             pos = jnp.arange(tokens.shape[1], dtype=jnp.int32)
@@ -213,17 +227,19 @@ class Transformer(nn.Module):
         for i in range(cfg.n_layers):
             x = block(cfg, name=f"layer_{i}")(x, positions)
         x = RMSNorm(cfg.norm_eps, cfg.param_dtype, name="final_norm")(x)
+        head_dtype = cfg.lm_head_dtype or cfg.dtype
         if cfg.tie_embeddings:
-            logits = jnp.einsum("bsd,vd->bsv", x.astype(jnp.float32),
-                                emb.astype(jnp.float32))
+            logits = jnp.einsum("bsd,vd->bsv", x.astype(head_dtype),
+                                emb.astype(head_dtype),
+                                preferred_element_type=jnp.float32)
         else:
             logits = nn.Dense(
-                cfg.vocab_size, use_bias=False, dtype=jnp.float32,
+                cfg.vocab_size, use_bias=False, dtype=head_dtype,
                 param_dtype=cfg.param_dtype, name="lm_head",
                 kernel_init=nn.with_logical_partitioning(
                     nn.initializers.lecun_normal(), ("embed", "vocab")))(
-                        x.astype(jnp.float32))
-        return logits
+                        x.astype(head_dtype))
+        return logits.astype(jnp.float32)
 
 
 def causal_lm_loss(logits: jax.Array, tokens: jax.Array,

@@ -39,6 +39,25 @@ def bound_axis_size(axis_name: str):
         try:
             return jax.lax.psum(1, axis_name)
         except NameError:
+            # The requested axis isn't bound — but another mesh axis might
+            # be, which would mean a *misnamed* axis, not an unsharded
+            # trace. Probe the standard mesh axes so that case still fails
+            # loudly instead of silently degrading to shard-local attention.
+            from tony_tpu.parallel.mesh import MESH_AXES
+
+            bound = []
+            for name in MESH_AXES:
+                if name == axis_name:
+                    continue
+                try:
+                    jax.lax.psum(1, name)
+                    bound.append(name)
+                except NameError:
+                    pass
+            if bound:
+                raise NameError(
+                    f"axis {axis_name!r} is not bound under this shard_map; "
+                    f"bound axes include: {bound} — pass the right axis_name")
             return None
     if axis_name in sizes:
         return jax.lax.psum(1, axis_name)
