@@ -1,0 +1,116 @@
+"""Pipeline parallelism on the virtual 8-device CPU mesh.
+
+Covers: exactness vs the sequential transformer oracle, per-stage parameter
+placement, gradient flow, and a full pp×dp train step (SURVEY.md §2.3 —
+PP is a first-class requirement with no reference analogue)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from tony_tpu.models import Transformer, TransformerConfig
+from tony_tpu.parallel import MeshSpec, build_mesh
+from tony_tpu.parallel.pipeline import (init_pipeline_params,
+                                        pipeline_forward, pipeline_loss,
+                                        pipeline_param_shardings)
+
+CFG = TransformerConfig.tiny(n_layers=4)
+
+
+def _plain_params_from_pipeline(params, n_layers):
+    """Map the stacked-blocks layout onto the sequential Transformer's
+    {layer_i: ...} naming so the oracle runs the SAME weights."""
+    plain = {
+        "embedding": params["embedding"],
+        "final_norm": {"scale": params["final_norm"]},
+        "lm_head": {"kernel": params["lm_head"]},
+    }
+    for i in range(n_layers):
+        plain[f"layer_{i}"] = jax.tree.map(lambda a, i=i: a[i],
+                                           params["blocks"])
+    return plain
+
+
+@pytest.fixture(scope="module")
+def mesh():
+    return build_mesh(MeshSpec(dp=2, pp=4))
+
+
+@pytest.fixture(scope="module")
+def params():
+    return init_pipeline_params(CFG, jax.random.key(0))
+
+
+def test_pipeline_matches_sequential(mesh, params):
+    tokens = jax.random.randint(jax.random.key(1), (4, 32), 0,
+                                CFG.vocab_size)
+    got = jax.jit(
+        lambda p, t: pipeline_forward(CFG, mesh, p, t, num_microbatches=2)
+    )(params, tokens)
+
+    import flax.linen as nn
+    from tony_tpu.parallel.sharding import DEFAULT_RULES
+
+    plain = _plain_params_from_pipeline(params, CFG.n_layers)
+    with nn.logical_axis_rules(list(DEFAULT_RULES)):
+        want = Transformer(CFG).apply({"params": plain}, tokens)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-4, atol=2e-4)
+
+
+def test_pipeline_stage_placement(mesh, params):
+    """Each pp member must hold exactly its contiguous n_layers/pp slice."""
+    sh = pipeline_param_shardings(mesh, params)
+    placed = jax.device_put(params, sh)
+    leaf = placed["blocks"]["attn"]["wq"]["kernel"]
+    assert leaf.shape[0] == CFG.n_layers
+    for shard in leaf.addressable_shards:
+        assert shard.data.shape[0] == CFG.n_layers // mesh.shape["pp"]
+    # embeddings replicated
+    assert placed["embedding"].sharding.is_fully_replicated
+
+
+def test_pipeline_microbatch_counts(mesh, params):
+    """Output must be microbatch-count invariant (same math, different
+    schedule lengths)."""
+    tokens = jax.random.randint(jax.random.key(2), (8, 16), 0,
+                                CFG.vocab_size)
+    a = jax.jit(lambda p, t: pipeline_forward(CFG, mesh, p, t, 1))(
+        params, tokens)
+    b = jax.jit(lambda p, t: pipeline_forward(CFG, mesh, p, t, 4))(
+        params, tokens)
+    np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=2e-4,
+                               atol=2e-4)
+
+
+def test_pipeline_train_step_improves_loss(mesh, params):
+    """Full pp×dp train step: grads flow through ppermute/scan; loss drops."""
+    sh = pipeline_param_shardings(mesh, params)
+    state = jax.device_put(params, sh)
+    tx = optax.adam(3e-3)
+    opt = tx.init(state)
+    tokens = jax.random.randint(jax.random.key(3), (8, 16), 0,
+                                CFG.vocab_size)
+
+    @jax.jit
+    def step(p, opt, t):
+        loss, g = jax.value_and_grad(
+            lambda p: pipeline_loss(CFG, mesh, p, t, num_microbatches=2))(p)
+        upd, opt = tx.update(g, opt, p)
+        return optax.apply_updates(p, upd), opt, loss
+
+    losses = []
+    for _ in range(5):
+        state, opt, loss = step(state, opt, tokens)
+        losses.append(float(loss))
+    assert all(jnp.isfinite(jnp.asarray(losses)))
+    assert losses[-1] < losses[0]
+
+
+def test_pipeline_rejects_indivisible_layers(mesh, params):
+    bad = TransformerConfig.tiny(n_layers=3)
+    with pytest.raises(ValueError, match="divisible"):
+        pipeline_forward(bad, mesh, params,
+                         jnp.zeros((4, 16), jnp.int32), 2)

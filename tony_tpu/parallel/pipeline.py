@@ -1,0 +1,187 @@
+"""Pipeline parallelism: GPipe-style microbatched schedule over the ``pp``
+mesh axis.
+
+Nothing to cite in the reference — TonY has no tensor/pipeline/sequence
+parallelism anywhere (SURVEY.md §2.3, verified absent); this is the genuinely
+new TPU-first work the blueprint requires.
+
+Design:
+- All transformer blocks' params are **stacked on a leading "stage" axis**
+  ``[n_layers, ...]`` sharded over ``pp`` (``DEFAULT_RULES`` maps
+  ``stage → pp``). With ``n_layers % pp == 0``, jax.sharding hands each
+  device a *contiguous* layer range — the classic stage assignment falls
+  out of array sharding, no bespoke placement code.
+- Inside ``shard_map`` each device scans its local ``[L/S, ...]`` params
+  over its resident activation (``lax.scan`` — compiled once, not unrolled).
+- The schedule is GPipe: split the local batch into M microbatches; at tick
+  t, stage 0 injects microbatch t, every stage applies its layers to its
+  resident activation, the last stage banks the finished microbatch
+  ``t-(S-1)``, and activations rotate to the next stage via a single
+  neighbour ``ppermute`` (pure ICI traffic; the ``pp`` axis is laid out so
+  neighbours share links — mesh.py axis order). Total ticks ``M + S - 1``,
+  bubble fraction ``(S-1)/(M+S-1)``.
+- Embedding and the LM head run *outside* the shard_map, auto-sharded by
+  jit like every other op. Composes with data parallelism: activations ride
+  in sharded over ``(dp, fsdp)`` and stay that way inside (the shard_map
+  covers those axes too, it just doesn't communicate over them).
+- Backward is plain autodiff: ``ppermute``'s transpose is the reverse
+  ppermute, so reverse-mode replays the schedule mirror-image — GPipe's
+  backward pass without writing one. Per-layer ``jax.checkpoint`` keeps
+  residency at O(activations · microbatch), not O(· full batch).
+
+1F1B would shave peak activation memory a further ~2× at equal bubble; GPipe
+was chosen because its loop body is a single uniform SPMD program (same code
+on every stage every tick — no per-stage control flow, which XLA can't
+diverge on anyway).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Dict, Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+from jax import lax, shard_map
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from tony_tpu.models.transformer import (Block, TransformerConfig,
+                                         causal_lm_loss)
+
+PP_AXIS = "pp"
+BATCH_AXES = ("dp", "fsdp")
+
+
+def init_pipeline_params(cfg: TransformerConfig, rng: jax.Array
+                         ) -> Dict[str, Any]:
+    """Params pytree with every block stacked on a leading stage axis:
+    ``{"embedding", "blocks"[n_layers, ...], "final_norm", "lm_head"}``."""
+    r_blocks, r_emb, r_head = jax.random.split(rng, 3)
+    dummy_x = jnp.zeros((1, 8, cfg.dim), cfg.dtype)
+    dummy_pos = jnp.zeros((1, 8), jnp.int32)
+    block = Block(cfg)
+
+    def init_one(r):
+        return nn.meta.unbox(block.init(r, dummy_x, dummy_pos))["params"]
+
+    blocks = jax.vmap(init_one)(jax.random.split(r_blocks, cfg.n_layers))
+    head_init = nn.initializers.lecun_normal()
+    return {
+        "embedding": (jax.random.normal(
+            r_emb, (cfg.vocab_size, cfg.dim), cfg.param_dtype) * 0.02),
+        "blocks": blocks,
+        "final_norm": jnp.ones((cfg.dim,), cfg.param_dtype),
+        "lm_head": head_init(r_head, (cfg.dim, cfg.vocab_size),
+                             cfg.param_dtype),
+    }
+
+
+def pipeline_param_shardings(mesh: Mesh, params: Dict[str, Any]
+                             ) -> Dict[str, Any]:
+    """Stacked blocks → leading axis over ``pp``; everything else replicated
+    (v1 — compose fsdp/tp sharding of the non-block leaves later)."""
+    return {
+        "embedding": NamedSharding(mesh, P()),
+        "blocks": jax.tree.map(
+            lambda _: NamedSharding(mesh, P(PP_AXIS)), params["blocks"]),
+        "final_norm": NamedSharding(mesh, P()),
+        "lm_head": NamedSharding(mesh, P()),
+    }
+
+
+def _stage_apply(cfg: TransformerConfig, stage_params: Any, x: jax.Array,
+                 positions: jax.Array) -> jax.Array:
+    """Apply this device's contiguous layer range ([L/S, ...] stacked)."""
+    block = Block(cfg)
+
+    def apply_one(p, h):
+        return block.apply({"params": p}, h, positions)
+
+    if cfg.remat:
+        apply_one = jax.checkpoint(apply_one, prevent_cse=False)
+
+    def body(h, layer_params):
+        return apply_one(layer_params, h), None
+
+    x, _ = lax.scan(body, x, stage_params)
+    return x
+
+
+def _pipeline_blocks(cfg: TransformerConfig, num_microbatches: int,
+                     blocks_local: Any, x: jax.Array,
+                     positions: jax.Array) -> jax.Array:
+    """Per-shard GPipe loop (runs inside shard_map over pp + batch axes).
+
+    ``x``: [B_local, S, D] embedded activations (replicated over pp);
+    ``blocks_local``: this stage's [L/S, ...] param stack.
+    """
+    n_stages = lax.psum(1, PP_AXIS)
+    stage = lax.axis_index(PP_AXIS)
+    m = num_microbatches
+    b_loc, seq, d = x.shape
+    mb = b_loc // m
+    mbs = x.reshape(m, mb, seq, d)
+    pos_mb = positions[:mb]
+
+    perm = [(i, (i + 1) % n_stages) for i in range(n_stages)]
+    state0 = jnp.zeros_like(mbs[0])
+    out0 = jnp.zeros_like(mbs)
+
+    def tick(carry, t):
+        state, out = carry
+        inject = lax.dynamic_index_in_dim(
+            mbs, jnp.clip(t, 0, m - 1), axis=0, keepdims=False)
+        state = jnp.where(stage == 0, inject, state)
+        state = _stage_apply(cfg, blocks_local, state, pos_mb)
+        done_idx = t - (n_stages - 1)
+        banked = lax.dynamic_update_index_in_dim(
+            out, state, jnp.clip(done_idx, 0, m - 1), axis=0)
+        out = jnp.where((stage == n_stages - 1) & (done_idx >= 0),
+                        banked, out)
+        state = lax.ppermute(state, PP_AXIS, perm)
+        return (state, out), None
+
+    (_, out), _ = lax.scan(tick, (state0, out0),
+                           jnp.arange(m + n_stages - 1))
+    # Only the last stage holds non-zero outputs; psum replicates them over
+    # pp so the head (outside the shard_map) sees a well-defined array.
+    out = lax.psum(out, PP_AXIS)
+    return out.reshape(b_loc, seq, d)
+
+
+def pipeline_forward(cfg: TransformerConfig, mesh: Mesh,
+                     params: Dict[str, Any], tokens: jax.Array,
+                     num_microbatches: int = 2) -> jax.Array:
+    """Causal-LM forward with the block stack pipelined over ``pp``.
+
+    tokens [B, S] (B sharded over dp·fsdp; B/(dp·fsdp) must divide evenly
+    into ``num_microbatches``) → logits [B, S, vocab] f32.
+    """
+    if cfg.n_layers % mesh.shape[PP_AXIS]:
+        raise ValueError(
+            f"n_layers={cfg.n_layers} not divisible by pp="
+            f"{mesh.shape[PP_AXIS]}")
+    if tokens.shape[1] > cfg.max_seq_len:
+        raise ValueError(f"seq {tokens.shape[1]} > max {cfg.max_seq_len}")
+    x = params["embedding"][tokens].astype(cfg.dtype)
+    positions = jnp.broadcast_to(
+        jnp.arange(tokens.shape[1], dtype=jnp.int32)[None, :], tokens.shape)
+
+    fn = functools.partial(_pipeline_blocks, cfg, num_microbatches)
+    x = shard_map(
+        fn, mesh=mesh,
+        in_specs=(P(PP_AXIS), P(BATCH_AXES), P(BATCH_AXES)),
+        out_specs=P(BATCH_AXES), check_vma=False,
+    )(params["blocks"], x, positions)
+
+    xf = x.astype(jnp.float32)
+    var = jnp.mean(jnp.square(xf), axis=-1, keepdims=True)
+    xf = xf * lax.rsqrt(var + cfg.norm_eps) * params["final_norm"]
+    return xf @ params["lm_head"].astype(jnp.float32)
+
+
+def pipeline_loss(cfg: TransformerConfig, mesh: Mesh, params: Dict[str, Any],
+                  tokens: jax.Array, num_microbatches: int = 2) -> jax.Array:
+    logits = pipeline_forward(cfg, mesh, params, tokens, num_microbatches)
+    return causal_lm_loss(logits, tokens)

@@ -35,6 +35,7 @@ DEFAULT_RULES: Tuple[Tuple[str, Any], ...] = (
     ("layers", None),
     ("stage", "pp"),
     ("expert", "ep"),
+    ("expert_logits", None),
     ("norm", None),
 )
 
