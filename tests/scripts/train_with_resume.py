@@ -1,0 +1,34 @@
+"""Checkpoint/resume contract script: trains 4 "steps" with saves, crashes
+mid-run in retry epoch 0, resumes from ``latest_step()`` in epoch 1.
+
+Writes "start end" step numbers to TONY_TEST_RESULT so the e2e can assert
+the second epoch RESUMED (start==2) instead of restarting (start==0).
+"""
+import os
+import sys
+
+import jax.numpy as jnp
+
+from tony_tpu.checkpoint import CheckpointManager
+
+ckpt_dir = os.environ["TONY_CHECKPOINT_DIR"]
+epoch = os.environ.get("SESSION_ID", "0")
+
+with CheckpointManager(ckpt_dir, async_save=False) as mgr:
+    state = {"step": jnp.zeros((), jnp.int32),
+             "w": jnp.arange(4, dtype=jnp.float32)}
+    latest = mgr.latest_step()
+    if latest is not None:
+        state = mgr.restore(latest, state)
+    start = int(state["step"])
+
+    for _ in range(start, 4):
+        state = {"step": state["step"] + 1, "w": state["w"] * 2.0}
+        mgr.save(int(state["step"]), state, force=True)
+        mgr.wait()
+        if int(state["step"]) == 2 and epoch == "0":
+            print("crashing after step 2 in epoch 0", file=sys.stderr)
+            os._exit(1)
+
+with open(os.environ["TONY_TEST_RESULT"], "w") as f:
+    f.write(f"{start} {int(state['step'])} {float(state['w'][1])}")

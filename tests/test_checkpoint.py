@@ -1,0 +1,93 @@
+"""Checkpoint manager: sharded roundtrip on the virtual mesh + policy.
+
+The reference has no checkpoint subsystem (SURVEY.md §5 — user-code only);
+the TPU framework owns one. The resume e2e lives in test_e2e_faults-style
+form at the bottom: crash mid-training, whole-job retry, restore from
+latest_step, total steps preserved (resume contract
+``checkpoint/manager.py`` docstring; reference retry semantics
+``ApplicationMaster.java:356-371``)."""
+
+import os
+import sys
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from tony_tpu.checkpoint import CheckpointManager
+from tony_tpu.models import Transformer, TransformerConfig
+from tony_tpu.parallel import MeshSpec, build_mesh, init_sharded_state
+
+
+def test_roundtrip_preserves_values_and_sharding(tmp_path):
+    mesh = build_mesh(MeshSpec(dp=2, fsdp=2, tp=2))
+    cfg = TransformerConfig.tiny()
+    model = Transformer(cfg)
+    tokens = jnp.zeros((4, 16), jnp.int32)
+    state, sh = init_sharded_state(model, tokens, optax.adamw(1e-3), mesh)
+    tree = {"step": state.step, "params": state.params}
+
+    with CheckpointManager(str(tmp_path / "ckpt"), async_save=False) as mgr:
+        assert mgr.latest_step() is None
+        assert mgr.save(0, tree, force=True)
+        restored = mgr.restore(0, tree)
+
+    a = jax.tree.leaves(tree)
+    b = jax.tree.leaves(restored)
+    for x, y in zip(a, b):
+        np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+        assert x.sharding == y.sharding  # re-laid-out onto the same mesh
+
+
+def test_latest_step_and_retention(tmp_path):
+    tree = {"w": jnp.arange(8.0)}
+    with CheckpointManager(str(tmp_path / "c"), max_to_keep=2,
+                           async_save=False) as mgr:
+        for s in (1, 2, 3):
+            mgr.save(s, {"w": tree["w"] * s}, force=True)
+        mgr.wait()
+        assert mgr.latest_step() == 3
+        restored = mgr.restore(None, tree)  # None → latest
+        np.testing.assert_array_equal(np.asarray(restored["w"]),
+                                      np.asarray(tree["w"] * 3))
+        # retention: step 1 was purged
+        steps = sorted(mgr._mgr.all_steps())
+        assert steps == [2, 3]
+        with pytest.raises(Exception):
+            mgr.restore(1, tree)
+
+
+def test_save_interval_policy(tmp_path):
+    tree = {"w": jnp.zeros(4)}
+    with CheckpointManager(str(tmp_path / "c"), save_interval_steps=5,
+                           async_save=False) as mgr:
+        assert mgr.save(0, tree)
+        assert not mgr.save(2, tree)   # skipped by policy
+        assert mgr.save(5, tree)
+        assert mgr.save(7, tree, force=True)  # force overrides
+
+
+def test_e2e_crash_resume_with_session_retry(tmp_path):
+    """Kill training mid-run (epoch 0 exits 1 after step 2), whole-job
+    retry relaunches with SESSION_ID=1, script restores from latest_step()
+    and finishes steps 3..4 — start step proves resume, w value proves the
+    restored tensor contents."""
+    from tony_tpu.conf import keys as K
+
+    from test_e2e import SCRIPTS, _dump_task_logs, make_conf, submit
+
+    result = tmp_path / "result.txt"
+    conf = make_conf(tmp_path, "train_with_resume.py", workers=1, extra={
+        K.APPLICATION_RETRY_COUNT: 1,
+        K.APPLICATION_CHECKPOINT_DIR: str(tmp_path / "ckpt"),
+    })
+    conf.set(K.EXECUTION_ENV, f"TONY_TEST_RESULT={result}")
+    client, rec, code = submit(conf, tmp_path)
+    assert code == 0, _dump_task_logs(client)
+    start, end, w1 = result.read_text().split()
+    assert (int(start), int(end)) == (2, 4), \
+        f"epoch 1 should resume at step 2 and finish at 4, got {start}..{end}"
+    # w starts [0,1,2,3]; doubled once per step → w[1] == 1·2⁴
+    assert float(w1) == 16.0

@@ -53,6 +53,12 @@ APPLICATION_RETRY_COUNT = _key(
     "tony.application.retry-count", 0, int,
     "Coordinator-level whole-job retries (reference tony.am.retry-count, "
     "ApplicationMaster.java:356-371).")
+APPLICATION_CHECKPOINT_DIR = _key(
+    "tony.application.checkpoint-dir", "", str,
+    "Shared checkpoint directory exported to every task as "
+    "TONY_CHECKPOINT_DIR; with whole-job retry, user scripts restore from "
+    "CheckpointManager.latest_step() there to resume across session epochs "
+    "(the reference leaves this wholly to user code — SURVEY.md §5).")
 APPLICATION_PREPARE_STAGE = _key(
     "tony.application.prepare-stage", "", str,
     "Comma list of jobtypes forming the prepare stage of the DAG "
