@@ -1,0 +1,21 @@
+"""Asserts the SRC[::NAME][#archive] localization contract in the task
+working dir (reference ``check_archive_file_localization.py`` +
+``TestTonyE2E.java:322-340``): a renamed plain file, an unpacked archive
+directory, and the venv marker."""
+import os
+import sys
+
+failures = []
+if not os.path.isfile("renamed.txt"):
+    failures.append("renamed.txt missing (::NAME localization)")
+elif open("renamed.txt").read().strip() != "plain-resource":
+    failures.append("renamed.txt has wrong contents")
+if not os.path.isdir("bundle.zip"):
+    failures.append("bundle.zip dir missing (#archive localization)")
+elif not os.path.isfile(os.path.join("bundle.zip", "inner.txt")):
+    failures.append("bundle.zip/inner.txt missing after unpack")
+if not os.path.isfile(os.path.join("venv", "marker.txt")):
+    failures.append("venv/marker.txt missing (python-venv staging)")
+if failures:
+    print("\n".join(failures), file=sys.stderr)
+    sys.exit(4)

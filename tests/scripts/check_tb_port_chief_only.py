@@ -1,0 +1,11 @@
+"""TB_PORT must be set iff this task is the chief (reference
+``check_tb_port_set_in_chief_only.py``)."""
+import os
+import sys
+
+tb_port = os.environ.get("TB_PORT")
+is_chief = os.environ["IS_CHIEF"] == "true"
+print(f"TB_PORT={tb_port} IS_CHIEF={is_chief}")
+if bool(tb_port) != is_chief:
+    print("TB_PORT presence does not match chief-ness", file=sys.stderr)
+    sys.exit(5)

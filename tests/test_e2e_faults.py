@@ -170,3 +170,24 @@ def test_e2e_chief_plus_worker_gang(tmp_path):
     final = {f"{t['name']}:{t['index']}": t["status"] for t in rec.updates[-1]}
     assert final == {"chief:0": "SUCCEEDED", "worker:0": "SUCCEEDED",
                      "worker:1": "SUCCEEDED"}
+
+
+def test_e2e_tb_port_chief_only_and_tb_launch(tmp_path):
+    """TB_PORT is exported to the chief only (reference
+    ``check_tb_port_set_in_chief_only.py``); the configured tensorboard
+    command runs on the chief with that port; the TB URL reaches the
+    client's application report."""
+    marker = tmp_path / "tb-marker.txt"
+    conf = make_conf(tmp_path, "check_tb_port_chief_only.py", workers=2)
+    conf.set("tony.chief.instances", 1)
+    conf.set("tony.chief.command",
+             f"{sys.executable} "
+             f"{os.path.join(SCRIPTS, 'check_tb_port_chief_only.py')}")
+    conf.set(K.APPLICATION_TENSORBOARD_COMMAND,
+             f'sh -c "echo $TB_PORT > {marker}"')
+    client, rec, code = submit(conf, tmp_path)
+    assert code == 0, _dump_task_logs(client)
+    assert marker.exists(), "tensorboard command did not run on the chief"
+    port = marker.read_text().strip()
+    assert port.isdigit()
+    assert rec.finished[1].get("tb_url", "").endswith(f":{port}")

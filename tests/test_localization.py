@@ -1,0 +1,106 @@
+"""Localization: the SRC[::NAME][#archive] grammar, staging, and the e2e
+contract (reference ``LocalizableResource.java:20-30,75-102``,
+``TestTonyE2E.java:322-340``, venv staging ``TonyClient.java:189-228``)."""
+
+import os
+import zipfile
+
+import pytest
+
+from tony_tpu.conf import keys as K
+from tony_tpu.utils.localize import (LocalizableResource, localize_resources,
+                                     stage_resources)
+
+from test_e2e import _dump_task_logs, make_conf, submit
+
+
+# -- grammar ---------------------------------------------------------------
+@pytest.mark.parametrize("spec,source,name,archive", [
+    ("/a/b/data.txt", "/a/b/data.txt", "data.txt", False),
+    ("/a/b/data.txt::renamed.bin", "/a/b/data.txt", "renamed.bin", False),
+    ("/a/b/model.zip#archive", "/a/b/model.zip", "model.zip", True),
+    ("/a/b/model.zip::m#archive", "/a/b/model.zip", "m", True),
+    ("rel/path.txt", "rel/path.txt", "path.txt", False),
+])
+def test_parse_grammar(spec, source, name, archive):
+    r = LocalizableResource.parse(spec)
+    assert (r.source, r.name, r.archive) == (source, name, archive)
+    # round-trip
+    r2 = LocalizableResource.parse(r.unparse())
+    assert r2 == r
+
+
+@pytest.mark.parametrize("bad", ["a::b::c", "", "::x"])
+def test_parse_rejects(bad):
+    with pytest.raises(ValueError):
+        LocalizableResource.parse(bad)
+
+
+# -- stage + localize roundtrip -------------------------------------------
+def test_stage_and_localize_roundtrip(tmp_path):
+    src = tmp_path / "f.txt"
+    src.write_text("hello")
+    archive = tmp_path / "ar.zip"
+    with zipfile.ZipFile(archive, "w") as z:
+        z.writestr("inside/x.txt", "zipped")
+    staged = stage_resources(
+        [f"{src}::conf.txt", f"{archive}#archive"],
+        str(tmp_path / "stage"))
+    # staging rewrote sources but preserved annotations
+    assert staged[0].endswith("::conf.txt")
+    assert staged[1].endswith("#archive")
+    work = tmp_path / "task"
+    work.mkdir()
+    placed = localize_resources(staged, str(work))
+    assert (work / "conf.txt").read_text() == "hello"
+    assert (work / "ar.zip" / "inside" / "x.txt").read_text() == "zipped"
+    assert len(placed) == 2
+
+
+def test_stage_missing_source_raises(tmp_path):
+    with pytest.raises(FileNotFoundError):
+        stage_resources(["/does/not/exist.txt"], str(tmp_path))
+
+
+# -- e2e -------------------------------------------------------------------
+def test_e2e_resource_and_venv_localization(tmp_path):
+    """Reference ``TestTonyE2E.java:322-340``: renamed file + archive,
+    plus the venv archive unpacked to ./venv in the task workdir."""
+    plain = tmp_path / "plain.txt"
+    plain.write_text("plain-resource\n")
+    archive = tmp_path / "bundle.zip"
+    with zipfile.ZipFile(archive, "w") as z:
+        z.writestr("inner.txt", "inner")
+    venv = tmp_path / "venv.zip"
+    with zipfile.ZipFile(venv, "w") as z:
+        z.writestr("marker.txt", "venv-marker")
+
+    conf = make_conf(tmp_path, "check_localized_resources.py", workers=1,
+                     extra={
+                         K.CONTAINER_RESOURCES:
+                             f"{plain}::renamed.txt,{archive}#archive",
+                         K.PYTHON_VENV: str(venv),
+                     })
+    client, rec, code = submit(conf, tmp_path)
+    assert code == 0, _dump_task_logs(client)
+
+
+def test_default_command_uses_venv_python(tmp_path):
+    """With a venv staged, jobtypes without a command get the venv
+    interpreter (reference ``buildTaskCommand`` :454-475)."""
+    from tony_tpu.client import TonyTpuClient
+    from tony_tpu.conf.config import TonyTpuConfig
+
+    venv = tmp_path / "venv.zip"
+    with zipfile.ZipFile(venv, "w") as z:
+        z.writestr("bin/python3", "#!/bin/sh\n")
+    conf = TonyTpuConfig({
+        "tony.worker.instances": 1,
+        K.APPLICATION_EXECUTABLE: "train.py",
+        K.PYTHON_VENV: str(venv),
+        K.PYTHON_BINARY_PATH: "bin/python3",
+    })
+    client = TonyTpuClient(conf, workdir=str(tmp_path / "w"))
+    client._build_default_commands()
+    assert conf.get(K.COMMAND_FORMAT.format(job="worker")) == \
+        os.path.join("venv", "bin", "python3") + " train.py"

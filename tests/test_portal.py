@@ -1,0 +1,93 @@
+"""Portal: the four reference routes served for a finished job
+(``tony-portal/conf/routes:1-5``), plus the mover/purger background story."""
+
+import json
+import time
+import urllib.request
+
+import pytest
+
+from tony_tpu.conf import keys as K
+from tony_tpu.events import history
+from tony_tpu.portal import PortalServer
+
+from test_e2e import SCRIPTS, make_conf, submit  # noqa: F401
+
+
+@pytest.fixture(scope="module")
+def finished_job(tmp_path_factory):
+    """Run one real job to completion so the portal has authentic history."""
+    tmp_path = tmp_path_factory.mktemp("portal-job")
+    conf = make_conf(tmp_path, "exit_0.py", workers=2)
+    client, rec, code = submit(conf, tmp_path)
+    assert code == 0
+    return str(tmp_path / "history"), rec.app_id
+
+
+@pytest.fixture(scope="module")
+def portal(finished_job):
+    root, _ = finished_job
+    srv = PortalServer(root, port=0, mover_interval_s=3600,
+                       purger_interval_s=3600)
+    srv.start()
+    yield srv
+    srv.stop()
+
+
+def _get(url, as_json=True):
+    with urllib.request.urlopen(url, timeout=10) as r:
+        data = r.read()
+    return json.loads(data) if as_json else data.decode()
+
+
+def test_jobs_index(portal, finished_job):
+    _, app_id = finished_job
+    rows = _get(f"{portal.url}/?format=json")
+    assert any(r["app_id"] == app_id and r["status"] == "SUCCEEDED"
+               for r in rows)
+    html_page = _get(portal.url + "/", as_json=False)
+    assert app_id in html_page
+
+
+def test_config_view(portal, finished_job):
+    _, app_id = finished_job
+    conf = _get(f"{portal.url}/config/{app_id}?format=json")
+    assert conf["tony.worker.instances"] == 2
+    assert "tony.worker.command" in conf
+
+
+def test_events_view(portal, finished_job):
+    _, app_id = finished_job
+    evs = _get(f"{portal.url}/jobs/{app_id}?format=json")
+    types = [e["type"] for e in evs]
+    assert types[0] == "APPLICATION_INITED"
+    assert types[-1] == "APPLICATION_FINISHED"
+    assert types.count("TASK_FINISHED") == 2
+
+
+def test_logs_view_and_logfile(portal, finished_job):
+    _, app_id = finished_job
+    logs = _get(f"{portal.url}/logs/{app_id}?format=json")
+    assert len(logs) == 4  # 2 tasks x (stdout, stderr)
+    body = _get(portal.url + logs[0]["url"], as_json=False)
+    assert isinstance(body, str)
+
+
+def test_unknown_job_404(portal):
+    with pytest.raises(urllib.error.HTTPError) as e:
+        _get(f"{portal.url}/jobs/nope?format=json")
+    assert e.value.code == 404
+
+
+def test_mover_then_views_still_work(portal, finished_job):
+    """After the mover relocates the job to finished/yyyy/MM/dd, every view
+    must keep resolving it (reference HistoryFileMover.java:74-121)."""
+    root, app_id = finished_job
+    moved = history.HistoryFileMover(root).move_once()
+    assert moved, "mover should have relocated the finished job"
+    # cache may hold the old dir for config; events go through list_job_dirs
+    portal.cache._data.clear()
+    rows = _get(f"{portal.url}/?format=json")
+    assert any(r["app_id"] == app_id for r in rows)
+    conf = _get(f"{portal.url}/config/{app_id}?format=json")
+    assert conf["tony.worker.instances"] == 2

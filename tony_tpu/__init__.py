@@ -28,3 +28,9 @@ __version__ = "0.1.0"
 
 from tony_tpu import constants  # noqa: F401
 from tony_tpu.conf.config import TonyTpuConfig  # noqa: F401
+
+# Inside a task (TONY_METRICS_FILE set by the executor) a bare import is
+# enough to start the HBM telemetry reporter; no-op everywhere else.
+from tony_tpu import telemetry as _telemetry  # noqa: E402
+
+_telemetry.maybe_start()

@@ -85,7 +85,23 @@ class TonyTpuClient:
         params = str(self.conf.get(K.APPLICATION_TASK_PARAMS, "") or "")
         python = str(self.conf.get(K.PYTHON_BINARY_PATH, "") or "") \
             or sys.executable
-        for job in self.conf.job_types().values():
+        if str(self.conf.get(K.PYTHON_VENV, "") or "") and \
+                not os.path.isabs(python):
+            # The venv archive is unpacked to ./venv in every task workdir;
+            # a relative interpreter resolves inside it (reference
+            # ``TonyClient.buildTaskCommand`` venv interpreter :454-475).
+            python = os.path.join("venv", python)
+        jobs = self.conf.job_types()
+        if not jobs and executable and \
+                not str(self.conf.get(K.COORDINATOR_COMMAND, "") or ""):
+            # Zero jobtypes → single-node mode: the coordinator runs the
+            # command itself (reference ApplicationMaster.java:714).
+            cmd = f"{python} {executable}"
+            if params:
+                cmd += f" {params}"
+            self.conf.set(K.COORDINATOR_COMMAND, cmd)
+            return
+        for job in jobs.values():
             if job.command:
                 continue
             if not executable:
@@ -98,17 +114,32 @@ class TonyTpuClient:
             self.conf.set(K.COMMAND_FORMAT.format(job=job.name), cmd)
 
     def _stage_bundle(self) -> None:
-        """Copy src-dir into the job dir (the HDFS-upload analogue,
-        ``processFinalTonyConf`` :189-228); executors localize it into each
-        task working dir."""
+        """Copy src-dir, container resources, and the python venv into the
+        job dir (the HDFS-upload analogue, ``processFinalTonyConf``
+        :189-228); executors localize them into each task working dir."""
         src = str(self.conf.get(K.SRC_DIR, "") or "")
-        if not src:
-            return
-        if not os.path.isdir(src):
-            raise ConfigError(f"{K.SRC_DIR}={src!r} is not a directory")
-        bundle = os.path.join(self.job_dir, "bundle")
-        shutil.copytree(src, bundle, dirs_exist_ok=True)
-        self.conf.set(K.INTERNAL_BUNDLE_DIR, bundle)
+        if src:
+            if not os.path.isdir(src):
+                raise ConfigError(f"{K.SRC_DIR}={src!r} is not a directory")
+            bundle = os.path.join(self.job_dir, "bundle")
+            shutil.copytree(src, bundle, dirs_exist_ok=True)
+            self.conf.set(K.INTERNAL_BUNDLE_DIR, bundle)
+        resources = self.conf.get_list(K.CONTAINER_RESOURCES)
+        if resources:
+            from tony_tpu.utils.localize import stage_resources
+
+            staged = stage_resources(
+                resources, os.path.join(self.job_dir, "resources"))
+            self.conf.set(K.INTERNAL_RESOURCES, ",".join(staged))
+        venv = str(self.conf.get(K.PYTHON_VENV, "") or "")
+        if venv:
+            if not os.path.isfile(venv):
+                raise ConfigError(
+                    f"{K.PYTHON_VENV}={venv!r} is not an archive file")
+            staged_venv = os.path.join(self.job_dir,
+                                       os.path.basename(venv))
+            shutil.copy2(venv, staged_venv)
+            self.conf.set(K.INTERNAL_VENV, staged_venv)
 
     # -- lifecycle -------------------------------------------------------
     def start(self) -> int:

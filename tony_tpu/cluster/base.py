@@ -54,5 +54,11 @@ class Backend(abc.ABC):
         without reporting their own exit over RPC.
         """
 
+    def task_log_paths(self, task_id: str) -> Optional[Tuple[str, str]]:
+        """(stdout, stderr) paths/URLs for a task, if the backend captures
+        them (the reference surfaces NodeManager log URLs per container,
+        ``models/JobLog.java:69-80``)."""
+        return None
+
     def stop(self) -> None:
         """Release backend resources."""

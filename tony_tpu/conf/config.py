@@ -205,9 +205,14 @@ class TonyTpuConfig:
         """Quota + sanity checks (reference ``TonyClient.validateTonyConf``
         :598-667: instance and resource quota enforcement at submit time)."""
         jobs = self.job_types()
-        if not jobs:
+        if not jobs and not str(self.get(K.COORDINATOR_COMMAND, "") or "") \
+                and not str(self.get(K.APPLICATION_EXECUTABLE, "") or ""):
+            # Zero jobtypes is legal only for single-node mode, where the
+            # coordinator itself runs the command (reference
+            # ApplicationMaster.java:714 single-node path).
             raise ConfigError(
-                "no jobtypes configured: set tony.<job>.instances >= 1")
+                "no jobtypes configured: set tony.<job>.instances >= 1 "
+                "(or a coordinator-local command for single-node mode)")
         total_instances = sum(j.instances for j in jobs.values())
         max_total = self.get_int(K.MAX_TOTAL_INSTANCES, -1)
         if max_total >= 0 and total_instances > max_total:

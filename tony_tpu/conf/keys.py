@@ -53,6 +53,25 @@ APPLICATION_RETRY_COUNT = _key(
     "tony.application.retry-count", 0, int,
     "Coordinator-level whole-job retries (reference tony.am.retry-count, "
     "ApplicationMaster.java:356-371).")
+APPLICATION_ENABLE_PREPROCESS = _key(
+    "tony.application.enable-preprocess", False, bool,
+    "Run the coordinator-local command as a preprocessing stage before "
+    "scheduling any gang (reference tony.application.enable-preprocess, "
+    "ApplicationMaster.doPreprocessingJob :714-766).")
+COORDINATOR_COMMAND = _key(
+    "tony.coordinator.command", "", str,
+    "Command the coordinator runs in-process: the preprocessing stage when "
+    "enable-preprocess is set, or the whole job in single-node mode (no "
+    "jobtypes configured). Reference AM-local execution, "
+    "ApplicationMaster.java:714.")
+APPLICATION_TENSORBOARD_COMMAND = _key(
+    "tony.application.tensorboard-command", "", str,
+    "Command the CHIEF executor spawns alongside its user process with "
+    "TB_PORT exported (e.g. 'tensorboard --logdir ... --port $TB_PORT'); "
+    "killed when the task ends. The chief's TB URL is registered with the "
+    "coordinator either way (reference TaskExecutor.java:311-319, "
+    "ApplicationMaster.java:935-951; launching TB was user-script territory "
+    "in the reference examples).")
 APPLICATION_CHECKPOINT_DIR = _key(
     "tony.application.checkpoint-dir", "", str,
     "Shared checkpoint directory exported to every task as "
@@ -218,6 +237,15 @@ INTERNAL_BUNDLE_DIR = _key(
 INTERNAL_APP_ID = _key(
     "tony.internal.app-id", "", str,
     "Set by the client at submit: the application id.")
+INTERNAL_RESOURCES = _key(
+    "tony.internal.resources", "", str,
+    "Set by the client at submit: staged SRC[::NAME][#archive] specs for "
+    "executors to localize (reference LocalizableResource grammar).",
+    multi_value=True)
+INTERNAL_VENV = _key(
+    "tony.internal.venv", "", str,
+    "Set by the client at submit: staged python-venv archive, unpacked to "
+    "./venv in every task working dir (reference TonyClient.java:189-228).")
 
 # --- per-jobtype dynamic keys (reference TonyConfigurationKeys.java:171-239)
 INSTANCES_FORMAT = "tony.{job}.instances"

@@ -21,6 +21,9 @@ APP_ID = "TONY_APP_ID"                # application id
 COORDINATOR_HOST = "TONY_COORDINATOR_HOST"
 COORDINATOR_PORT = "TONY_COORDINATOR_PORT"
 METRICS_PORT = "TONY_METRICS_PORT"    # metrics RPC port on the coordinator
+# File the user process's telemetry reporter writes device stats to; the
+# TaskMonitor tails it (set by the executor; see tony_tpu/telemetry.py).
+METRICS_FILE = "TONY_METRICS_FILE"
 TASK_ID = "TONY_TASK_ID"              # "<jobtype>:<index>"
 TASK_COMMAND = "TONY_TASK_COMMAND"    # user command for this task
 EXECUTOR_CONF = "TONY_EXECUTOR_CONF"  # path to the frozen final config
@@ -35,6 +38,9 @@ GLOBAL_WORLD = "TONY_GLOBAL_WORLD"
 # ---------------------------------------------------------------------------
 TF_CONFIG = "TF_CONFIG"
 CLUSTER_SPEC = "CLUSTER_SPEC"
+# This task's own reserved rendezvous port (generic servers — notebooks,
+# Ray heads — bind it; released to the user process before exec).
+TASK_PORT = "TASK_PORT"
 
 # PyTorch (reference Constants.java:50-54)
 INIT_METHOD = "INIT_METHOD"

@@ -287,10 +287,12 @@ class Coordinator:
         if t is None or t.status.terminal:
             return
         self.session.on_task_completed(task_id, exit_code)
+        logs = self.backend.task_log_paths(task_id)
         self.events.emit(Event(EventType.TASK_FINISHED, {
             "task": task_id, "exit_code": exit_code,
             "status": t.status.value,
             "metrics": self.metrics_store.get(task_id, {}),
+            "logs": list(logs) if logs else [],
             "session_id": self.session.session_id}))
         if self.scheduler is not None and t.tracked:
             job = self.session.jobs[t.job_name]
@@ -360,6 +362,21 @@ class Coordinator:
         retries = self.conf.get_int(K.APPLICATION_RETRY_COUNT, 0)
         attempt = 0
         try:
+            local_cmd = str(self.conf.get(K.COORDINATOR_COMMAND, "") or "")
+            single_node = not self.session.tasks
+            if local_cmd and (single_node or self.conf.get_bool(
+                    K.APPLICATION_ENABLE_PREPROCESS)):
+                # Preprocess / single-node path: run the command in the
+                # coordinator (reference ``doPreprocessingJob`` :714-766 —
+                # short-circuit the job if it fails).
+                code = self._do_local_job(local_cmd, register_tb=single_node)
+                if code != 0:
+                    self.session.fail(
+                        f"coordinator-local job failed (exit {code})")
+                    return self.final_status
+                if single_node:
+                    self.session.status = SessionStatus.SUCCEEDED
+                    return self.final_status
             while True:
                 self._start_session(attempt)
                 status = self._monitor()
@@ -378,6 +395,43 @@ class Coordinator:
                 self.final_status = SessionStatus.KILLED
             self._stop()
         return self.final_status
+
+    def _do_local_job(self, cmd: str, register_tb: bool) -> int:
+        """Run a command in the coordinator process (single-node/preprocess
+        mode, reference ``ApplicationMaster.doPreprocessingJob`` :714-766):
+        TB port registered for single-node, HOME pinned to the job dir for
+        notebook-style servers, exit code short-circuits the job."""
+        from tony_tpu.executor.ports import ReservedPort
+        from tony_tpu.utils import proc as procutil
+
+        env = dict(os.environ)
+        env.update({
+            constants.APP_ID: self.app_id,
+            constants.JOB_NAME: "coordinator",
+            constants.TASK_INDEX: "0",
+            "HOME": self.job_dir,
+            "PREPROCESSING_JOB": "true",
+        })
+        if register_tb:
+            tb = ReservedPort(reuse=False)
+            import socket as _socket
+            self.tb_url = f"http://{_socket.gethostname()}:{tb.port}"
+            env[constants.TB_PORT] = str(tb.port)
+            tb.release()
+        for kv in self.conf.get_list(K.EXECUTION_ENV):
+            if "=" in kv:
+                k, v = kv.split("=", 1)
+                env[k] = v
+        self.events.emit(Event(EventType.TASK_STARTED, {
+            "task": "coordinator:0", "session_id": 0}))
+        code = procutil.execute_shell(
+            cmd, timeout_s=self.conf.get_int(
+                K.TASK_EXECUTOR_EXECUTION_TIMEOUT_S, 0), env=env)
+        self.events.emit(Event(EventType.TASK_FINISHED, {
+            "task": "coordinator:0", "exit_code": code,
+            "status": "SUCCEEDED" if code == 0 else "FAILED",
+            "metrics": {}, "logs": [], "session_id": 0}))
+        return code
 
     def _start_session(self, attempt: int) -> None:
         if attempt > 0:

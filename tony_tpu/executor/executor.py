@@ -139,13 +139,34 @@ class TaskExecutor:
             attempt, interval_s=0.3, timeout_s=timeout_s)
 
     def _localize_bundle(self) -> None:
-        """Copy the staged job bundle into this task's working dir
-        (reference ``Utils.extractResources`` :710-723 unzipping the
-        HDFS-localized src/venv archives)."""
+        """Localize the staged job bundle, container resources, and venv
+        into this task's working dir (reference ``Utils.extractResources``
+        :710-723 unzipping the HDFS-localized src/venv archives, and YARN
+        resource localization per ``LocalizableResource``)."""
         bundle = str(self.conf.get(K.INTERNAL_BUNDLE_DIR, "") or "")
         if bundle and os.path.isdir(bundle):
             import shutil
             shutil.copytree(bundle, os.getcwd(), dirs_exist_ok=True)
+        resources = self.conf.get_list(K.INTERNAL_RESOURCES)
+        if resources:
+            from tony_tpu.utils.localize import localize_resources
+
+            localize_resources(resources, os.getcwd())
+        venv = str(self.conf.get(K.INTERNAL_VENV, "") or "")
+        if venv and os.path.isfile(venv):
+            import shutil
+
+            venv_dir = os.path.join(os.getcwd(), "venv")
+            os.makedirs(venv_dir, exist_ok=True)
+            shutil.unpack_archive(venv, venv_dir)
+            # Archived venvs lose the executable bit on their binaries when
+            # zipped; restore it so venv/bin/python is actually runnable.
+            bin_dir = os.path.join(venv_dir, "bin")
+            if os.path.isdir(bin_dir):
+                for f in os.listdir(bin_dir):
+                    p = os.path.join(bin_dir, f)
+                    if os.path.isfile(p):
+                        os.chmod(p, os.stat(p).st_mode | 0o755)
 
     # -- run ------------------------------------------------------------
     def run(self) -> int:
@@ -158,12 +179,14 @@ class TaskExecutor:
             self.client, self.task_id,
             self.conf.get_int(K.TASK_HEARTBEAT_INTERVAL_MS, 1000) / 1000.0)
         hb.start()
+        metrics_file = os.path.join(os.getcwd(), "user-metrics.json")
         monitor = TaskMonitor(
             self.task_id,
             push=lambda tid, m: self.client.call("metrics.push", task_id=tid,
                                                  metrics=m),
             interval_s=self.conf.get_int(K.TASK_METRICS_INTERVAL_MS,
-                                         5000) / 1000.0)
+                                         5000) / 1000.0,
+            metrics_file=metrics_file)
 
         cluster_spec = self.register_and_get_cluster_spec()
         if cluster_spec is None:
@@ -188,28 +211,37 @@ class TaskExecutor:
         })
         if self.tb_port is not None:
             env[constants.TB_PORT] = str(self.tb_port.port)
+        # The user process reports its own device stats here (it owns the
+        # chips; see tony_tpu/telemetry.py) and the monitor tails the file.
+        env[constants.METRICS_FILE] = metrics_file
+
+        tb_proc = self._maybe_launch_tensorboard(env)
 
         # Release-before-exec dance (reference :224-249): ephemeral ports must
         # be free for the user process to bind; reusable ports stay held.
-        child_pid: list = [None]
         if not self.rendezvous_port.reuse:
             self.rendezvous_port.release()
         if self.tb_port is not None:
             self.tb_port.release()
 
-        monitor._pid_fn = lambda: child_pid[0] or os.getpid()
+        # Root the proc-tree walk at the executor itself: the user process
+        # is a descendant, and this root stays sampleable after the child
+        # exits (a dead child pid would zero the final sample short tasks
+        # rely on).
+        monitor._pid_fn = os.getpid
         monitor.start()
         try:
             exit_code = procutil.execute_shell(
                 self.command,
                 timeout_s=self.conf.get_int(
                     K.TASK_EXECUTOR_EXECUTION_TIMEOUT_S, 0),
-                env=env,
-                on_start=lambda p: child_pid.__setitem__(0, p.pid))
+                env=env)
         finally:
             monitor.stop()
             if self.rendezvous_port.reuse:
                 self.rendezvous_port.release()
+            if tb_proc is not None and tb_proc.poll() is None:
+                tb_proc.terminate()
         log.info("user process for %s exited with %d", self.task_id, exit_code)
 
         try:
@@ -220,6 +252,22 @@ class TaskExecutor:
         hb.stop()
         self._maybe_skew_sleep()
         return exit_code
+
+    def _maybe_launch_tensorboard(self, env: Dict[str, str]):
+        """Chief-only: spawn the configured TensorBoard command on the
+        reserved TB_PORT (the URL was registered at setup_ports; serving is
+        new — the reference left launching to user scripts)."""
+        cmd = str(self.conf.get(K.APPLICATION_TENSORBOARD_COMMAND, "") or "")
+        if not cmd or not self.is_chief or self.tb_port is None:
+            return None
+        import subprocess
+
+        full_env = dict(os.environ)
+        full_env.update(env)
+        log.info("chief launching tensorboard: %s", cmd)
+        return subprocess.Popen(cmd, shell=True, env=full_env,
+                                stdout=open("tensorboard.log", "ab"),
+                                stderr=subprocess.STDOUT)
 
     def _maybe_skew_sleep(self) -> None:
         """TEST_EXECUTOR_SKEW='job#idx#seconds' straggler simulation

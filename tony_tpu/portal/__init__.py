@@ -1,0 +1,3 @@
+from tony_tpu.portal.server import PortalServer
+
+__all__ = ["PortalServer"]

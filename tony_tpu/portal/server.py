@@ -1,0 +1,305 @@
+"""History web portal: the reference's Play-framework history server,
+re-imagined as a dependency-free stdlib HTTP server.
+
+Reference model: ``tony-portal`` — routes (``conf/routes:1-5``):
+jobs index ``/``, per-job config ``/config/:jobId``, events
+``/jobs/:jobId``, logs ``/logs/:jobId``; Guava caches warming parsed
+metadata/config/events/logs (``cache/CacheWrapper.java:82-126``); background
+``HistoryFileMover`` (intermediate → finished/yyyy/MM/dd, every 5 min) and
+``HistoryFilePurger`` (retention deletes) singletons (``Module.java:14-22``).
+
+Every view is served as HTML (human) or JSON (``?format=json`` — the
+machine-readable surface the reference lacks). Log links only resolve paths
+recorded in the job's own TASK_FINISHED events, never caller-supplied ones.
+"""
+
+from __future__ import annotations
+
+import html
+import json
+import logging
+import os
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Dict, List, Optional, Tuple
+from urllib.parse import parse_qs, urlparse
+
+from tony_tpu import constants
+from tony_tpu.events import history
+
+log = logging.getLogger(__name__)
+
+_CACHE_TTL_S = 30.0
+
+
+class _Cache:
+    """TTL cache per (kind, job) — the CacheWrapper analogue. Finished jobs
+    never change, so entries for terminal jobs are kept until evicted."""
+
+    def __init__(self, ttl_s: float = _CACHE_TTL_S, max_entries: int = 256):
+        self._data: Dict[Tuple[str, str], Tuple[float, Any]] = {}
+        self._ttl = ttl_s
+        self._max = max_entries
+        self._lock = threading.Lock()
+
+    def get(self, kind: str, key: str):
+        with self._lock:
+            hit = self._data.get((kind, key))
+        if hit and (time.monotonic() - hit[0]) < self._ttl:
+            return hit[1]
+        return None
+
+    def put(self, kind: str, key: str, value) -> None:
+        with self._lock:
+            if len(self._data) >= self._max:
+                oldest = min(self._data, key=lambda k: self._data[k][0])
+                del self._data[oldest]
+            self._data[(kind, key)] = (time.monotonic(), value)
+
+
+class PortalServer:
+    """Serves the four history views + JSON API; owns mover/purger threads."""
+
+    def __init__(self, history_root: str, port: int = 0,
+                 host: str = "127.0.0.1", mover_interval_s: float = 300.0,
+                 purger_interval_s: float = 3600.0,
+                 retention_days: int = 30):
+        self.history_root = history_root
+        self.cache = _Cache()
+        self._mover = history.HistoryFileMover(history_root)
+        self._purger = history.HistoryFilePurger(history_root, retention_days)
+        self._mover_interval = mover_interval_s
+        self._purger_interval = purger_interval_s
+        self._stop = threading.Event()
+        self._threads: List[threading.Thread] = []
+
+        portal = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, fmt, *args):  # quiet; use logging
+                log.debug("portal: " + fmt, *args)
+
+            def do_GET(self):  # noqa: N802
+                portal._route(self)
+
+        self.httpd = ThreadingHTTPServer((host, port), Handler)
+        self.port = self.httpd.server_address[1]
+
+    # -- lifecycle -------------------------------------------------------
+    def start(self) -> None:
+        t = threading.Thread(target=self.httpd.serve_forever,
+                             name="tony-portal", daemon=True)
+        t.start()
+        self._threads.append(t)
+        for name, fn, interval in (
+                ("tony-history-mover", self._mover.move_once,
+                 self._mover_interval),
+                ("tony-history-purger", self._purger.purge_once,
+                 self._purger_interval)):
+            th = threading.Thread(target=self._periodic, name=name,
+                                  args=(fn, interval), daemon=True)
+            th.start()
+            self._threads.append(th)
+
+    def _periodic(self, fn, interval: float) -> None:
+        while not self._stop.wait(interval):
+            try:
+                fn()
+            except Exception as e:  # noqa: BLE001
+                log.warning("%s failed: %s", fn.__name__, e)
+
+    def stop(self) -> None:
+        self._stop.set()
+        self.httpd.shutdown()
+        self.httpd.server_close()
+
+    @property
+    def url(self) -> str:
+        return f"http://{self.httpd.server_address[0]}:{self.port}"
+
+    # -- routing ---------------------------------------------------------
+    def _route(self, req: BaseHTTPRequestHandler) -> None:
+        parsed = urlparse(req.path)
+        parts = [p for p in parsed.path.split("/") if p]
+        as_json = parse_qs(parsed.query).get("format", [""])[0] == "json"
+        try:
+            if not parts:
+                return self._jobs_index(req, as_json)
+            view, *rest = parts
+            if view in ("config", "jobs", "logs", "logfile") and rest:
+                job_id = rest[0]
+                if view == "config":
+                    return self._config_view(req, job_id, as_json)
+                if view == "jobs":
+                    return self._events_view(req, job_id, as_json)
+                if view == "logs":
+                    return self._logs_view(req, job_id, as_json)
+                if view == "logfile" and len(rest) >= 2:
+                    return self._logfile_view(req, job_id, int(rest[1]))
+            self._send(req, 404, "text/plain", b"not found")
+        except Exception as e:  # noqa: BLE001
+            log.exception("portal error for %s", req.path)
+            self._send(req, 500, "text/plain",
+                       f"internal error: {e}".encode())
+
+    # -- views -----------------------------------------------------------
+    def _jobs_index(self, req, as_json: bool) -> None:
+        rows = history.list_jobs(self.history_root)
+        if as_json:
+            payload = [dict(app_id=r.app_id, status=r.status, user=r.user,
+                            started_ms=r.started_ms) for r in rows]
+            return self._send_json(req, payload)
+        body = ["<h1>tony-tpu jobs</h1><table border=1 cellpadding=4>",
+                "<tr><th>job</th><th>status</th><th>user</th>"
+                "<th>started</th><th></th></tr>"]
+        for r in rows:
+            a = html.escape(r.app_id)
+            body.append(
+                f"<tr><td>{a}</td><td>{html.escape(r.status)}</td>"
+                f"<td>{html.escape(r.user)}</td><td>{r.started_iso}</td>"
+                f"<td><a href='/jobs/{a}'>events</a> "
+                f"<a href='/config/{a}'>config</a> "
+                f"<a href='/logs/{a}'>logs</a></td></tr>")
+        body.append("</table>")
+        self._send_html(req, "".join(body))
+
+    def _job_dir(self, job_id: str) -> Optional[str]:
+        return history.list_job_dirs(self.history_root).get(job_id)
+
+    def _config_view(self, req, job_id: str, as_json: bool) -> None:
+        conf = self.cache.get("config", job_id)
+        if conf is None:
+            job_dir = self._job_dir(job_id)
+            if job_dir is None:
+                return self._send(req, 404, "text/plain", b"unknown job")
+            path = os.path.join(job_dir, constants.FINAL_CONFIG_FILE)
+            if not os.path.exists(path):
+                return self._send(req, 404, "text/plain",
+                                  b"no frozen config for job")
+            with open(path, encoding="utf-8") as f:
+                conf = json.load(f)
+            self.cache.put("config", job_id, conf)
+        if as_json:
+            return self._send_json(req, conf)
+        rows = "".join(
+            f"<tr><td>{html.escape(str(k))}</td>"
+            f"<td>{html.escape(str(v))}</td></tr>"
+            for k, v in sorted(conf.items()))
+        self._send_html(
+            req, f"<h1>config — {html.escape(job_id)}</h1>"
+                 f"<table border=1 cellpadding=4>"
+                 f"<tr><th>key</th><th>value</th></tr>{rows}</table>")
+
+    def _events(self, job_id: str):
+        evs = self.cache.get("events", job_id)
+        if evs is None:
+            evs = history.read_job_events(self.history_root, job_id)
+            if evs is not None:
+                self.cache.put("events", job_id, evs)
+        return evs
+
+    def _events_view(self, req, job_id: str, as_json: bool) -> None:
+        evs = self._events(job_id)
+        if evs is None:
+            return self._send(req, 404, "text/plain", b"unknown job")
+        if as_json:
+            return self._send_json(
+                req, [dict(type=e.type, timestamp_ms=e.timestamp_ms,
+                           payload=e.payload) for e in evs])
+        rows = "".join(
+            f"<tr><td>{e.timestamp_ms}</td><td>{html.escape(e.type)}</td>"
+            f"<td><pre>{html.escape(json.dumps(e.payload, indent=1))}"
+            f"</pre></td></tr>" for e in evs)
+        self._send_html(
+            req, f"<h1>events — {html.escape(job_id)}</h1>"
+                 f"<table border=1 cellpadding=4><tr><th>ts</th><th>type"
+                 f"</th><th>payload</th></tr>{rows}</table>")
+
+    def _log_paths(self, job_id: str) -> List[Tuple[str, str]]:
+        """(task, path) pairs from the job's own TASK_FINISHED events — the
+        only paths this server will ever read (no caller-supplied paths)."""
+        evs = self._events(job_id) or []
+        out: List[Tuple[str, str]] = []
+        for e in evs:
+            if e.type == "TASK_FINISHED":
+                for p in e.payload.get("logs", []):
+                    out.append((e.payload.get("task", "?"), p))
+        return out
+
+    def _logs_view(self, req, job_id: str, as_json: bool) -> None:
+        pairs = self._log_paths(job_id)
+        if as_json:
+            return self._send_json(
+                req, [dict(task=t, path=p,
+                           url=f"/logfile/{job_id}/{i}")
+                      for i, (t, p) in enumerate(pairs)])
+        items = "".join(
+            f"<li>{html.escape(t)}: "
+            f"<a href='/logfile/{html.escape(job_id)}/{i}'>"
+            f"{html.escape(os.path.basename(p))}</a></li>"
+            for i, (t, p) in enumerate(pairs))
+        self._send_html(
+            req, f"<h1>logs — {html.escape(job_id)}</h1><ul>{items}</ul>"
+                 or "<p>no logs recorded</p>")
+
+    def _logfile_view(self, req, job_id: str, index: int) -> None:
+        pairs = self._log_paths(job_id)
+        if not 0 <= index < len(pairs):
+            return self._send(req, 404, "text/plain", b"no such log")
+        path = pairs[index][1]
+        if not os.path.exists(path):
+            return self._send(req, 404, "text/plain",
+                              b"log file no longer present")
+        with open(path, "rb") as f:
+            data = f.read()[-1_000_000:]  # tail cap
+        self._send(req, 200, "text/plain; charset=utf-8", data)
+
+    # -- plumbing --------------------------------------------------------
+    def _send(self, req, code: int, ctype: str, body: bytes) -> None:
+        req.send_response(code)
+        req.send_header("Content-Type", ctype)
+        req.send_header("Content-Length", str(len(body)))
+        req.end_headers()
+        req.wfile.write(body)
+
+    def _send_html(self, req, body: str) -> None:
+        page = ("<!doctype html><html><head><title>tony-tpu history</title>"
+                "</head><body><p><a href='/'>&larr; jobs</a></p>"
+                f"{body}</body></html>")
+        self._send(req, 200, "text/html; charset=utf-8", page.encode())
+
+    def _send_json(self, req, obj) -> None:
+        self._send(req, 200, "application/json",
+                   json.dumps(obj, indent=1).encode())
+
+
+def main(argv=None) -> int:
+    """``python -m tony_tpu.portal --history-root ... [--port N]``."""
+    import argparse
+
+    from tony_tpu.conf import keys as K
+    from tony_tpu.conf.config import TonyTpuConfig
+
+    logging.basicConfig(level=logging.INFO)
+    p = argparse.ArgumentParser(prog="tony-tpu-portal")
+    p.add_argument("--history-root", required=True)
+    p.add_argument("--port", type=int, default=None)
+    p.add_argument("--host", default="0.0.0.0")
+    args = p.parse_args(argv)
+    conf = TonyTpuConfig()
+    port = args.port if args.port is not None \
+        else conf.get_int(K.PORTAL_PORT, 19886)
+    srv = PortalServer(
+        args.history_root, port=port, host=args.host,
+        mover_interval_s=conf.get_int(K.HISTORY_MOVER_INTERVAL_S, 300),
+        purger_interval_s=conf.get_int(K.HISTORY_PURGER_INTERVAL_S, 3600),
+        retention_days=conf.get_int(K.HISTORY_RETENTION_DAYS, 30))
+    srv.start()
+    log.info("portal serving %s at %s", args.history_root, srv.url)
+    try:
+        while True:
+            time.sleep(3600)
+    except KeyboardInterrupt:
+        srv.stop()
+    return 0

@@ -67,6 +67,7 @@ class Runtime:
             constants.CLUSTER_SPEC: json.dumps(cluster_spec, sort_keys=True),
             constants.GLOBAL_RANK: str(flat.index(my_id)),
             constants.GLOBAL_WORLD: str(len(flat)),
+            constants.TASK_PORT: str(me.port),
         }
         env.update(self.framework_env(cluster_spec, me, conf))
         return env
