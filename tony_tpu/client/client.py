@@ -157,6 +157,12 @@ class TonyTpuClient:
             lst.on_application_id_received(self.app_id)
         self._stage_bundle()
         self.conf.set(K.INTERNAL_APP_ID, self.app_id)
+        from tony_tpu.utils.version import version_info
+
+        vi = version_info()
+        self.conf.set(K.INTERNAL_VERSION, vi["version"])
+        self.conf.set(K.INTERNAL_REVISION, vi["revision"])
+        self.conf.set(K.INTERNAL_BRANCH, vi["branch"])
         frozen = self.conf.freeze(
             os.path.join(self.job_dir, constants.FINAL_CONFIG_FILE))
 

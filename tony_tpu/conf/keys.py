@@ -246,6 +246,17 @@ INTERNAL_VENV = _key(
     "tony.internal.venv", "", str,
     "Set by the client at submit: staged python-venv archive, unpacked to "
     "./venv in every task working dir (reference TonyClient.java:189-228).")
+INTERNAL_VERSION = _key(
+    "tony.internal.version", "", str,
+    "Stamped by the client at submit: framework package version "
+    "(reference VersionInfo injection, TonyClient.java:152).")
+INTERNAL_REVISION = _key(
+    "tony.internal.revision", "", str,
+    "Stamped by the client at submit: git revision of the framework build "
+    "(reference util/VersionInfo.java:149).")
+INTERNAL_BRANCH = _key(
+    "tony.internal.branch", "", str,
+    "Stamped by the client at submit: git branch of the framework build.")
 
 # --- per-jobtype dynamic keys (reference TonyConfigurationKeys.java:171-239)
 INSTANCES_FORMAT = "tony.{job}.instances"
@@ -271,6 +282,42 @@ _RESERVED_NON_JOB_SEGMENTS = {
 def registry() -> Dict[str, ConfigKey]:
     """The static key registry (name → ConfigKey)."""
     return dict(_REGISTRY)
+
+
+def defaults_markdown() -> str:
+    """Render the documented defaults table. ``tony_tpu/conf/defaults.md``
+    must be exactly this output — the parity test regenerates and compares
+    (the analogue of ``TestTonyConfigurationFields.java:17-45`` enforcing
+    keys-class ↔ ``tony-default.xml`` agreement). Regenerate with
+    ``python -m tony_tpu.conf.keys``."""
+    lines = [
+        "# tony-tpu configuration defaults",
+        "",
+        "Generated from `tony_tpu/conf/keys.py` — do not edit by hand; run",
+        "`python -m tony_tpu.conf.keys` to regenerate. Parity with the key",
+        "registry is test-enforced (reference discipline:",
+        "`TestTonyConfigurationFields.java:17-45`).",
+        "",
+        "| Key | Default | Type | Multi-value |",
+        "|---|---|---|---|",
+    ]
+    for name in sorted(_REGISTRY):
+        k = _REGISTRY[name]
+        default = "(empty)" if k.default == "" else repr(k.default)
+        lines.append(f"| `{name}` | {default} | {k.type.__name__} | "
+                     f"{'yes' if k.multi_value else ''} |")
+    lines += [
+        "",
+        "Dynamic per-jobtype keys (reference "
+        "`TonyConfigurationKeys.java:171-239`):",
+        "",
+    ]
+    for fmt in (INSTANCES_FORMAT, COMMAND_FORMAT, CHIPS_FORMAT,
+                VCORES_FORMAT, MEMORY_FORMAT, MAX_INSTANCES_FORMAT,
+                DEPENDS_ON_FORMAT, ENV_FORMAT, NODE_POOL_FORMAT):
+        lines.append(f"- `{fmt.format(job='<jobtype>')}`")
+    lines.append("")
+    return "\n".join(lines)
 
 
 def is_multi_value(name: str) -> bool:
@@ -308,3 +355,13 @@ def coerce(name: str, value: Any) -> Any:
     if key.type is str:
         return str(value)
     return value
+
+
+if __name__ == "__main__":
+    import os
+
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "defaults.md")
+    with open(path, "w", encoding="utf-8") as f:
+        f.write(defaults_markdown())
+    print(f"wrote {path}")

@@ -117,6 +117,8 @@ class Coordinator:
         self._stop_requested = threading.Event()
         self._stop_reason = ""
         self._started_ms = int(time.time() * 1000)
+        self._retries_total = conf.get_int(K.APPLICATION_RETRY_COUNT, 0)
+        self._attempt = 0
         self._last_hb: Dict[str, float] = {}
         self._hb_lock = threading.Lock()
         self._schedule_start: float = 0.0
@@ -261,11 +263,25 @@ class Coordinator:
     def application_report(self) -> dict:
         status = (self.final_status if self.final_status != SessionStatus.RUNNING
                   else self.session.status)
+        retries_left = max(0, self._retries_total - self._attempt)
+        if (self.final_status == SessionStatus.RUNNING
+                and status in (SessionStatus.FAILED, SessionStatus.KILLED)
+                and retries_left > 0
+                and not self._stop_requested.is_set()):
+            # Whole-job retry window: the current epoch failed but attempts
+            # remain, so the next report may well be RUNNING again. A client
+            # that treats any terminal status as final (ours does, like
+            # ``TonyClient.java:838-892`` gates on the YARN *application*
+            # status, never transient session state) must not observe the
+            # transient FAILED here.
+            status = SessionStatus.RUNNING
         return {
             "app_id": self.app_id,
             "status": status.value,
             "failure_reason": self.session.failure_reason or self._stop_reason,
             "session_id": self.session.session_id,
+            "attempt": self._attempt,
+            "retries_left": retries_left,
             "tb_url": self.tb_url,
             "tasks": [t.to_info() for t in self.session.all_tasks()],
         }
@@ -359,7 +375,7 @@ class Coordinator:
             self.rpc.stop()
             raise CoordinatorCrash("TEST_COORDINATOR_CRASH requested")
 
-        retries = self.conf.get_int(K.APPLICATION_RETRY_COUNT, 0)
+        retries = self._retries_total
         attempt = 0
         try:
             local_cmd = str(self.conf.get(K.COORDINATOR_COMMAND, "") or "")
@@ -441,6 +457,10 @@ class Coordinator:
             with self._hb_lock:
                 self._last_hb.clear()
             self._worker_termination_done = False
+        # Bump the attempt only after the fresh session is installed: a
+        # concurrent application_report must never see (old FAILED session,
+        # new attempt) — that combination un-masks the transient FAILED.
+        self._attempt = attempt
         self.scheduler = GangScheduler(self.conf, self._launch_job)
         self._schedule_start = time.monotonic()
         self.scheduler.schedule_ready()

@@ -240,8 +240,7 @@ class TaskExecutor:
             monitor.stop()
             if self.rendezvous_port.reuse:
                 self.rendezvous_port.release()
-            if tb_proc is not None and tb_proc.poll() is None:
-                tb_proc.terminate()
+            self._teardown_tensorboard(tb_proc)
         log.info("user process for %s exited with %d", self.task_id, exit_code)
 
         try:
@@ -265,9 +264,34 @@ class TaskExecutor:
         full_env = dict(os.environ)
         full_env.update(env)
         log.info("chief launching tensorboard: %s", cmd)
-        return subprocess.Popen(cmd, shell=True, env=full_env,
-                                stdout=open("tensorboard.log", "ab"),
-                                stderr=subprocess.STDOUT)
+        self._tb_log = open("tensorboard.log", "ab")
+        try:
+            return subprocess.Popen(cmd, shell=True, env=full_env,
+                                    stdout=self._tb_log,
+                                    stderr=subprocess.STDOUT)
+        except Exception:
+            self._tb_log.close()
+            self._tb_log = None
+            raise
+
+    def _teardown_tensorboard(self, tb_proc) -> None:
+        """Terminate→wait→kill escalation; must never raise — it runs in
+        run()'s finally, after the user exit code is already in hand."""
+        if tb_proc is not None:
+            if tb_proc.poll() is None:
+                tb_proc.terminate()
+                try:
+                    tb_proc.wait(timeout=5)
+                except Exception:  # noqa: BLE001 — escalate to SIGKILL
+                    tb_proc.kill()
+                    try:
+                        tb_proc.wait(timeout=5)
+                    except Exception:  # noqa: BLE001 — unreapable; move on
+                        log.warning("tensorboard process unreapable")
+            log_f = getattr(self, "_tb_log", None)
+            if log_f is not None:
+                log_f.close()
+                self._tb_log = None
 
     def _maybe_skew_sleep(self) -> None:
         """TEST_EXECUTOR_SKEW='job#idx#seconds' straggler simulation

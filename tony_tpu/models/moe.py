@@ -221,8 +221,10 @@ def moe_lm_loss(model_out, tokens, aux_weight: float) -> jax.Array:
     return causal_lm_loss(logits, tokens) + aux_weight * aux
 
 
-def dryrun_ep_step(devices, ep: int) -> None:
-    """One MoE train step on an ep≥2 mesh (used by __graft_entry__)."""
+def dryrun_ep_step(devices, ep: int) -> float:
+    """One FULL MoE train step (fwd + bwd + optimizer update) on an ep≥2
+    mesh, asserting the compiled program dispatches experts via all_to_all.
+    Used by ``__graft_entry__.dryrun_multichip``; returns the loss."""
     import optax
 
     from tony_tpu.parallel import MeshSpec, build_mesh, init_sharded_state
@@ -234,12 +236,26 @@ def dryrun_ep_step(devices, ep: int) -> None:
     model = MoETransformer(cfg)
     tokens = jax.random.randint(jax.random.key(0), (2 * (n // ep), 32), 0,
                                 cfg.vocab_size)
-    state, sh = init_sharded_state(model, tokens, optax.adam(1e-3), mesh)
+    state, _sh = init_sharded_state(model, tokens, optax.adam(1e-3), mesh)
 
     def loss_fn(p):
         with nn.logical_axis_rules(list(DEFAULT_RULES)):
             return moe_lm_loss(model.apply({"params": p}, tokens), tokens,
                                cfg.aux_loss_weight)
 
-    loss, grads = jax.jit(jax.value_and_grad(loss_fn))(state.params)
-    assert jnp.isfinite(float(loss)), f"ep MoE step diverged: {loss}"
+    def step(state):
+        loss, grads = jax.value_and_grad(loss_fn)(state.params)
+        return state.apply_gradients(grads), loss
+
+    # set_mesh binds the abstract mesh MoEMLP reads to pick the ep path;
+    # without it n_ep resolves to 1 and the dry run would only validate the
+    # replicated fallback (advisor finding, round 2).
+    with jax.set_mesh(mesh):
+        compiled = jax.jit(step).lower(state).compile()
+        hlo = compiled.as_text()
+        assert "all-to-all" in hlo, \
+            "ep dryrun compiled WITHOUT all_to_all expert dispatch"
+        state, loss = compiled(state)
+    loss = float(loss)
+    assert jnp.isfinite(loss), f"ep MoE train step diverged: {loss}"
+    return loss

@@ -239,9 +239,9 @@ class PortalServer:
             f"<a href='/logfile/{html.escape(job_id)}/{i}'>"
             f"{html.escape(os.path.basename(p))}</a></li>"
             for i, (t, p) in enumerate(pairs))
+        body = f"<ul>{items}</ul>" if items else "<p>no logs recorded</p>"
         self._send_html(
-            req, f"<h1>logs — {html.escape(job_id)}</h1><ul>{items}</ul>"
-                 or "<p>no logs recorded</p>")
+            req, f"<h1>logs — {html.escape(job_id)}</h1>{body}")
 
     def _logfile_view(self, req, job_id: str, index: int) -> None:
         pairs = self._log_paths(job_id)

@@ -1,0 +1,39 @@
+"""Build/version stamping.
+
+Reference: ``util/VersionInfo.java`` (149 LoC) injects build
+version/revision/branch into the job configuration at submit time
+(``TonyClient.java:152``), so the frozen artifact records exactly which
+build ran the job. Here the same triple is resolved at submit from the
+package version plus best-effort git metadata and stamped into the frozen
+``tony-final.json`` under ``tony.internal.{version,revision,branch}``.
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+import subprocess
+from typing import Dict
+
+
+@functools.lru_cache(maxsize=1)
+def version_info() -> Dict[str, str]:
+    from tony_tpu import __version__
+
+    root = os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+
+    def _git(*args: str) -> str:
+        try:
+            out = subprocess.run(
+                ["git", *args], cwd=root, capture_output=True, text=True,
+                timeout=5)
+            return out.stdout.strip() or "unknown"
+        except Exception:  # noqa: BLE001 — no git / not a checkout
+            return "unknown"
+
+    return {
+        "version": __version__,
+        "revision": _git("rev-parse", "--short", "HEAD"),
+        "branch": _git("rev-parse", "--abbrev-ref", "HEAD"),
+    }
