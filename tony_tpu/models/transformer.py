@@ -42,6 +42,14 @@ class TransformerConfig:
     param_dtype: jnp.dtype = jnp.float32
     attn_impl: str = "flash"                 # flash | ring | ulysses | xla
     remat: bool = True
+    # Name of a jax.checkpoint_policies policy for remat, e.g.
+    # "dots_with_no_batch_dims_saveable" (save matmul outputs, recompute
+    # only cheap elementwise/norm ops — ~the full-remat memory win at a
+    # fraction of the recompute FLOPs). None → full remat of each block.
+    remat_policy: Optional[str] = None
+    # Flash kernel tile sizes (see ops/attention.py block sweep notes).
+    attn_block_q: int = 1024
+    attn_block_k: int = 512
     tie_embeddings: bool = False
     # LM-head matmul dtype; None → activation dtype (bf16 on TPU: the
     # [dim, vocab] projection is ~20% of model FLOPs and f32 runs at half
@@ -139,7 +147,9 @@ class Attention(nn.Module):
         v = nn.with_logical_constraint(v, ("batch", "seq", "kv_heads", "kv"))
 
         if cfg.attn_impl == "flash":
-            o = flash_attention(q, k, v, causal=True)
+            o = flash_attention(q, k, v, causal=True,
+                                block_q=cfg.attn_block_q,
+                                block_k=cfg.attn_block_k)
         elif cfg.attn_impl == "xla":
             g = cfg.n_heads // cfg.n_kv_heads
             o = reference_attention(q, jnp.repeat(k, g, axis=2),
@@ -223,7 +233,9 @@ class Transformer(nn.Module):
         x = nn.with_logical_constraint(x, ("batch", "seq", "embed"))
         block = Block
         if cfg.remat:
-            block = nn.remat(Block, prevent_cse=False)
+            policy = (getattr(jax.checkpoint_policies, cfg.remat_policy)
+                      if cfg.remat_policy else None)
+            block = nn.remat(Block, prevent_cse=False, policy=policy)
         for i in range(cfg.n_layers):
             x = block(cfg, name=f"layer_{i}")(x, positions)
         x = RMSNorm(cfg.norm_eps, cfg.param_dtype, name="final_norm")(x)

@@ -7,8 +7,27 @@ through VMEM and keeps the running (max, sum, acc) state on-chip, so HBM
 traffic stays O(S·D). Forward and backward are custom kernels under a
 ``jax.custom_vjp``; the forward saves only O and the row logsumexp L.
 
+TPU-first design points (round-3 rework):
+
+- **GQA is zero-copy.** K/V stay at their native ``[B, H_kv, S, D]`` shape;
+  the q→kv head mapping happens in the BlockSpec index maps (``h // g``), so
+  repeated heads cost no extra HBM footprint or bandwidth. The dk/dv grid
+  folds the ``g`` group members into its innermost loop and accumulates in
+  VMEM scratch.
+- **Per-row stats are near-minimal.** lse/delta are ``[B, H, 8, S]`` f32 —
+  the 8-sublane-broadcast layout (32 B/row, the smallest tileable form: the
+  last two dims must tile (8, 128)) — not the ``[·, S, 128]``
+  lane-broadcast layout of jax's bundled kernel (512 B/row; measurable at
+  long context).
+- **Matmuls run at native MXU rate.** Inputs keep their dtype (bf16 stays
+  bf16) with ``preferred_element_type=f32`` accumulation; softmax state is
+  f32 on-chip.
+- **Causal tiles are skipped in the DMA, not just the ALU.** Index maps
+  clamp fully-masked tiles to the previous fetch, so Pallas's pipeline
+  skips the copy (revisited blocks are not re-fetched).
+
 Public layout is ``[batch, seq, heads, head_dim]`` (the layout the models
-use); kernels run per (batch·head) slice. On non-TPU backends the kernels
+use); kernels run on ``[B, H, S, D]`` views. On non-TPU backends the kernels
 run in Pallas interpret mode so the exact same code path is unit-tested on
 the virtual CPU mesh (SURVEY.md §4 test strategy).
 """
@@ -23,59 +42,117 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 
 NEG_INF = -1e30
-# TPU lane width; per-row stats (lse, delta) are stored lane-broadcast as
-# [B·H, S, 128] f32 — 128× the minimal HBM for those stats, the same layout
-# jax's own TPU flash kernel uses (flash_attention.py MIN_BLOCK_SIZE scratch)
-# because Mosaic wants the trailing two dims tileable to (8, 128). At 8B/
-# long-context scale consider [B·H, S, 8] (min sublane tile) instead; the
-# stats are ~d/128 of the O tensor either way (<1% of activation traffic).
-LANES = 128
+# Stats (lse/delta) sublane broadcast factor: min f32 tile is (8, 128), so
+# a per-row float is stored as 8 identical sublanes over lanes=seq.
+STAT_SUB = 8
+
+
+def _prec(x):
+    """Dot precision: TPU DEFAULT multiplies in bf16 (one MXU pass) — right
+    for bf16 inputs, silently lossy for f32 ones. f32 inputs (the oracle /
+    unit-test path) get HIGHEST (true f32 passes) so the kernel is exact
+    where the caller asked for f32."""
+    return (jax.lax.Precision.HIGHEST if x.dtype == jnp.float32
+            else jax.lax.Precision.DEFAULT)
 
 
 def _load2d(ref, block_idx, block_rows, seq):
-    """Load a [1, block, d] block as f32 with out-of-range rows zeroed.
+    """Load a [1, 1, block, d] block with out-of-range rows zeroed, keeping
+    the stored dtype (bf16 in → bf16 out, so dots hit the MXU at full rate).
     Pallas pads partial edge blocks with undefined memory (NaN in interpret
-    mode); a zero row is inert in every matmul below, undefined is not."""
-    x = ref[0].astype(jnp.float32)
+    mode); a zero row is inert in every matmul below, undefined is not.
+    When ``seq`` divides the block the guard compiles away entirely — the
+    production path pays zero VPU passes here."""
+    x = ref[0, 0]
+    if seq % block_rows == 0:
+        return x
+    rows = block_idx * block_rows + jax.lax.broadcasted_iota(
+        jnp.int32, x.shape, 0)
+    return jnp.where(rows < seq, x, jnp.zeros_like(x))
+
+
+def _load_stat(ref, block_idx, block_rows, seq):
+    """Load a per-row statistic block [1, 1, STAT_SUB, block] (identical
+    sublanes — see _finalize) as a [block, 1] COLUMN vector, zero past
+    ``seq``. Column (sublane) orientation matters: the stats broadcast
+    against the [bq, bk] score tile along lanes, and handing Mosaic a lane
+    vector here would cost a lane→sublane relayout on every tile."""
+    x = jnp.transpose(ref[0, 0][:1, :])        # [block, 1]
+    if seq % block_rows == 0:
+        return x
     rows = block_idx * block_rows + jax.lax.broadcasted_iota(
         jnp.int32, x.shape, 0)
     return jnp.where(rows < seq, x, 0.0)
 
 
-def _load1d(ref, block_idx, block_rows, seq):
-    """Load a per-row statistic stored as [1, block, LANES] (all lanes
-    identical — see _finalize) and return the [block] vector, zero past
-    ``seq``."""
-    x = ref[0][:, 0]
-    rows = block_idx * block_rows + jax.lax.iota(jnp.int32, x.shape[0])
-    return jnp.where(rows < seq, x, 0.0)
+def _store_stat(ref, col):
+    """Store a [block, 1] column stat as the [STAT_SUB, block] sublane-
+    broadcast block."""
+    ref[0, 0] = jnp.broadcast_to(jnp.transpose(col), ref.shape[2:])
+
+
+def _last_valid_kj(i, block_q, block_k):
+    """Last k-block index with any unmasked causal element for q-tile
+    ``i``. Single source of truth for BOTH the kernels' compute guards and
+    the index-map DMA clamps — they must never disagree."""
+    return (i * block_q + block_q - 1) // block_k
+
+
+def _first_valid_qi(j, block_q, block_k):
+    """First q-block index with any unmasked causal element for k-tile
+    ``j`` (identity: ceil((j·bk − bq + 1)/bq) == floor(j·bk/bq))."""
+    return (j * block_k) // block_q
 
 
 def _mask_scores(s, qi, kj, block_q, block_k, causal, seq_q, seq_k):
-    """Mask invalid scores: keys/queries past the true sequence ends (grid
-    padding when seq % block != 0) and, for causal, keys after the query.
-    Padded-q rows are masked too so backward passes can't scatter garbage
-    into dk/dv (forward writes of padded rows are dropped by pallas)."""
-    rows = qi * block_q + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
-    cols = kj * block_k + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
-    valid = (cols < seq_k) & (rows < seq_q)
-    if causal:
-        valid = valid & (rows >= cols)
-    return jnp.where(valid, s, NEG_INF), valid
+    """Set invalid scores to NEG_INF so they vanish through exp().
+
+    VPU passes over the [bq, bk] score tile are the flash bottleneck at
+    small head_dim, so the mask is ONE broadcast compare + ONE select built
+    from 1-D iotas ([bq,1] vs [1,bk] — register-cheap), and the
+    sequence-edge guards (grid padding when seq % block != 0) are emitted
+    only for ragged shapes: the production path (divisible seq) pays 2
+    passes for causal, 0 for non-causal.
+
+    Returns (masked s, valid) — ``valid`` is None when only the causal
+    compare ran (no padded rows/cols exist, so exp(masked) needs no extra
+    zeroing)."""
+    rows = qi * block_q + jax.lax.broadcasted_iota(
+        jnp.int32, (s.shape[0], 1), 0)
+    cols = kj * block_k + jax.lax.broadcasted_iota(
+        jnp.int32, (1, s.shape[1]), 1)
+    ragged = bool(seq_q % block_q) or bool(seq_k % block_k)
+    valid = None
+    if ragged:
+        # Padded-q rows are masked too so backward passes can't scatter
+        # garbage into dk/dv (forward writes of padded rows are dropped).
+        valid = (cols < seq_k) & (rows < seq_q)
+        if causal:
+            valid = valid & (rows >= cols)
+    elif causal:
+        valid = rows >= cols
+    if valid is None:
+        return s, None
+    s = jnp.where(valid, s, NEG_INF)
+    return s, (valid if ragged else None)
 
 
 def reference_attention(q: jax.Array, k: jax.Array, v: jax.Array,
                         causal: bool = True,
                         scale: Optional[float] = None) -> jax.Array:
-    """Plain XLA attention ([B,S,H,D] layout) — the correctness oracle."""
+    """Plain XLA attention ([B,S,H,D] layout) — the correctness oracle.
+    Einsums run at HIGHEST precision: on TPU the DEFAULT is bf16 multiplies,
+    which would make the oracle less accurate than the kernel under test."""
     scale = scale if scale is not None else q.shape[-1] ** -0.5
-    s = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) * scale
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k,
+                   precision=_prec(q)).astype(jnp.float32) * scale
     if causal:
         sq, sk = q.shape[1], k.shape[1]
         mask = jnp.tril(jnp.ones((sq, sk), bool), k=sk - sq)
         s = jnp.where(mask, s, NEG_INF)
     p = jax.nn.softmax(s, axis=-1)
-    return jnp.einsum("bhqk,bkhd->bqhd", p.astype(v.dtype), v)
+    return jnp.einsum("bhqk,bkhd->bqhd", p.astype(v.dtype), v,
+                      precision=_prec(v))
 
 
 # ---------------------------------------------------------------------------
@@ -84,8 +161,8 @@ def reference_attention(q: jax.Array, k: jax.Array, v: jax.Array,
 def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
                 *, scale: float, causal: bool, block_q: int, block_k: int,
                 num_k_blocks: int, seq_q: int, seq_k: int):
-    qi = pl.program_id(1)
-    kj = pl.program_id(2)
+    qi = pl.program_id(2)
+    kj = pl.program_id(3)
 
     @pl.when(kj == 0)
     def _init():
@@ -96,46 +173,64 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
     # Causal: skip fully-masked tiles (k strictly after the q tile's end).
     run = True
     if causal:
-        run = kj * block_k <= qi * block_q + block_q - 1
+        run = kj <= _last_valid_kj(qi, block_q, block_k)
 
     @pl.when(run)
     def _compute():
         q = _load2d(q_ref, qi, block_q, seq_q)    # [block_q, d]
         k = _load2d(k_ref, kj, block_k, seq_k)    # [block_k, d]
         v = _load2d(v_ref, kj, block_k, seq_k)    # [block_k, d]
+        # Scale folded into the [·, d] q block — 8–16× fewer elements than
+        # a post-hoc pass over the [bq, bk] score tile.
+        qs = q * jnp.asarray(scale, q.dtype)
         s = jax.lax.dot_general(
-            q, k, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32) * scale  # [block_q, block_k]
+            qs, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+            precision=_prec(q))                   # [block_q, block_k]
         s, _ = _mask_scores(s, qi, kj, block_q, block_k, causal, seq_q,
                             seq_k)
+        # All row stats stay [block_q, 1] COLUMN vectors: reductions use
+        # keepdims and the scratch is (block_q, 1), so no lane↔sublane
+        # relayout ever happens on the hot path (1-D lane vectors with
+        # [:, None] broadcasts cost a relayout per tile).
         m_prev = m_scr[:]
-        m_new = jnp.maximum(m_prev, jnp.max(s, axis=1))
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
         alpha = jnp.exp(m_prev - m_new)
-        p = jnp.exp(s - m_new[:, None])
-        l_scr[:] = l_scr[:] * alpha + jnp.sum(p, axis=1)
-        acc_scr[:] = acc_scr[:] * alpha[:, None] + jax.lax.dot(
-            p, v, preferred_element_type=jnp.float32)
+        p = jnp.exp(s - m_new)
+        l_scr[:] = l_scr[:] * alpha + jnp.sum(p, axis=1, keepdims=True)
+        acc_scr[:] = acc_scr[:] * alpha + jax.lax.dot(
+            p.astype(v.dtype), v, preferred_element_type=jnp.float32,
+            precision=_prec(v))
         m_scr[:] = m_new
 
     @pl.when(kj == num_k_blocks - 1)
     def _finalize():
         l = jnp.maximum(l_scr[:], 1e-30)
-        o_ref[0] = (acc_scr[:] / l[:, None]).astype(o_ref.dtype)
-        # lse is [block_q, LANES] with identical lanes: Mosaic needs the
-        # last two block dims tileable (8x128), so a 1-D [block_q] output
-        # does not lower — same trick as jax's own TPU flash kernel.
-        lse_ref[0] = jnp.broadcast_to((m_scr[:] + jnp.log(l))[:, None],
-                                      lse_ref.shape[1:])
+        o_ref[0, 0] = (acc_scr[:] / l).astype(o_ref.dtype)
+        _store_stat(lse_ref, m_scr[:] + jnp.log(l))
 
 
 # ---------------------------------------------------------------------------
 # Backward kernels (standard flash backward, two passes)
 # ---------------------------------------------------------------------------
+def _p_block(s, lse, qi, kj, block_q, block_k, causal, seq_q, seq_k):
+    """exp(s − lse) with NEG_INF masking (causal entries vanish through the
+    exp). Ragged shapes additionally zero p explicitly: padded lse/do reads
+    are undefined memory on TPU, so exp(s − lse) can't be trusted there —
+    for divisible shapes that where() is statically elided."""
+    sm, valid = _mask_scores(s, qi, kj, block_q, block_k, causal, seq_q,
+                             seq_k)
+    p = jnp.exp(sm - lse)                       # lse is [bq, 1]
+    if valid is not None:
+        p = jnp.where(valid, p, 0.0)
+    return p
+
+
 def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
                    acc_scr, *, scale: float, causal: bool, block_q: int,
                    block_k: int, num_k_blocks: int, seq_q: int, seq_k: int):
-    qi = pl.program_id(1)
-    kj = pl.program_id(2)
+    qi = pl.program_id(2)
+    kj = pl.program_id(3)
 
     @pl.when(kj == 0)
     def _init():
@@ -143,7 +238,7 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
 
     run = True
     if causal:
-        run = kj * block_k <= qi * block_q + block_q - 1
+        run = kj <= _last_valid_kj(qi, block_q, block_k)
 
     @pl.when(run)
     def _compute():
@@ -151,36 +246,41 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
         k = _load2d(k_ref, kj, block_k, seq_k)
         v = _load2d(v_ref, kj, block_k, seq_k)
         do = _load2d(do_ref, qi, block_q, seq_q)
-        lse = _load1d(lse_ref, qi, block_q, seq_q)
-        delta = _load1d(delta_ref, qi, block_q, seq_q)
+        lse = _load_stat(lse_ref, qi, block_q, seq_q)
+        delta = _load_stat(delta_ref, qi, block_q, seq_q)
+        # One scaled copy of the [·, d] k block serves both dots:
+        # s = q·(k·scale)ᵀ and dq += ds_hat·(k·scale), where
+        # ds_hat = p·(dp − delta) — no [bq, bk]-sized scale pass.
+        ks = k * jnp.asarray(scale, k.dtype)
         s = jax.lax.dot_general(
-            q, k, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32) * scale
-        s, valid = _mask_scores(s, qi, kj, block_q, block_k, causal, seq_q,
-                                seq_k)
-        # Explicit zero (not just -inf scores): padded lse/do reads are
-        # undefined memory on TPU, so exp(s - lse) can't be trusted there.
-        p = jnp.where(valid, jnp.exp(s - lse[:, None]), 0.0)  # [bq, bk]
+            q, ks, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+            precision=_prec(q))
+        p = _p_block(s, lse, qi, kj, block_q, block_k, causal, seq_q,
+                     seq_k)                                 # [bq, bk]
         dp = jax.lax.dot_general(
             do, v, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32)            # [bq, bk]
-        ds = p * (dp - delta[:, None]) * scale
-        acc_scr[:] += jax.lax.dot(ds, k,
-                                  preferred_element_type=jnp.float32)
+            preferred_element_type=jnp.float32, precision=_prec(v))
+        ds = (p * (dp - delta)).astype(k.dtype)
+        acc_scr[:] += jax.lax.dot(ds, ks,
+                                  preferred_element_type=jnp.float32,
+                                  precision=_prec(k))
 
     @pl.when(kj == num_k_blocks - 1)
     def _finalize():
-        dq_ref[0] = acc_scr[:].astype(dq_ref.dtype)
+        dq_ref[0, 0] = acc_scr[:].astype(dq_ref.dtype)
 
 
 def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                     dk_ref, dv_ref, dk_scr, dv_scr, *, scale: float,
                     causal: bool, block_q: int, block_k: int,
-                    num_q_blocks: int, seq_q: int, seq_k: int):
-    kj = pl.program_id(1)
-    qi = pl.program_id(2)
+                    num_q_blocks: int, num_inner: int, seq_q: int,
+                    seq_k: int):
+    kj = pl.program_id(2)
+    t = pl.program_id(3)          # folds (group member, q block)
+    qi = t % num_q_blocks
 
-    @pl.when(qi == 0)
+    @pl.when(t == 0)
     def _init():
         dk_scr[:] = jnp.zeros_like(dk_scr)
         dv_scr[:] = jnp.zeros_like(dv_scr)
@@ -188,7 +288,7 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     run = True
     if causal:
         # q tiles strictly before the k tile's start contribute nothing.
-        run = qi * block_q + block_q - 1 >= kj * block_k
+        run = qi >= _first_valid_qi(kj, block_q, block_k)
 
     @pl.when(run)
     def _compute():
@@ -196,29 +296,32 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         k = _load2d(k_ref, kj, block_k, seq_k)
         v = _load2d(v_ref, kj, block_k, seq_k)
         do = _load2d(do_ref, qi, block_q, seq_q)
-        lse = _load1d(lse_ref, qi, block_q, seq_q)
-        delta = _load1d(delta_ref, qi, block_q, seq_q)
+        lse = _load_stat(lse_ref, qi, block_q, seq_q)
+        delta = _load_stat(delta_ref, qi, block_q, seq_q)
+        # One scaled [·, d] q block serves s = (q·scale)·kᵀ and
+        # dk += ds_hatᵀ·(q·scale) — no [bq, bk]-sized scale pass.
+        qs = q * jnp.asarray(scale, q.dtype)
         s = jax.lax.dot_general(
-            q, k, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32) * scale
-        s, valid = _mask_scores(s, qi, kj, block_q, block_k, causal, seq_q,
-                                seq_k)
-        p = jnp.where(valid, jnp.exp(s - lse[:, None]), 0.0)  # [bq, bk]
+            qs, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+            precision=_prec(q))
+        p = _p_block(s, lse, qi, kj, block_q, block_k, causal, seq_q,
+                     seq_k)                                 # [bq, bk]
         dv_scr[:] += jax.lax.dot_general(
-            p, do, (((0,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32)            # [bk, d]
+            p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32, precision=_prec(do))
         dp = jax.lax.dot_general(
             do, v, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32)
-        ds = p * (dp - delta[:, None]) * scale
+            preferred_element_type=jnp.float32, precision=_prec(v))
+        ds = (p * (dp - delta)).astype(q.dtype)
         dk_scr[:] += jax.lax.dot_general(
-            ds, q, (((0,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32)            # [bk, d]
+            ds, qs, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32, precision=_prec(q))
 
-    @pl.when(qi == num_q_blocks - 1)
+    @pl.when(t == num_inner - 1)
     def _finalize():
-        dk_ref[0] = dk_scr[:].astype(dk_ref.dtype)
-        dv_ref[0] = dv_scr[:].astype(dv_ref.dtype)
+        dk_ref[0, 0] = dk_scr[:].astype(dk_ref.dtype)
+        dv_ref[0, 0] = dv_scr[:].astype(dv_ref.dtype)
 
 
 # ---------------------------------------------------------------------------
@@ -235,35 +338,49 @@ def _round_up(x: int, m: int) -> int:
 
 
 def _fwd_impl(q, k, v, scale, causal, block_q, block_k):
-    bh, sq, d = q.shape
-    sk = k.shape[1]
-    block_q = min(block_q, _round_up(sq, 16))
+    b, h, sq, d = q.shape
+    hk = k.shape[1]
+    g = h // hk
+    sk = k.shape[2]
+    # q blocks round to 128: block_q is the stats blocks' LANE dim, which
+    # must be a multiple of 128 (k blocks only ever sit on sublanes → 16).
+    block_q = min(block_q, _round_up(sq, 128))
     block_k = min(block_k, _round_up(sk, 16))
     nq = pl.cdiv(sq, block_q)
     nk = pl.cdiv(sk, block_k)
     from jax.experimental.pallas import tpu as pltpu
+
+    def kv_j(i, j):
+        # Clamp fully-masked causal tiles to the previous fetch so the
+        # pipeline skips the DMA (revisited blocks are not re-fetched).
+        return jnp.minimum(j, _last_valid_kj(i, block_q, block_k)) \
+            if causal else j
+
     kernel = functools.partial(
         _fwd_kernel, scale=scale, causal=causal, block_q=block_q,
         block_k=block_k, num_k_blocks=nk, seq_q=sq, seq_k=sk)
     o, lse = pl.pallas_call(
         kernel,
-        grid=(bh, nq, nk),
+        grid=(b, h, nq, nk),
         in_specs=[
-            pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
-            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, 1, block_q, d), lambda b, h, i, j: (b, h, i, 0)),
+            pl.BlockSpec((1, 1, block_k, d),
+                         lambda b, h, i, j: (b, h // g, kv_j(i, j), 0)),
+            pl.BlockSpec((1, 1, block_k, d),
+                         lambda b, h, i, j: (b, h // g, kv_j(i, j), 0)),
         ],
         out_specs=[
-            pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((1, block_q, LANES), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, 1, block_q, d), lambda b, h, i, j: (b, h, i, 0)),
+            pl.BlockSpec((1, 1, STAT_SUB, block_q),
+                         lambda b, h, i, j: (b, h, 0, i)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((bh, sq, d), q.dtype),
-            jax.ShapeDtypeStruct((bh, sq, LANES), jnp.float32),
+            jax.ShapeDtypeStruct((b, h, sq, d), q.dtype),
+            jax.ShapeDtypeStruct((b, h, STAT_SUB, sq), jnp.float32),
         ],
         scratch_shapes=[
-            pltpu.VMEM((block_q,), jnp.float32),
-            pltpu.VMEM((block_q,), jnp.float32),
+            pltpu.VMEM((block_q, 1), jnp.float32),
+            pltpu.VMEM((block_q, 1), jnp.float32),
             pltpu.VMEM((block_q, d), jnp.float32),
         ],
         interpret=_interpret(),
@@ -272,55 +389,91 @@ def _fwd_impl(q, k, v, scale, causal, block_q, block_k):
 
 
 def _bwd_impl(q, k, v, o, lse, do, scale, causal, block_q, block_k):
-    bh, sq, d = q.shape
-    sk = k.shape[1]
-    block_q = min(block_q, _round_up(sq, 16))
+    b, h, sq, d = q.shape
+    hk = k.shape[1]
+    g = h // hk
+    sk = k.shape[2]
+    block_q = min(block_q, _round_up(sq, 128))
     block_k = min(block_k, _round_up(sk, 16))
     nq = pl.cdiv(sq, block_q)
     nk = pl.cdiv(sk, block_k)
     from jax.experimental.pallas import tpu as pltpu
     delta = jnp.broadcast_to(
         jnp.sum(o.astype(jnp.float32) * do.astype(jnp.float32),
-                axis=-1)[:, :, None],
-        (bh, sq, LANES))                     # lane-broadcast like lse
+                axis=-1)[:, :, None, :],
+        (b, h, STAT_SUB, sq))                        # sublane-bcast like lse
+
+    def kv_j(i, j):
+        return jnp.minimum(j, _last_valid_kj(i, block_q, block_k)) \
+            if causal else j
+
     dq = pl.pallas_call(
         functools.partial(_bwd_dq_kernel, scale=scale, causal=causal,
                           block_q=block_q, block_k=block_k, num_k_blocks=nk,
                           seq_q=sq, seq_k=sk),
-        grid=(bh, nq, nk),
+        grid=(b, h, nq, nk),
         in_specs=[
-            pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
-            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
-            pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((1, block_q, LANES), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((1, block_q, LANES), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, 1, block_q, d), lambda b, h, i, j: (b, h, i, 0)),
+            pl.BlockSpec((1, 1, block_k, d),
+                         lambda b, h, i, j: (b, h // g, kv_j(i, j), 0)),
+            pl.BlockSpec((1, 1, block_k, d),
+                         lambda b, h, i, j: (b, h // g, kv_j(i, j), 0)),
+            pl.BlockSpec((1, 1, block_q, d), lambda b, h, i, j: (b, h, i, 0)),
+            pl.BlockSpec((1, 1, STAT_SUB, block_q),
+                         lambda b, h, i, j: (b, h, 0, i)),
+            pl.BlockSpec((1, 1, STAT_SUB, block_q),
+                         lambda b, h, i, j: (b, h, 0, i)),
         ],
-        out_specs=pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
-        out_shape=jax.ShapeDtypeStruct((bh, sq, d), q.dtype),
+        out_specs=pl.BlockSpec((1, 1, block_q, d),
+                               lambda b, h, i, j: (b, h, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((b, h, sq, d), q.dtype),
         scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
         interpret=_interpret(),
     )(q, k, v, do, lse, delta)
+
+    # dk/dv: one grid cell per kv head; the g q-head group members are
+    # folded into the innermost loop (t = gi·nq + qi) and accumulated in
+    # VMEM — repeated K/V is never materialized, in either direction.
+    ni = g * nq
+
+    def qh(hk_, t):
+        return hk_ * g + t // nq
+
+    def q_i(j, t):
+        i = t % nq
+        # First q-tile with any unmasked element for k-tile j (causal);
+        # clamping masked tiles to it skips their DMA.
+        return jnp.maximum(i, _first_valid_qi(j, block_q, block_k)) \
+            if causal else i
+
     dk, dv = pl.pallas_call(
         functools.partial(_bwd_dkv_kernel, scale=scale, causal=causal,
                           block_q=block_q, block_k=block_k, num_q_blocks=nq,
-                          seq_q=sq, seq_k=sk),
-        grid=(bh, nk, nq),
+                          num_inner=ni, seq_q=sq, seq_k=sk),
+        grid=(b, hk, nk, ni),
         in_specs=[
-            pl.BlockSpec((1, block_q, d), lambda b, j, i: (b, i, 0)),
-            pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0)),
-            pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0)),
-            pl.BlockSpec((1, block_q, d), lambda b, j, i: (b, i, 0)),
-            pl.BlockSpec((1, block_q, LANES), lambda b, j, i: (b, i, 0)),
-            pl.BlockSpec((1, block_q, LANES), lambda b, j, i: (b, i, 0)),
+            pl.BlockSpec((1, 1, block_q, d),
+                         lambda b, hk_, j, t: (b, qh(hk_, t), q_i(j, t), 0)),
+            pl.BlockSpec((1, 1, block_k, d),
+                         lambda b, hk_, j, t: (b, hk_, j, 0)),
+            pl.BlockSpec((1, 1, block_k, d),
+                         lambda b, hk_, j, t: (b, hk_, j, 0)),
+            pl.BlockSpec((1, 1, block_q, d),
+                         lambda b, hk_, j, t: (b, qh(hk_, t), q_i(j, t), 0)),
+            pl.BlockSpec((1, 1, STAT_SUB, block_q),
+                         lambda b, hk_, j, t: (b, qh(hk_, t), 0, q_i(j, t))),
+            pl.BlockSpec((1, 1, STAT_SUB, block_q),
+                         lambda b, hk_, j, t: (b, qh(hk_, t), 0, q_i(j, t))),
         ],
         out_specs=[
-            pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0)),
-            pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0)),
+            pl.BlockSpec((1, 1, block_k, d),
+                         lambda b, hk_, j, t: (b, hk_, j, 0)),
+            pl.BlockSpec((1, 1, block_k, d),
+                         lambda b, hk_, j, t: (b, hk_, j, 0)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((bh, sk, d), k.dtype),
-            jax.ShapeDtypeStruct((bh, sk, d), v.dtype),
+            jax.ShapeDtypeStruct((b, hk, sk, d), k.dtype),
+            jax.ShapeDtypeStruct((b, hk, sk, d), v.dtype),
         ],
         scratch_shapes=[
             pltpu.VMEM((block_k, d), jnp.float32),
@@ -358,8 +511,10 @@ def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
                     block_q: int = 1024, block_k: int = 512) -> jax.Array:
     """Flash attention, layout ``[B, S, H, D]`` (GQA: H_kv may divide H).
 
-    Differentiable (custom flash backward); numerics in f32 accumulation
-    regardless of input dtype (bf16 in, bf16 out, f32 on-chip).
+    Differentiable (custom flash backward); accumulation in f32 regardless
+    of input dtype (bf16 in, bf16 out, f32 softmax state on-chip), matmuls
+    at the input dtype's MXU rate. GQA K/V are indexed in the BlockSpecs,
+    never repeated.
 
     Default blocks (1024, 512) come from a v5e sweep on the 317M flagship
     at seq 2048: 128×128 grid points are too small to amortize per-tile
@@ -378,21 +533,12 @@ def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     if k.shape[2] != v.shape[2]:
         raise ValueError(f"k heads ({k.shape[2]}) != v heads "
                          f"({v.shape[2]})")
-    if h != hk:
-        if h % hk:
-            raise ValueError(f"q heads {h} not a multiple of kv heads {hk}")
-        # TODO(gqa): materializes repeated K/V (h/hk× their HBM + bandwidth).
-        # The zero-copy alternative maps the kv-head inside the BlockSpec
-        # index maps (kv = (bh//h)*hk + (bh%h)//g) and restructures the dkv
-        # grid to accumulate over the g group members; revisit if K/V traffic
-        # shows up in profiles at 8B scale.
-        k = jnp.repeat(k, h // hk, axis=2)
-        v = jnp.repeat(v, h // hk, axis=2)
-    sk = k.shape[1]
+    if h % hk:
+        raise ValueError(f"q heads {h} not a multiple of kv heads {hk}")
     scale = scale if scale is not None else d ** -0.5
-    # [B,S,H,D] → [B·H, S, D]
-    qf = q.transpose(0, 2, 1, 3).reshape(b * h, sq, d)
-    kf = k.transpose(0, 2, 1, 3).reshape(b * h, sk, d)
-    vf = v.transpose(0, 2, 1, 3).reshape(b * h, sk, d)
-    of = _flash(qf, kf, vf, scale, causal, block_q, block_k)
-    return of.reshape(b, h, sq, d).transpose(0, 2, 1, 3)
+    # [B,S,H,D] → [B, H, S, D] views for the kernels
+    qh = q.transpose(0, 2, 1, 3)
+    kh = k.transpose(0, 2, 1, 3)
+    vh = v.transpose(0, 2, 1, 3)
+    oh = _flash(qh, kh, vh, scale, causal, block_q, block_k)
+    return oh.transpose(0, 2, 1, 3)
