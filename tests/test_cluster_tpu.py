@@ -1,0 +1,157 @@
+"""TPU-slice backend: atomic slice leases, gang placement over hosts,
+host-loss → whole-job retry, capacity denial.
+
+This is the e2e coverage for SURVEY.md §7 hard part (a) — "partial
+allocation states that YARN tolerated must become atomic slice leases" —
+the analogue of the reference's container-allocation path
+(``RMCallbackHandler``/``ContainerLauncher``,
+``ApplicationMaster.java:1051-1175``) exercised through the full
+client→coordinator→executor stack with the FakeSliceProvisioner standing
+in for the Cloud TPU API (MiniCluster role, SURVEY.md §4.1).
+"""
+
+import os
+import sys
+import time
+
+import pytest
+
+from tony_tpu import constants
+from tony_tpu.cluster.base import TaskLaunchSpec
+from tony_tpu.cluster.tpu import (FakeSliceProvisioner, HOST_LOST_EXIT,
+                                  SliceProvisionError, TpuSliceBackend)
+from tony_tpu.conf import keys as K
+
+from test_e2e import SCRIPTS, _dump_task_logs, make_conf, submit
+
+
+def slice_conf(tmp_path, script, workers=2, n_hosts=2, inventory=0,
+               extra=None):
+    conf = make_conf(tmp_path, script, workers=workers, extra=extra)
+    conf.set(K.APPLICATION_BACKEND, "tpu-slice")
+    conf.set(K.SLICE_PROVISIONER, "fake")
+    conf.set(K.SLICE_NUM_HOSTS, n_hosts)
+    if inventory:
+        conf.set(K.SLICE_FAKE_INVENTORY, inventory)
+    return conf
+
+
+# ---------------------------------------------------------------------------
+# Backend-level (no coordinator): lease + placement mechanics
+# ---------------------------------------------------------------------------
+def _spec(task_id):
+    job, _, idx = task_id.partition(":")
+    return TaskLaunchSpec(
+        task_id=task_id, job_name=job, index=int(idx), command="true",
+        env={constants.COORDINATOR_HOST: "127.0.0.1",
+             constants.COORDINATOR_PORT: "1",
+             constants.JOB_NAME: job, constants.TASK_INDEX: str(idx)})
+
+
+def test_lease_is_atomic_all_or_nothing(tmp_path):
+    prov = FakeSliceProvisioner(3, str(tmp_path))
+    lease = prov.acquire(2)
+    assert len(lease.hosts) == 2
+    # Only 1 host left: a 2-host request must be denied whole, not split.
+    with pytest.raises(SliceProvisionError):
+        prov.acquire(2)
+    prov.release(lease)
+    assert len(prov.acquire(2).hosts) == 2
+
+
+def test_round_robin_placement_and_host_env(tmp_path):
+    prov = FakeSliceProvisioner(2, str(tmp_path / "hosts"))
+    backend = TpuSliceBackend(prov, 2, str(tmp_path / "work"),
+                              python=sys.executable)
+    try:
+        handles = [backend.launch_task(_spec(f"worker:{i}"))
+                   for i in range(4)]
+    finally:
+        backend.stop()
+    hosts = [h.host.host_id for h in handles]
+    assert hosts == ["fakehost-0", "fakehost-1"] * 2  # round-robin
+    # per-host local ordinals count up independently on each host
+    ordinals = [h.spec.env["TONY_HOST_LOCAL_ORDINAL"] for h in handles]
+    assert ordinals == ["0", "0", "1", "1"]
+    assert all(h.spec.env["TONY_HOST_ID"] == h.host.host_id
+               for h in handles)
+
+
+def test_host_loss_reports_all_its_tasks(tmp_path):
+    prov = FakeSliceProvisioner(2, str(tmp_path / "hosts"))
+    backend = TpuSliceBackend(prov, 2, str(tmp_path / "work"),
+                              python=sys.executable)
+    try:
+        for i in range(4):
+            backend.launch_task(_spec(f"worker:{i}"))
+        prov.fail_host("fakehost-0")
+        deadline = time.time() + 10
+        lost = {}
+        while time.time() < deadline and len(lost) < 2:
+            for tid, rc in backend.poll_completions():
+                if rc == HOST_LOST_EXIT:
+                    lost[tid] = rc
+            time.sleep(0.05)
+        # worker:0 and worker:2 were placed on fakehost-0
+        assert set(lost) >= {"worker:0", "worker:2"}, lost
+    finally:
+        backend.stop()
+
+
+def test_releasing_broken_lease_re_leases_healthy_hosts(tmp_path):
+    prov = FakeSliceProvisioner(3, str(tmp_path / "hosts"))
+    backend = TpuSliceBackend(prov, 2, str(tmp_path / "work"),
+                              python=sys.executable)
+    try:
+        backend.launch_task(_spec("worker:0"))
+        first = {h.host_id for h in backend.lease.hosts}
+        prov.fail_host(sorted(first)[0])
+        backend.launch_task(_spec("worker:1"))   # triggers re-lease
+        second = {h.host_id for h in backend.lease.hosts}
+        assert sorted(first)[0] not in second
+        assert len(second) == 2
+    finally:
+        backend.stop()
+
+
+# ---------------------------------------------------------------------------
+# Full-stack e2e through client → coordinator → slice backend → executors
+# ---------------------------------------------------------------------------
+def test_e2e_gang_over_two_fake_hosts_succeeds(tmp_path):
+    conf = slice_conf(tmp_path, "check_env.py", workers=3, n_hosts=2)
+    client, rec, code = submit(conf, tmp_path)
+    assert code == 0, _dump_task_logs(client)
+    assert rec.finished[0] == "SUCCEEDED"
+    # the gang really spanned both fake hosts (task dirs live under
+    # <workdir>/jobs/<app_id>/tasks/<host_id>/)
+    workroot = tmp_path / "work" / "jobs" / rec.app_id / "tasks"
+    hostdirs = sorted(d for d in os.listdir(str(workroot))
+                      if d.startswith("fakehost-"))
+    assert hostdirs == ["fakehost-0", "fakehost-1"]
+
+
+def test_e2e_capacity_denial_fails_job(tmp_path):
+    """2-host slice from a 1-host inventory: the all-or-nothing lease is
+    denied, the job fails cleanly (no partial gang, no hang)."""
+    conf = slice_conf(tmp_path, "exit_0.py", workers=2, n_hosts=2,
+                      inventory=1)
+    client, rec, code = submit(conf, tmp_path)
+    assert code == constants.EXIT_FAILURE
+    assert rec.finished[0] == "FAILED"
+    assert "launch" in (rec.finished[1].get("failure_reason") or "")
+
+
+def test_e2e_host_loss_triggers_retry_and_recovers(tmp_path, monkeypatch):
+    """Host dies mid-job → its tasks report HOST_LOST_EXIT → chief failure
+    policy fails the session → whole-job retry releases the broken lease,
+    re-leases healthy hosts, epoch 1 succeeds (reference retry semantics
+    ``ApplicationMaster.java:356-371`` over slice leases)."""
+    monkeypatch.setenv(constants.TEST_SLICE_FAIL_HOST, "fakehost-0")
+    conf = slice_conf(
+        tmp_path, "sleep_5.py", workers=2, n_hosts=2, inventory=3,
+        extra={K.APPLICATION_RETRY_COUNT: 1,
+               K.TASK_REGISTRATION_TIMEOUT_S: 60})
+    client, rec, code = submit(conf, tmp_path)
+    assert code == 0, _dump_task_logs(client)
+    assert rec.finished[0] == "SUCCEEDED"
+    assert int(rec.finished[1].get("attempt", 0)) == 1  # recovered on retry

@@ -1,2 +1,5 @@
 from tony_tpu.cluster.base import Backend, TaskLaunchSpec  # noqa: F401
 from tony_tpu.cluster.local import LocalProcessBackend  # noqa: F401
+from tony_tpu.cluster.tpu import (  # noqa: F401
+    FakeSliceProvisioner, SliceLease, SliceProvisionError, SliceProvisioner,
+    StaticSshProvisioner, TpuSliceBackend)

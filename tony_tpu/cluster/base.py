@@ -5,11 +5,13 @@ This replaces the reference's YARN substrate (RM container allocation
 NM container launch ``ContainerLauncher.run`` :1108-1175) with a minimal
 lease-style interface the coordinator drives directly:
 
-- ``LocalProcessBackend`` — subprocesses on this host; the MiniCluster
-  analogue (``tony-mini/.../MiniCluster.java:43-63``) and also the real
-  single-TPU-VM path (one process per local chip group).
-- ``TpuSliceBackend`` (``tpu.py``) — provisions/leases Cloud TPU slices and
-  launches per-host agents; gated because this environment has no egress.
+- ``LocalProcessBackend`` (``local.py``) — subprocesses on this host; the
+  MiniCluster analogue (``tony-mini/.../MiniCluster.java:43-63``) and also
+  the real single-TPU-VM path (one process per local chip group).
+- ``TpuSliceBackend`` (``tpu.py``) — gang launch over an atomically leased
+  multi-host slice via a ``SliceProvisioner`` (ssh inventory for real TPU
+  VMs, ``FakeSliceProvisioner`` for hardware-free e2e, incl. host-loss and
+  capacity-denial fault injection).
 
 A backend launches whole tasks-with-environments and reports exits; it knows
 nothing about rendezvous, heartbeats or failure policy — those live in the

@@ -1,0 +1,481 @@
+"""TPU-slice backend: gang launch over leased multi-host slices.
+
+The reference acquires compute incrementally — YARN grants containers one
+callback at a time (``RMCallbackHandler.onContainersAllocated``
+``ApplicationMaster.java:1051-1070``) and each is launched on its
+NodeManager (``ContainerLauncher.run`` :1108-1175). A TPU pod slice is NOT
+incremental: the interconnect topology makes a slice indivisible, so the
+cluster substrate here is a **lease**: a provisioner grants a whole slice
+(all hosts) or nothing (SURVEY.md §7 hard part (a)), and losing any host
+invalidates the lease — the whole gang fails and the coordinator's existing
+failure policy / whole-job retry takes over (the analogue of
+``onTaskDeemedDead`` → AM reset, ``ApplicationMaster.java:1178-1185``,
+:559-575).
+
+Three layers:
+
+- ``HostChannel`` — exec/kill/poll on one TPU VM. ``SshHostChannel`` is the
+  production shape (plain ssh; TPU VMs are reachable hosts, no cluster
+  manager needed). ``LocalSimHostChannel`` runs the same contract as local
+  subprocesses so the full gang-over-hosts path is e2e-testable on one
+  machine (the MiniCluster role, ``tony-mini/.../MiniCluster.java:43-63``).
+- ``SliceProvisioner`` — ``acquire(n_hosts)`` → all-or-nothing
+  ``SliceLease``. ``StaticSshProvisioner`` leases from a fixed host list;
+  ``FakeSliceProvisioner`` simulates an inventory, including host **loss**
+  mid-job (``fail_host``) and capacity denial, for the fault e2e matrix.
+- ``TpuSliceBackend`` — the ``Backend`` implementation: leases on first
+  launch, places tasks round-robin over the slice's hosts, exports
+  ``TONY_HOST_ID`` / per-host ``TPU_PROCESS_*`` ordinals, surfaces host
+  loss as synthetic exit codes for every task on the lost host.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import shlex
+import signal
+import subprocess
+import sys
+import threading
+import time
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from tony_tpu.cluster.base import Backend, TaskLaunchSpec
+
+log = logging.getLogger(__name__)
+
+# Exit code reported for tasks whose HOST died under them (distinct from
+# any user exit so failure policy/logs can tell "your code crashed" from
+# "the machine went away"). 128+SIGKILL by convention.
+HOST_LOST_EXIT = 137
+
+
+class HostChannel:
+    """Exec/kill/poll on one host of a slice."""
+
+    host_id: str
+
+    def exec_task(self, task_id: str, argv: Sequence[str],
+                  env: Dict[str, str], workdir: str) -> object:
+        raise NotImplementedError
+
+    def kill(self, handle: object, grace_s: float = 0.0) -> None:
+        raise NotImplementedError
+
+    def poll(self, handle: object) -> Optional[int]:
+        """Exit code if the task finished, else None."""
+        raise NotImplementedError
+
+    def alive(self) -> bool:
+        """Is the host itself still reachable?"""
+        return True
+
+    def log_paths(self, handle: object) -> Optional[Tuple[str, str]]:
+        return None
+
+
+class LocalSimHostChannel(HostChannel):
+    """A 'host' that is really a local process group — same contract as a
+    remote TPU VM, minus the network. Used by FakeSliceProvisioner."""
+
+    def __init__(self, host_id: str, workroot: str):
+        self.host_id = host_id
+        self.workroot = workroot
+        self._alive = True
+        self._procs: List[subprocess.Popen] = []
+        self._lock = threading.Lock()
+
+    def exec_task(self, task_id, argv, env, workdir):
+        os.makedirs(workdir, exist_ok=True)
+        full_env = dict(os.environ)
+        full_env.update(env)
+        repo_root = os.path.dirname(os.path.dirname(
+            os.path.dirname(os.path.abspath(__file__))))
+        full_env["PYTHONPATH"] = (repo_root + os.pathsep
+                                  + full_env.get("PYTHONPATH", "")
+                                  ).rstrip(os.pathsep)
+        stdout = open(os.path.join(workdir, "stdout.log"), "ab")
+        stderr = open(os.path.join(workdir, "stderr.log"), "ab")
+        popen = subprocess.Popen(
+            list(argv), cwd=workdir, env=full_env, stdout=stdout,
+            stderr=stderr, start_new_session=True)
+        with self._lock:
+            self._procs.append(popen)
+        return {"popen": popen, "workdir": workdir}
+
+    def kill(self, handle, grace_s: float = 0.0) -> None:
+        popen = handle["popen"]
+        if popen.poll() is not None:
+            return
+        try:
+            os.killpg(popen.pid, signal.SIGTERM)
+        except (ProcessLookupError, PermissionError):
+            return
+        deadline = time.time() + grace_s
+        while time.time() < deadline and popen.poll() is None:
+            time.sleep(0.05)
+        if popen.poll() is None:
+            try:
+                os.killpg(popen.pid, signal.SIGKILL)
+            except (ProcessLookupError, PermissionError):
+                pass
+
+    def poll(self, handle) -> Optional[int]:
+        if not self._alive:
+            return HOST_LOST_EXIT
+        rc = handle["popen"].poll()
+        if rc is None:
+            return None
+        return 128 - rc if rc < 0 else rc
+
+    def alive(self) -> bool:
+        return self._alive
+
+    def log_paths(self, handle):
+        wd = handle["workdir"]
+        return (os.path.join(wd, "stdout.log"),
+                os.path.join(wd, "stderr.log"))
+
+    def simulate_loss(self) -> None:
+        """The host 'disappears': every process on it dies instantly and
+        the channel reports dead."""
+        self._alive = False
+        with self._lock:
+            procs = list(self._procs)
+        for p in procs:
+            if p.poll() is None:
+                try:
+                    os.killpg(p.pid, signal.SIGKILL)
+                except (ProcessLookupError, PermissionError):
+                    pass
+
+
+class SshHostChannel(HostChannel):
+    """Run executors on a remote TPU VM over plain ssh.
+
+    The remote command writes its process-group id to ``<workdir>/task.pid``
+    so kill() can signal the group from a second ssh exec; ssh itself exits
+    with the remote command's code (255 = ssh transport failure = host
+    loss). Assumes the job bundle is reachable from the VM (a shared
+    filesystem or the remote store — ``tony_tpu.storage``)."""
+
+    def __init__(self, host_id: str, ssh_target: str,
+                 ssh_args: Optional[List[str]] = None,
+                 python: str = "python3"):
+        self.host_id = host_id
+        self.ssh_target = ssh_target
+        self.ssh_args = list(ssh_args or
+                             ["-o", "BatchMode=yes",
+                              "-o", "ConnectTimeout=10",
+                              "-o", "StrictHostKeyChecking=accept-new"])
+        self.python = python
+        self._alive_cache: Optional[Tuple[float, bool]] = None
+
+    def _ssh(self, remote_cmd: str, **popen_kw) -> subprocess.Popen:
+        return subprocess.Popen(
+            ["ssh", *self.ssh_args, self.ssh_target, remote_cmd],
+            **popen_kw)
+
+    def exec_task(self, task_id, argv, env, workdir):
+        exports = " ".join(
+            f"export {k}={shlex.quote(v)};" for k, v in env.items())
+        cmd = " ".join(shlex.quote(a) for a in argv)
+        remote = (
+            f"mkdir -p {shlex.quote(workdir)} && cd {shlex.quote(workdir)} "
+            f"&& echo $$ > task.pid && {exports} exec {cmd} "
+            f"> stdout.log 2> stderr.log")
+        popen = self._ssh(remote)
+        return {"popen": popen, "workdir": workdir}
+
+    def kill(self, handle, grace_s: float = 0.0) -> None:
+        wd = shlex.quote(handle["workdir"])
+        sig = "TERM"
+        for attempt in range(2):
+            k = self._ssh(
+                f"test -f {wd}/task.pid && kill -{sig} -$(cat {wd}/task.pid)"
+                " 2>/dev/null || true",
+                stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
+            try:
+                k.wait(timeout=15)
+            except subprocess.TimeoutExpired:
+                k.kill()
+            if attempt == 0:
+                deadline = time.time() + grace_s
+                while (time.time() < deadline
+                       and handle["popen"].poll() is None):
+                    time.sleep(0.1)
+                if handle["popen"].poll() is not None:
+                    return
+                sig = "KILL"
+
+    def poll(self, handle) -> Optional[int]:
+        rc = handle["popen"].poll()
+        if rc is None:
+            return None
+        if rc == 255:           # ssh transport failure → host unreachable
+            return HOST_LOST_EXIT
+        return 128 - rc if rc < 0 else rc
+
+    def alive(self) -> bool:
+        # A real ssh probe per call would serialize 15 s round trips into
+        # every launch (lost_hosts() runs before each one) — cache for 5 s.
+        now = time.monotonic()
+        if self._alive_cache is not None and now - self._alive_cache[0] < 5:
+            return self._alive_cache[1]
+        probe = self._ssh("true", stdout=subprocess.DEVNULL,
+                          stderr=subprocess.DEVNULL)
+        try:
+            ok = probe.wait(timeout=15) == 0
+        except subprocess.TimeoutExpired:
+            probe.kill()
+            ok = False
+        self._alive_cache = (now, ok)
+        return ok
+
+
+class SliceLease:
+    """An atomic grant of a whole slice: every host or none."""
+
+    def __init__(self, slice_id: str, hosts: List[HostChannel]):
+        self.slice_id = slice_id
+        self.hosts = hosts
+
+    def lost_hosts(self) -> List[HostChannel]:
+        return [h for h in self.hosts if not h.alive()]
+
+
+class SliceProvisionError(RuntimeError):
+    """The provisioner cannot grant the requested slice."""
+
+
+class SliceProvisioner:
+    def acquire(self, n_hosts: int, node_pool: str = "") -> SliceLease:
+        """Grant a slice of ``n_hosts`` hosts atomically, or raise
+        SliceProvisionError. Never returns a partial slice."""
+        raise NotImplementedError
+
+    def release(self, lease: SliceLease) -> None:
+        raise NotImplementedError
+
+
+class StaticSshProvisioner(SliceProvisioner):
+    """Leases from a fixed inventory of ssh-reachable TPU VMs (the
+    operator's host list — e.g. the VMs of one pre-created pod slice)."""
+
+    def __init__(self, ssh_targets: List[str], python: str = "python3"):
+        self.targets = list(ssh_targets)
+        self.python = python
+        self._leased: Dict[str, List[str]] = {}
+        self._lock = threading.Lock()
+        self._n = 0
+
+    def acquire(self, n_hosts: int, node_pool: str = "") -> SliceLease:
+        with self._lock:
+            used = {t for ts in self._leased.values() for t in ts}
+            free = [t for t in self.targets if t not in used]
+            if len(free) < n_hosts:
+                raise SliceProvisionError(
+                    f"need {n_hosts} hosts, only {len(free)} of "
+                    f"{len(self.targets)} free")
+            grant = free[:n_hosts]
+            self._n += 1
+            slice_id = f"slice-{self._n}"
+            self._leased[slice_id] = grant
+        hosts: List[HostChannel] = [
+            SshHostChannel(host_id=t, ssh_target=t, python=self.python)
+            for t in grant]
+        return SliceLease(slice_id, hosts)
+
+    def release(self, lease: SliceLease) -> None:
+        with self._lock:
+            self._leased.pop(lease.slice_id, None)
+
+
+class FakeSliceProvisioner(SliceProvisioner):
+    """In-memory slice inventory over LocalSimHostChannels: the test double
+    that lets the gang-over-hosts path (grant, placement, host loss,
+    capacity denial) run e2e with REAL executors and no hardware."""
+
+    def __init__(self, n_hosts: int, workroot: str):
+        self.workroot = workroot
+        self._hosts = {
+            f"fakehost-{i}": LocalSimHostChannel(
+                f"fakehost-{i}", os.path.join(workroot, f"fakehost-{i}"))
+            for i in range(n_hosts)}
+        self._leased: Dict[str, List[str]] = {}
+        self._lock = threading.Lock()
+        self._n = 0
+
+    def acquire(self, n_hosts: int, node_pool: str = "") -> SliceLease:
+        with self._lock:
+            used = {h for hs in self._leased.values() for h in hs}
+            free = [h for h, ch in self._hosts.items()
+                    if h not in used and ch.alive()]
+            if len(free) < n_hosts:
+                raise SliceProvisionError(
+                    f"need {n_hosts} hosts, only {len(free)} healthy/free")
+            grant = free[:n_hosts]
+            self._n += 1
+            slice_id = f"fakeslice-{self._n}"
+            self._leased[slice_id] = grant
+            return SliceLease(slice_id, [self._hosts[h] for h in grant])
+
+    def release(self, lease: SliceLease) -> None:
+        with self._lock:
+            self._leased.pop(lease.slice_id, None)
+
+    def fail_host(self, host_id: str) -> None:
+        """Simulate sudden host loss (preemption / hardware failure)."""
+        self._hosts[host_id].simulate_loss()
+
+
+class _SliceTask:
+    def __init__(self, spec: TaskLaunchSpec, host: HostChannel,
+                 handle: object):
+        self.spec = spec
+        self.host = host
+        self.handle = handle
+        self.reported = False
+
+
+class TpuSliceBackend(Backend):
+    """Gang launch over a leased TPU slice (see module docstring).
+
+    The lease is acquired lazily at the first ``launch_task`` — the
+    coordinator launches gangs task-by-task, and the all-or-nothing
+    semantics live in ``SliceProvisioner.acquire``. Host loss is detected
+    on ``poll_completions`` (dead channel → every task on that host reports
+    ``HOST_LOST_EXIT``), feeding the coordinator's normal chief/worker
+    failure policy and whole-job retry."""
+
+    def __init__(self, provisioner: SliceProvisioner, n_hosts: int,
+                 workdir: str, python: str = sys.executable,
+                 node_pool: str = ""):
+        self.provisioner = provisioner
+        self.n_hosts = n_hosts
+        self.workdir = workdir
+        self.python = python
+        self.node_pool = node_pool
+        self.lease: Optional[SliceLease] = None
+        self._tasks: Dict[str, _SliceTask] = {}
+        self._next_host = 0
+        self._host_tasks: Dict[str, int] = {}
+        self._lock = threading.Lock()
+        self._test_fail_done = False
+        self._last_launch = 0.0
+
+    # -- lease ---------------------------------------------------------
+    def _gang_active(self) -> bool:
+        """Any launched task still running on a live host of the current
+        lease? (Terminal = already reported, or poll() returns a code.)"""
+        with self._lock:
+            tasks = list(self._tasks.values())
+        return any(not st.reported and st.host.poll(st.handle) is None
+                   for st in tasks)
+
+    def _ensure_lease(self) -> SliceLease:
+        if self.lease is not None and self.lease.lost_hosts():
+            # A slice with a dead host is invalid as a whole (the ICI mesh
+            # is broken) — release it and lease a fresh one. Only legal
+            # once the old gang is fully down (the retry-epoch path: the
+            # coordinator killed the gang and is relaunching, reference
+            # reset :559-575). Re-leasing mid-gang would split the gang
+            # across slices and double-book the old lease's healthy hosts.
+            if self._gang_active():
+                raise SliceProvisionError(
+                    f"lease {self.lease.slice_id} lost hosts "
+                    f"{[h.host_id for h in self.lease.lost_hosts()]} while "
+                    f"its gang is still running — kill the gang first")
+            log.warning("lease %s lost hosts %s; re-leasing",
+                        self.lease.slice_id,
+                        [h.host_id for h in self.lease.lost_hosts()])
+            self.provisioner.release(self.lease)
+            self.lease = None
+        if self.lease is None:
+            self.lease = self.provisioner.acquire(self.n_hosts,
+                                                  self.node_pool)
+            with self._lock:
+                self._next_host = 0
+                self._host_tasks = {}
+            log.info("leased %s: hosts=%s", self.lease.slice_id,
+                     [h.host_id for h in self.lease.hosts])
+        return self.lease
+
+    def _maybe_test_fail_host(self) -> None:
+        """TEST_SLICE_FAIL_HOST hook (see constants.py): once per job, after
+        the gang has had a moment to start, kill the named fake host."""
+        from tony_tpu import constants
+        target = os.environ.get(constants.TEST_SLICE_FAIL_HOST, "")
+        if not target or self._test_fail_done or self.lease is None:
+            return
+        if not self._tasks or time.monotonic() - self._last_launch < 0.7:
+            return
+        for h in self.lease.hosts:
+            if h.host_id == target and hasattr(h, "simulate_loss"):
+                log.warning("TEST hook: simulating loss of host %s", target)
+                h.simulate_loss()
+                self._test_fail_done = True
+                return
+
+    # -- Backend -------------------------------------------------------
+    def launch_task(self, spec: TaskLaunchSpec) -> object:
+        lease = self._ensure_lease()
+        with self._lock:
+            host = lease.hosts[self._next_host % len(lease.hosts)]
+            self._next_host += 1
+            local_ordinal = self._host_tasks.get(host.host_id, 0)
+            self._host_tasks[host.host_id] = local_ordinal + 1
+        env = dict(spec.env)
+        env["TONY_HOST_ID"] = host.host_id
+        env["TONY_HOST_LOCAL_ORDINAL"] = str(local_ordinal)
+        spec.env = env          # the spec records what actually ran
+        workdir = os.path.join(self.workdir, host.host_id,
+                               spec.task_id.replace(":", "_"))
+        handle = host.exec_task(
+            spec.task_id, [self.python, "-m", "tony_tpu.executor"], env,
+            workdir)
+        st = _SliceTask(spec, host, handle)
+        with self._lock:
+            self._tasks[spec.task_id] = st
+        self._last_launch = time.monotonic()
+        log.info("launched %s on %s", spec.task_id, host.host_id)
+        return st
+
+    def kill_task(self, handle: object, grace_s: float = 0.0) -> None:
+        if isinstance(handle, _SliceTask):
+            handle.host.kill(handle.handle, grace_s=grace_s)
+
+    def poll_completions(self) -> List[Tuple[str, int]]:
+        self._maybe_test_fail_host()
+        done: List[Tuple[str, int]] = []
+        with self._lock:
+            tasks = list(self._tasks.values())
+        for st in tasks:
+            if st.reported:
+                continue
+            rc = st.host.poll(st.handle)
+            if rc is not None:
+                st.reported = True
+                if rc == HOST_LOST_EXIT and not st.host.alive():
+                    log.warning("host %s lost; %s reported exit %d",
+                                st.host.host_id, st.spec.task_id, rc)
+                done.append((st.spec.task_id, rc))
+        return done
+
+    def task_log_paths(self, task_id: str) -> Optional[Tuple[str, str]]:
+        with self._lock:
+            st = self._tasks.get(task_id)
+        if st is None:
+            return None
+        return st.host.log_paths(st.handle)
+
+    def stop(self) -> None:
+        with self._lock:
+            tasks = list(self._tasks.values())
+        for st in tasks:
+            if st.host.alive():
+                st.host.kill(st.handle, grace_s=0.5)
+        if self.lease is not None:
+            self.provisioner.release(self.lease)
+            self.lease = None

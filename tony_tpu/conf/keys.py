@@ -53,6 +53,28 @@ APPLICATION_RETRY_COUNT = _key(
     "tony.application.retry-count", 0, int,
     "Coordinator-level whole-job retries (reference tony.am.retry-count, "
     "ApplicationMaster.java:356-371).")
+APPLICATION_BACKEND = _key(
+    "tony.application.backend", "local", str,
+    "Cluster substrate: local (subprocesses on this host, the MiniCluster "
+    "analogue) | tpu-slice (gang over a leased multi-host slice, "
+    "cluster/tpu.py — the analogue of YARN container allocation, "
+    "ApplicationMaster.java:1051-1175).")
+SLICE_PROVISIONER = _key(
+    "tony.slice.provisioner", "fake", str,
+    "tpu-slice backend only: fake (LocalSimHostChannel inventory for "
+    "tests/CI) | ssh (StaticSshProvisioner over tony.slice.hosts).")
+SLICE_NUM_HOSTS = _key(
+    "tony.slice.num-hosts", 1, int,
+    "tpu-slice backend only: hosts per slice lease (all-or-nothing grant; "
+    "SURVEY.md §7(a) slice-lease atomicity).")
+SLICE_HOSTS = _key(
+    "tony.slice.hosts", "", str,
+    "tpu-slice+ssh only: comma-separated ssh targets (TPU VM inventory).")
+SLICE_FAKE_INVENTORY = _key(
+    "tony.slice.fake-inventory", 0, int,
+    "tpu-slice+fake only: total fake hosts in the provisioner inventory; "
+    "0 means same as tony.slice.num-hosts (deny-capacity tests set it "
+    "lower).")
 APPLICATION_ENABLE_PREPROCESS = _key(
     "tony.application.enable-preprocess", False, bool,
     "Run the coordinator-local command as a preprocessing stage before "

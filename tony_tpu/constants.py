@@ -114,6 +114,10 @@ TEST_COMPLETION_DELAY = "TONY_TEST_COMPLETION_DELAY"
 # the coordinator-side registration timeout is exercisable E2E; reference
 # registration timeout, ApplicationMaster.java:791-888).
 TEST_SKIP_REGISTRATION = "TONY_TEST_SKIP_REGISTRATION"
+# "<host_id>" — the TpuSliceBackend simulates sudden loss of that host
+# (preemption/hardware death) shortly after the gang launches, once per job
+# (fake provisioner only; exercises slice-lease invalidation → retry).
+TEST_SLICE_FAIL_HOST = "TONY_TEST_SLICE_FAIL_HOST"
 
 # Untracked jobtypes: run-forever tasks (parameter servers) whose exit does not
 # gate job completion (reference TonyConfigurationKeys.java:252-253).

@@ -22,6 +22,35 @@ from tony_tpu.coordinator.coordinator import Coordinator
 from tony_tpu.coordinator.session import SessionStatus
 
 
+def _make_backend(conf, workdir):
+    """Backend selection (tony.application.backend): local subprocesses or
+    a leased multi-host slice (cluster/tpu.py)."""
+    from tony_tpu.conf import keys as K
+
+    kind = str(conf.get(K.APPLICATION_BACKEND, "local"))
+    if kind == "local":
+        return LocalProcessBackend(workdir)
+    if kind == "tpu-slice":
+        from tony_tpu.cluster.tpu import (FakeSliceProvisioner,
+                                          StaticSshProvisioner,
+                                          TpuSliceBackend)
+
+        n_hosts = int(conf.get(K.SLICE_NUM_HOSTS, 1))
+        prov_kind = str(conf.get(K.SLICE_PROVISIONER, "fake"))
+        if prov_kind == "ssh":
+            targets = [t.strip()
+                       for t in str(conf.get(K.SLICE_HOSTS, "")).split(",")
+                       if t.strip()]
+            prov = StaticSshProvisioner(targets)
+        elif prov_kind == "fake":
+            inv = int(conf.get(K.SLICE_FAKE_INVENTORY, 0)) or n_hosts
+            prov = FakeSliceProvisioner(inv, os.path.join(workdir, "hosts"))
+        else:
+            raise ValueError(f"unknown tony.slice.provisioner {prov_kind!r}")
+        return TpuSliceBackend(prov, n_hosts, workdir)
+    raise ValueError(f"unknown tony.application.backend {kind!r}")
+
+
 def main(argv=None) -> int:
     logging.basicConfig(
         level=logging.INFO,
@@ -38,7 +67,7 @@ def main(argv=None) -> int:
     args = p.parse_args(argv)
 
     conf = TonyTpuConfig.load_final(args.conf)
-    backend = LocalProcessBackend(args.workdir)
+    backend = _make_backend(conf, args.workdir)
     coord = Coordinator(conf, args.app_id, backend, args.history_root,
                         user=args.user)
     host, port = "", 0

@@ -198,7 +198,15 @@ class Coordinator:
                 command=job.command, env=self._task_env(task),
                 vcores=job.vcores, memory=job.memory, chips=job.chips,
                 node_pool=job.node_pool)
-            task.handle = self.backend.launch_task(spec)
+            try:
+                task.handle = self.backend.launch_task(spec)
+            except Exception as e:  # noqa: BLE001 — e.g. SliceProvisionError
+                # An unlaunchable gang is a session failure (subject to the
+                # normal retry budget), not a coordinator crash — the
+                # analogue of an unserviceable container request.
+                log.error("launch of %s failed: %s", task.task_id, e)
+                self.session.fail(f"launch of {task.task_id} failed: {e}")
+                return
             # Each gang launch restarts the registration-timeout clock; the
             # timeout gates on launched-but-unregistered tasks (scoped like
             # the barrier), so a long-running earlier DAG stage can't trip it.
