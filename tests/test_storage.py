@@ -1,0 +1,149 @@
+"""Remote storage: Store interface (file:// + fake gs://), credential
+passthrough, and store-backed staging/localization end-to-end.
+
+Reference model: HDFS upload + container localization
+(``TonyClient.processFinalTonyConf`` :189-228, ``HdfsUtils.java:115-160``)
+with delegation tokens shipped with the job
+(``security/TokenCache.java:44-51``). The e2e here proves executors fetch
+bundle/resources/venv/frozen-config THROUGH the store API (gs:// URLs in
+the frozen config), never via a client-local path.
+"""
+
+import os
+import zipfile
+
+import pytest
+
+from tony_tpu import constants
+from tony_tpu.conf import keys as K
+from tony_tpu.storage import (FakeGcsStore, LocalFsStore, StoreAuthError,
+                              get_store, is_url)
+from tony_tpu.storage.store import STORAGE_TOKEN_ENV, join as ujoin
+
+from test_e2e import _dump_task_logs, make_conf, submit
+
+
+# ---------------------------------------------------------------------------
+# Store unit tests
+# ---------------------------------------------------------------------------
+def test_localfs_roundtrip(tmp_path):
+    s = LocalFsStore()
+    src = tmp_path / "a.txt"
+    src.write_text("hello")
+    url = f"file://{tmp_path}/stage/a.txt"
+    s.put_file(str(src), url)
+    assert s.exists(url)
+    s.get_file(url, str(tmp_path / "back.txt"))
+    assert (tmp_path / "back.txt").read_text() == "hello"
+    assert s.list(f"file://{tmp_path}/stage") == ["a.txt"]
+
+
+def test_fake_gcs_roundtrip_and_trees(tmp_path, monkeypatch):
+    monkeypatch.setenv("TONY_FAKE_GCS_ROOT", str(tmp_path / "gcs"))
+    s = get_store("gs://bucket/x")
+    assert isinstance(s, FakeGcsStore)
+    d = tmp_path / "tree"
+    (d / "sub").mkdir(parents=True)
+    (d / "sub" / "f.txt").write_text("payload")
+    s.put_tree(str(d), "gs://bucket/jobs/app1/bundle")
+    assert s.isdir("gs://bucket/jobs/app1/bundle")
+    s.get_tree("gs://bucket/jobs/app1/bundle", str(tmp_path / "out"))
+    assert (tmp_path / "out" / "sub" / "f.txt").read_text() == "payload"
+    assert s.list("gs://bucket/jobs/app1") == ["bundle"]
+    with pytest.raises(FileNotFoundError):
+        s.get_file("gs://bucket/missing", str(tmp_path / "nope"))
+
+
+def test_fake_gcs_requires_root(monkeypatch):
+    monkeypatch.delenv("TONY_FAKE_GCS_ROOT", raising=False)
+    with pytest.raises(ValueError, match="TONY_FAKE_GCS_ROOT"):
+        get_store("gs://bucket/x")
+
+
+def test_unknown_scheme_rejected():
+    with pytest.raises(ValueError, match="no store"):
+        get_store("s3://bucket/x")
+    assert is_url("gs://b/k") and not is_url("/plain/path")
+
+
+def test_token_enforcement(tmp_path, monkeypatch):
+    root = str(tmp_path / "gcs")
+    monkeypatch.setenv("TONY_FAKE_GCS_ROOT", root)
+    FakeGcsStore.make_bucket(root, "secure", require_token="tok-123")
+    f = tmp_path / "x.txt"
+    f.write_text("x")
+    with pytest.raises(StoreAuthError, match="none given"):
+        FakeGcsStore(credential=None).put_file(str(f), "gs://secure/x.txt")
+    with pytest.raises(StoreAuthError, match="wrong token"):
+        FakeGcsStore(credential="bad").put_file(str(f), "gs://secure/x.txt")
+    FakeGcsStore(credential="tok-123").put_file(str(f), "gs://secure/x.txt")
+    # env-credential path (what executors use)
+    monkeypatch.setenv(STORAGE_TOKEN_ENV, "tok-123")
+    assert get_store("gs://secure/x.txt").exists("gs://secure/x.txt")
+
+
+# ---------------------------------------------------------------------------
+# E2E: staging + localization through the store, token passthrough
+# ---------------------------------------------------------------------------
+def _store_job(tmp_path, script, token=""):
+    root = str(tmp_path / "gcs")
+    if token:
+        FakeGcsStore.make_bucket(root, "jobs", require_token=token)
+    src = tmp_path / "src"
+    src.mkdir()
+    (src / "data.txt").write_text("bundled-data\n")
+    plain = tmp_path / "plain.txt"
+    plain.write_text("plain-resource\n")
+    archive = tmp_path / "bundle.zip"
+    with zipfile.ZipFile(archive, "w") as z:
+        z.writestr("inner.txt", "inner")
+    venv = tmp_path / "venv.zip"
+    with zipfile.ZipFile(venv, "w") as z:
+        z.writestr("marker.txt", "venv-marker")
+    conf = make_conf(tmp_path, script, workers=1, extra={
+        K.REMOTE_STORE: "gs://jobs/staging",
+        K.SRC_DIR: str(src),
+        K.CONTAINER_RESOURCES: f"{plain}::renamed.txt,{archive}#archive",
+        K.PYTHON_VENV: str(venv),
+    })
+    return root, conf
+
+
+def test_e2e_staging_through_fake_gcs(tmp_path, monkeypatch):
+    monkeypatch.setenv("TONY_FAKE_GCS_ROOT", str(tmp_path / "gcs"))
+    _, conf = _store_job(tmp_path, "check_localized_resources.py")
+    client, rec, code = submit(conf, tmp_path)
+    assert code == 0, _dump_task_logs(client)
+    # the frozen config carries store URLs, not client-local paths
+    assert str(client.conf.get(K.INTERNAL_BUNDLE_DIR)).startswith("gs://")
+    assert str(client.conf.get(K.INTERNAL_VENV)).startswith("gs://")
+    assert str(client.conf.get(K.INTERNAL_CONF_URL)).startswith("gs://")
+    for spec in client.conf.get_list(K.INTERNAL_RESOURCES):
+        assert spec.startswith("gs://"), spec
+    # ... and the store really holds the job prefix
+    s = get_store("gs://jobs/staging")
+    assert s.list(ujoin("gs://jobs/staging", rec.app_id))
+
+
+def test_e2e_token_passthrough_to_executors(tmp_path, monkeypatch):
+    """Token-protected bucket: the client stamps the credential into the
+    frozen config, the coordinator exports it, executors fetch config +
+    bundle with it (TokenCache.java:44-51 contract)."""
+    monkeypatch.setenv("TONY_FAKE_GCS_ROOT", str(tmp_path / "gcs"))
+    monkeypatch.delenv(STORAGE_TOKEN_ENV, raising=False)
+    _, conf = _store_job(tmp_path, "check_bundle.py", token="tok-xyz")
+    conf.set(K.STORAGE_TOKEN, "tok-xyz")
+    client, rec, code = submit(conf, tmp_path)
+    assert code == 0, _dump_task_logs(client)
+    # the credential must NOT survive into the frozen (world-readable)
+    # config — it travels by env only (portal shows this file verbatim)
+    frozen = os.path.join(client.job_dir, "tony-final.json")
+    assert "tok-xyz" not in open(frozen).read()
+
+
+def test_e2e_missing_token_fails_at_submit(tmp_path, monkeypatch):
+    monkeypatch.setenv("TONY_FAKE_GCS_ROOT", str(tmp_path / "gcs"))
+    monkeypatch.delenv(STORAGE_TOKEN_ENV, raising=False)
+    _, conf = _store_job(tmp_path, "check_bundle.py", token="tok-xyz")
+    with pytest.raises(StoreAuthError):
+        submit(conf, tmp_path)

@@ -137,6 +137,11 @@ class TonyTpuConfig:
                 value = f"{existing},{incoming}"
         self._conf[name] = value
 
+    def unset(self, name: str) -> None:
+        """Remove a key entirely (e.g. scrubbing credentials before the
+        config is frozen into a world-readable artifact)."""
+        self._conf.pop(name, None)
+
     def get(self, name: str, default: Any = None) -> Any:
         if name in self._conf:
             return self._conf[name]

@@ -1,0 +1,2 @@
+from tony_tpu.storage.store import (  # noqa: F401
+    FakeGcsStore, LocalFsStore, Store, StoreAuthError, get_store, is_url)

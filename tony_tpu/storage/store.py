@@ -1,0 +1,177 @@
+"""Remote storage abstraction behind job staging and localization.
+
+The reference stages the job bundle to HDFS and localizes it into every
+container (``TonyClient.processFinalTonyConf`` :189-228,
+``util/HdfsUtils.java:115-160``), with delegation tokens fetched for every
+referenced namenode and shipped with the job
+(``security/TokenCache.java:44-51``). The TPU-native analogue is an object
+store: the client **puts** the bundle under a job prefix, executors on
+remote TPU VMs **get** it — no shared filesystem is ever assumed once a
+remote store is configured.
+
+- ``Store`` — the minimal interface (put/get file+tree, open, list,
+  exists), addressed by URL.
+- ``LocalFsStore`` — ``file://`` (and bare paths): the single-host and
+  NFS-mount path.
+- ``FakeGcsStore`` — ``gs://``: GCS semantics (flat keys under buckets,
+  token-authenticated) backed by a local root directory, because this
+  environment has no egress. The *interface* is what multi-host correctness
+  rides on: every byte crosses put/get, so swapping in a real GCS client
+  changes one class. Token checks emulate the delegation-token contract:
+  a bucket root marked with ``.require_token`` rejects access unless the
+  caller presents the matching credential (see ``credential_from_env``).
+
+Credential passthrough (the TokenCache analogue): the client stamps the
+storage credential into the frozen config; the coordinator exports it to
+executors as ``TONY_STORAGE_TOKEN`` so they can fetch the frozen config
+itself from the store before they have read it.
+"""
+
+from __future__ import annotations
+
+import abc
+import os
+import shutil
+from typing import List, Optional
+from urllib.parse import urlparse
+
+STORAGE_TOKEN_ENV = "TONY_STORAGE_TOKEN"
+FAKE_GCS_ROOT_ENV = "TONY_FAKE_GCS_ROOT"
+REQUIRE_TOKEN_MARKER = ".require_token"
+
+
+class StoreAuthError(PermissionError):
+    """Credential missing or rejected by the store."""
+
+
+def is_url(s: str) -> bool:
+    return "://" in (s or "")
+
+
+def credential_from_env() -> Optional[str]:
+    return os.environ.get(STORAGE_TOKEN_ENV) or None
+
+
+def get_store(url: str, credential: Optional[str] = None) -> "Store":
+    """Factory: dispatch on scheme. ``file://`` and bare paths → local FS;
+    ``gs://`` → the (fake) GCS store."""
+    scheme = urlparse(url).scheme if is_url(url) else ""
+    if scheme in ("", "file"):
+        return LocalFsStore()
+    if scheme == "gs":
+        return FakeGcsStore(credential=credential or credential_from_env())
+    raise ValueError(f"no store for scheme {scheme!r} (url {url!r})")
+
+
+class Store(abc.ABC):
+    """Minimal object-store surface; paths are URLs of the store's scheme."""
+
+    @abc.abstractmethod
+    def _resolve(self, url: str) -> str:
+        """Map a URL to a backing filesystem path (backend detail)."""
+
+    def put_file(self, local_path: str, url: str) -> None:
+        dest = self._resolve(url)
+        os.makedirs(os.path.dirname(dest), exist_ok=True)
+        shutil.copy2(local_path, dest)
+
+    def get_file(self, url: str, local_path: str) -> None:
+        src = self._resolve(url)
+        if not os.path.isfile(src):
+            raise FileNotFoundError(f"{url} not in store")
+        os.makedirs(os.path.dirname(os.path.abspath(local_path)),
+                    exist_ok=True)
+        shutil.copy2(src, local_path)
+
+    def put_tree(self, local_dir: str, url: str) -> None:
+        dest = self._resolve(url)
+        os.makedirs(dest, exist_ok=True)
+        shutil.copytree(local_dir, dest, dirs_exist_ok=True)
+
+    def get_tree(self, url: str, local_dir: str) -> None:
+        src = self._resolve(url)
+        if not os.path.isdir(src):
+            raise FileNotFoundError(f"{url} not in store")
+        os.makedirs(local_dir, exist_ok=True)
+        shutil.copytree(src, local_dir, dirs_exist_ok=True)
+
+    def open(self, url: str, mode: str = "rb"):
+        path = self._resolve(url)
+        if any(m in mode for m in "wa"):
+            os.makedirs(os.path.dirname(path), exist_ok=True)
+        return open(path, mode)
+
+    def exists(self, url: str) -> bool:
+        return os.path.exists(self._resolve(url))
+
+    def isdir(self, url: str) -> bool:
+        return os.path.isdir(self._resolve(url))
+
+    def list(self, url: str) -> List[str]:
+        """Child names under a prefix (empty if absent)."""
+        path = self._resolve(url)
+        if not os.path.isdir(path):
+            return []
+        return sorted(os.listdir(path))
+
+
+class LocalFsStore(Store):
+    """``file://`` URLs and bare paths — identity mapping."""
+
+    def _resolve(self, url: str) -> str:
+        if is_url(url):
+            p = urlparse(url)
+            if p.scheme != "file":
+                raise ValueError(f"LocalFsStore got {url!r}")
+            return (p.netloc or "") + p.path
+        return url
+
+
+class FakeGcsStore(Store):
+    """``gs://bucket/key`` → ``$TONY_FAKE_GCS_ROOT/bucket/key`` with the
+    GCS access contract (token-checked when the bucket demands it)."""
+
+    def __init__(self, root: Optional[str] = None,
+                 credential: Optional[str] = None):
+        self.root = root or os.environ.get(FAKE_GCS_ROOT_ENV, "")
+        if not self.root:
+            raise ValueError(
+                f"gs:// store needs {FAKE_GCS_ROOT_ENV} (no egress in this "
+                f"environment; the fake is backed by a local root)")
+        self.credential = credential
+
+    def _check_auth(self, bucket: str) -> None:
+        marker = os.path.join(self.root, bucket, REQUIRE_TOKEN_MARKER)
+        if os.path.isfile(marker):
+            with open(marker, encoding="utf-8") as f:
+                expected = f.read().strip()
+            if expected and self.credential != expected:
+                raise StoreAuthError(
+                    f"bucket {bucket!r} requires a credential "
+                    f"({'wrong token' if self.credential else 'none given'})"
+                )
+
+    def _resolve(self, url: str) -> str:
+        p = urlparse(url)
+        if p.scheme != "gs" or not p.netloc:
+            raise ValueError(f"FakeGcsStore got {url!r}")
+        self._check_auth(p.netloc)
+        return os.path.join(self.root, p.netloc, p.path.lstrip("/"))
+
+    @staticmethod
+    def make_bucket(root: str, bucket: str,
+                    require_token: str = "") -> None:
+        """Test helper: create a bucket, optionally token-protected."""
+        os.makedirs(os.path.join(root, bucket), exist_ok=True)
+        if require_token:
+            with open(os.path.join(root, bucket, REQUIRE_TOKEN_MARKER),
+                      "w", encoding="utf-8") as f:
+                f.write(require_token)
+
+
+def join(url: str, *parts: str) -> str:
+    """URL-aware path join (no normalization across the scheme)."""
+    out = url.rstrip("/")
+    for p in parts:
+        out += "/" + p.strip("/")
+    return out

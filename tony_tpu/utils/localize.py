@@ -50,19 +50,37 @@ class LocalizableResource:
         return out
 
 
-def stage_resources(specs: List[str], stage_dir: str) -> List[str]:
-    """Client side: copy each resource into the job bundle dir (the HDFS
+def stage_resources(specs: List[str], stage_dir: str, store=None,
+                    store_prefix: str = "") -> List[str]:
+    """Client side: copy each resource into the staging area (the HDFS
     upload analogue) and return rewritten specs pointing at the staged
-    copies, annotations preserved."""
+    copies, annotations preserved. With ``store``/``store_prefix`` the
+    staged copies are PUT to the object store and the rewritten sources
+    are store URLs (``tony_tpu.storage``); sources that are already store
+    URLs pass through untouched."""
     out: List[str] = []
     for i, spec in enumerate(specs):
         r = LocalizableResource.parse(spec)
+        if _is_url(r.source):
+            out.append(spec.strip())
+            continue
         if not os.path.exists(r.source):
             raise FileNotFoundError(
                 f"resource {r.source!r} (from {spec!r}) does not exist")
+        base = os.path.basename(r.source.rstrip("/"))
+        if store is not None:
+            from tony_tpu.storage.store import join as ujoin
+
+            url = ujoin(store_prefix, str(i), base)
+            if os.path.isdir(r.source):
+                store.put_tree(r.source, url)
+            else:
+                store.put_file(r.source, url)
+            out.append(LocalizableResource(url, r.name, r.archive).unparse())
+            continue
         dest_dir = os.path.join(stage_dir, str(i))
         os.makedirs(dest_dir, exist_ok=True)
-        staged = os.path.join(dest_dir, os.path.basename(r.source.rstrip("/")))
+        staged = os.path.join(dest_dir, base)
         if os.path.isdir(r.source):
             shutil.copytree(r.source, staged, dirs_exist_ok=True)
         else:
@@ -75,18 +93,40 @@ def localize_resources(specs: List[str], workdir: str) -> List[str]:
     """Executor side: place every staged resource into the task working dir
     under its container name; unpack archives into a directory named NAME
     (YARN ARCHIVE localization semantics; exercised by the reference e2e
-    ``TestTonyE2E.java:322-340``)."""
+    ``TestTonyE2E.java:322-340``). Store-URL sources are fetched through
+    ``tony_tpu.storage`` first — a remote task host never dereferences a
+    client-local path."""
     placed: List[str] = []
-    for spec in specs:
+    for i, spec in enumerate(specs):
         r = LocalizableResource.parse(spec)
+        source = r.source
+        if _is_url(source) and not source.startswith("file://"):
+            from tony_tpu.storage import get_store
+
+            store = get_store(source)
+            # Keyed by index: two resources may share a basename, and a
+            # colliding get_tree(dirs_exist_ok) would silently merge them.
+            fetched = os.path.join(workdir, ".fetch", str(i),
+                                   os.path.basename(source.rstrip("/")))
+            if store.isdir(source):
+                store.get_tree(source, fetched)
+            else:
+                store.get_file(source, fetched)
+            source = fetched
+        elif source.startswith("file://"):
+            source = source[len("file://"):]
         target = os.path.join(workdir, r.name)
         if r.archive:
             os.makedirs(target, exist_ok=True)
-            shutil.unpack_archive(r.source, target)
-        elif os.path.isdir(r.source):
-            shutil.copytree(r.source, target, dirs_exist_ok=True)
+            shutil.unpack_archive(source, target)
+        elif os.path.isdir(source):
+            shutil.copytree(source, target, dirs_exist_ok=True)
         else:
             os.makedirs(os.path.dirname(target) or ".", exist_ok=True)
-            shutil.copy2(r.source, target)
+            shutil.copy2(source, target)
         placed.append(target)
     return placed
+
+
+def _is_url(s: str) -> bool:
+    return "://" in (s or "")
