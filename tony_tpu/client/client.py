@@ -46,6 +46,11 @@ class TaskUpdateListener:
     def on_task_infos_updated(self, task_infos: List[dict]) -> None:  # noqa: B027
         pass
 
+    def on_application_report(self, report: dict) -> None:  # noqa: B027
+        """Every poll, the raw coordinator report — mid-run state (tb_url,
+        attempt, ...) that the task-info callback doesn't carry. Used by
+        the notebook submitter to discover the server endpoint."""
+
     def on_application_finished(self, status: str, report: dict) -> None:  # noqa: B027
         pass
 
@@ -113,33 +118,86 @@ class TonyTpuClient:
                 cmd += f" {params}"
             self.conf.set(K.COMMAND_FORMAT.format(job=job.name), cmd)
 
+    def _storage_token(self) -> str:
+        """Credential for the remote store: explicit conf key, else the
+        submit environment (stamped into the frozen config either way —
+        the delegation-token-shipped-with-the-job contract,
+        ``security/TokenCache.java:44-51``)."""
+        from tony_tpu.storage.store import STORAGE_TOKEN_ENV
+
+        return str(self.conf.get(K.STORAGE_TOKEN, "") or "") \
+            or os.environ.get(STORAGE_TOKEN_ENV, "")
+
     def _stage_bundle(self) -> None:
-        """Copy src-dir, container resources, and the python venv into the
-        job dir (the HDFS-upload analogue, ``processFinalTonyConf``
-        :189-228); executors localize them into each task working dir."""
+        """Stage src-dir, container resources, and the python venv where
+        executors can localize them (the HDFS-upload analogue,
+        ``processFinalTonyConf`` :189-228). With ``tony.storage.
+        remote-store`` set, everything is PUT to the object store under the
+        job prefix and the internal keys carry store URLs — no shared
+        filesystem between client and task hosts is assumed. Otherwise the
+        job dir itself is the staging area (single-host path)."""
+        remote = str(self.conf.get(K.REMOTE_STORE, "") or "")
+        store = prefix = None
+        if remote:
+            from tony_tpu.storage import get_store
+            from tony_tpu.storage.store import STORAGE_TOKEN_ENV
+            from tony_tpu.storage.store import join as ujoin
+
+            token = self._storage_token()
+            if token:
+                # The credential travels by ENV, never in the config: the
+                # frozen config is world-readable (portal config view,
+                # events, the store itself). The coordinator inherits this
+                # env and re-exports it to executors — the separate-token-
+                # file discipline of the reference (TokenCache.java:44-51).
+                os.environ[STORAGE_TOKEN_ENV] = token
+                self.conf.unset(K.STORAGE_TOKEN)
+            store = get_store(remote, credential=token or None)
+            prefix = ujoin(remote, self.app_id)
         src = str(self.conf.get(K.SRC_DIR, "") or "")
         if src:
             if not os.path.isdir(src):
                 raise ConfigError(f"{K.SRC_DIR}={src!r} is not a directory")
-            bundle = os.path.join(self.job_dir, "bundle")
-            shutil.copytree(src, bundle, dirs_exist_ok=True)
-            self.conf.set(K.INTERNAL_BUNDLE_DIR, bundle)
+            if store:
+                from tony_tpu.storage.store import join as ujoin
+
+                url = ujoin(prefix, "bundle")
+                store.put_tree(src, url)
+                self.conf.set(K.INTERNAL_BUNDLE_DIR, url)
+            else:
+                bundle = os.path.join(self.job_dir, "bundle")
+                shutil.copytree(src, bundle, dirs_exist_ok=True)
+                self.conf.set(K.INTERNAL_BUNDLE_DIR, bundle)
         resources = self.conf.get_list(K.CONTAINER_RESOURCES)
         if resources:
             from tony_tpu.utils.localize import stage_resources
 
-            staged = stage_resources(
-                resources, os.path.join(self.job_dir, "resources"))
+            if store:
+                from tony_tpu.storage.store import join as ujoin
+
+                staged = stage_resources(resources, "", store=store,
+                                         store_prefix=ujoin(prefix,
+                                                            "resources"))
+            else:
+                staged = stage_resources(
+                    resources, os.path.join(self.job_dir, "resources"))
             self.conf.set(K.INTERNAL_RESOURCES, ",".join(staged))
         venv = str(self.conf.get(K.PYTHON_VENV, "") or "")
         if venv:
             if not os.path.isfile(venv):
                 raise ConfigError(
                     f"{K.PYTHON_VENV}={venv!r} is not an archive file")
-            staged_venv = os.path.join(self.job_dir,
-                                       os.path.basename(venv))
-            shutil.copy2(venv, staged_venv)
-            self.conf.set(K.INTERNAL_VENV, staged_venv)
+            if store:
+                from tony_tpu.storage.store import join as ujoin
+
+                url = ujoin(prefix, os.path.basename(venv))
+                store.put_file(venv, url)
+                self.conf.set(K.INTERNAL_VENV, url)
+            else:
+                staged_venv = os.path.join(self.job_dir,
+                                           os.path.basename(venv))
+                shutil.copy2(venv, staged_venv)
+                self.conf.set(K.INTERNAL_VENV, staged_venv)
 
     # -- lifecycle -------------------------------------------------------
     def start(self) -> int:
@@ -163,8 +221,24 @@ class TonyTpuClient:
         self.conf.set(K.INTERNAL_VERSION, vi["version"])
         self.conf.set(K.INTERNAL_REVISION, vi["revision"])
         self.conf.set(K.INTERNAL_BRANCH, vi["branch"])
+        remote = str(self.conf.get(K.REMOTE_STORE, "") or "")
+        conf_url = ""
+        if remote:
+            # Executors on remote hosts fetch the frozen config itself from
+            # the store; the URL must be IN the config for the coordinator
+            # to hand out, so set it before freezing.
+            from tony_tpu.storage.store import join as ujoin
+
+            conf_url = ujoin(remote, self.app_id,
+                             constants.FINAL_CONFIG_FILE)
+            self.conf.set(K.INTERNAL_CONF_URL, conf_url)
         frozen = self.conf.freeze(
             os.path.join(self.job_dir, constants.FINAL_CONFIG_FILE))
+        if conf_url:
+            from tony_tpu.storage import get_store
+
+            get_store(remote, credential=self._storage_token() or None
+                      ).put_file(frozen, conf_url)
 
         history_root = str(self.conf.get(K.HISTORY_LOCATION, "") or "") \
             or os.path.join(self.workdir, "history")
@@ -233,6 +307,14 @@ class TonyTpuClient:
                 self._last_task_infos = tasks
                 for lst in self.listeners:
                     lst.on_task_infos_updated(tasks)
+            for lst in self.listeners:
+                try:
+                    lst.on_application_report(report)
+                except Exception as e:  # noqa: BLE001
+                    # A listener failure (e.g. the notebook proxy's local
+                    # port already bound) must not tear down a running job.
+                    log.warning("listener %s.on_application_report "
+                                "failed: %s", type(lst).__name__, e)
             status = report.get("status", "")
             if status in ("SUCCEEDED", "FAILED", "KILLED"):
                 for lst in self.listeners:

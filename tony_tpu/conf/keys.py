@@ -75,6 +75,12 @@ SLICE_FAKE_INVENTORY = _key(
     "tpu-slice+fake only: total fake hosts in the provisioner inventory; "
     "0 means same as tony.slice.num-hosts (deny-capacity tests set it "
     "lower).")
+APPLICATION_PROFILER_ENABLED = _key(
+    "tony.application.profiler-enabled", False, bool,
+    "Export TONY_PROFILE_DIR (under the job history dir) to the chief "
+    "task so tony_tpu.profiler.trace_window captures XLA traces there; "
+    "the portal lists them per job (SURVEY.md §5 tracing — the TPU-native "
+    "complement to the reference's TB-only observability).")
 APPLICATION_ENABLE_PREPROCESS = _key(
     "tony.application.enable-preprocess", False, bool,
     "Run the coordinator-local command as a preprocessing stage before "
@@ -251,6 +257,27 @@ APPLICATION_EXECUTABLE = _key(
 APPLICATION_TASK_PARAMS = _key(
     "tony.application.task-params", "", str,
     "Extra arguments appended to the default task command.")
+REMOTE_STORE = _key(
+    "tony.storage.remote-store", "", str,
+    "URL prefix of an object store for job staging (gs://bucket/prefix or "
+    "file:///mount/prefix). When set, the client PUTs the bundle, "
+    "resources, venv, and frozen config under <prefix>/<app_id>/ and "
+    "executors GET them — no shared filesystem is assumed (the HDFS "
+    "upload/localize analogue, HdfsUtils.java:115-160). Empty = local "
+    "job-dir staging.")
+STORAGE_TOKEN = _key(
+    "tony.storage.token", "", str,
+    "Storage credential for submit-time staging. SCRUBBED from the frozen "
+    "config before it is written (the artifact is world-readable via the "
+    "portal and the store); it reaches executors by env passthrough as "
+    "TONY_STORAGE_TOKEN — the separate-token-file discipline of the "
+    "reference (security/TokenCache.java:44-51). Empty = read from the "
+    "TONY_STORAGE_TOKEN env at submit.")
+INTERNAL_CONF_URL = _key(
+    "tony.internal.conf-url", "", str,
+    "Set by the client at submit when a remote store is configured: store "
+    "URL of the frozen config; executors fetch it before reading any "
+    "other key (which is why the credential travels by env, not config).")
 INTERNAL_BUNDLE_DIR = _key(
     "tony.internal.bundle-dir", "", str,
     "Set by the client at submit: staged src-dir bundle that executors "

@@ -93,6 +93,9 @@ INPROGRESS_SUFFIX = ".jhist.jsonl.inprogress"
 HISTORY_INTERMEDIATE = "intermediate"
 HISTORY_FINISHED = "finished"
 
+# Chief-only XLA trace destination (tony_tpu/profiler.py contract).
+PROFILE_DIR = "TONY_PROFILE_DIR"
+
 # ---------------------------------------------------------------------------
 # Fault-injection test hooks, honoured by production code exactly like the
 # reference's (Constants.java:116-121; see SURVEY.md §4.1 hook table).

@@ -173,8 +173,28 @@ class Coordinator:
         ckpt_dir = str(self.conf.get(K.APPLICATION_CHECKPOINT_DIR, "") or "")
         if ckpt_dir:
             env[constants.CHECKPOINT_DIR] = ckpt_dir
-        if self._final_conf_path:
+        if self.conf.get_bool(K.APPLICATION_PROFILER_ENABLED) and \
+                self.session.is_chief(task.job_name, task.index):
+            # Chief-only trace capture into the job history dir, where the
+            # portal finds it (tony_tpu/profiler.py contract).
+            env[constants.PROFILE_DIR] = os.path.join(self.job_dir,
+                                                      "profile")
+        conf_url = str(self.conf.get(K.INTERNAL_CONF_URL, "") or "")
+        if conf_url:
+            # Remote store configured: executors fetch the frozen config
+            # from the store (they may be on another host); the credential
+            # travels by env because it gates reading the config itself.
+            env[constants.EXECUTOR_CONF] = conf_url
+        elif self._final_conf_path:
             env[constants.EXECUTOR_CONF] = self._final_conf_path
+        from tony_tpu.storage.store import STORAGE_TOKEN_ENV
+
+        # Credential passthrough: inherited env from the client (the frozen
+        # config is scrubbed of it — see client._stage_bundle).
+        token = os.environ.get(STORAGE_TOKEN_ENV, "") \
+            or str(self.conf.get(K.STORAGE_TOKEN, "") or "")
+        if token:
+            env[STORAGE_TOKEN_ENV] = token
         for kv in self.conf.get_list(K.EXECUTION_ENV):
             if "=" in kv:
                 k, v = kv.split("=", 1)

@@ -75,6 +75,14 @@ class TaskExecutor:
         self.coordinator_port = int(e[constants.COORDINATOR_PORT])
         self.command = e.get(constants.TASK_COMMAND, "")
         conf_path = e.get(constants.EXECUTOR_CONF, "")
+        if conf_path and "://" in conf_path:
+            # Frozen config lives in the remote store (multi-host path);
+            # fetch it with the env credential before reading any key.
+            from tony_tpu.storage import get_store
+
+            local = os.path.join(os.getcwd(), constants.FINAL_CONFIG_FILE)
+            get_store(conf_path).get_file(conf_path, local)
+            conf_path = local
         self.conf = (TonyTpuConfig.load_final(conf_path)
                      if conf_path and os.path.exists(conf_path)
                      else TonyTpuConfig())
@@ -143,8 +151,14 @@ class TaskExecutor:
         into this task's working dir (reference ``Utils.extractResources``
         :710-723 unzipping the HDFS-localized src/venv archives, and YARN
         resource localization per ``LocalizableResource``)."""
+        from tony_tpu.storage.store import is_url
+
         bundle = str(self.conf.get(K.INTERNAL_BUNDLE_DIR, "") or "")
-        if bundle and os.path.isdir(bundle):
+        if bundle and is_url(bundle):
+            from tony_tpu.storage import get_store
+
+            get_store(bundle).get_tree(bundle, os.getcwd())
+        elif bundle and os.path.isdir(bundle):
             import shutil
             shutil.copytree(bundle, os.getcwd(), dirs_exist_ok=True)
         resources = self.conf.get_list(K.INTERNAL_RESOURCES)
@@ -153,6 +167,12 @@ class TaskExecutor:
 
             localize_resources(resources, os.getcwd())
         venv = str(self.conf.get(K.INTERNAL_VENV, "") or "")
+        if venv and is_url(venv):
+            from tony_tpu.storage import get_store
+
+            local = os.path.join(os.getcwd(), os.path.basename(venv))
+            get_store(venv).get_file(venv, local)
+            venv = local
         if venv and os.path.isfile(venv):
             import shutil
 
