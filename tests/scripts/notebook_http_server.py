@@ -1,0 +1,22 @@
+"""Stand-in for a Jupyter server in the notebook-mode e2e: binds the
+TB_PORT the coordinator reserved and answers every GET with a marker."""
+import http.server
+import os
+
+
+class Handler(http.server.BaseHTTPRequestHandler):
+    def do_GET(self):
+        body = b"tony-notebook-ok"
+        self.send_response(200)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def log_message(self, *args):
+        pass
+
+
+port = int(os.environ["TB_PORT"])
+# Bind all interfaces: the registered url advertises the hostname (like
+# jupyter --ip=0.0.0.0 in a real notebook job), not loopback.
+http.server.HTTPServer(("", port), Handler).serve_forever()

@@ -1,0 +1,90 @@
+"""Notebook mode + proxy: a server job reached through the local tunnel.
+
+Reference: ``NotebookSubmitter.java:118-139`` (single-container Jupyter +
+local ProxyServer) and ``tony-proxy/.../ProxyServer.java:50-88``. The e2e
+submits an HTTP echo server as the "notebook", waits for the proxy to come
+up from the application report's url, and fetches through the proxied
+port.
+"""
+
+import os
+import sys
+import threading
+import urllib.request
+
+from tony_tpu.conf.config import TonyTpuConfig
+from tony_tpu.conf import keys as K
+from tony_tpu.notebook import NotebookProxyListener, submit_notebook
+from tony_tpu.proxy import ProxyServer
+
+SCRIPTS = os.path.join(os.path.dirname(os.path.abspath(__file__)), "scripts")
+
+
+def test_proxy_forwards_bytes():
+    import http.server
+
+    class H(http.server.BaseHTTPRequestHandler):
+        def do_GET(self):
+            body = b"direct"
+            self.send_response(200)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def log_message(self, *a):
+            pass
+
+    srv = http.server.HTTPServer(("127.0.0.1", 0), H)
+    t = threading.Thread(target=srv.serve_forever, daemon=True)
+    t.start()
+    proxy = ProxyServer("127.0.0.1", srv.server_port).start()
+    try:
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{proxy.port}/", timeout=10) as r:
+            assert r.read() == b"direct"
+    finally:
+        proxy.stop()
+        srv.shutdown()
+
+
+def test_e2e_notebook_reachable_through_proxy(tmp_path):
+    conf = TonyTpuConfig()
+    conf.set(K.APPLICATION_TIMEOUT_S, 60)
+    conf.set(K.HISTORY_LOCATION, str(tmp_path / "history"))
+
+    # Drive the client directly with our own NotebookProxyListener so the
+    # test can observe readiness (submit_notebook wires the same pieces).
+    from tony_tpu.client import TonyTpuClient
+
+    listener = NotebookProxyListener()
+    result = {}
+    conf.set(K.COORDINATOR_COMMAND,
+             f"{sys.executable} "
+             f"{os.path.join(SCRIPTS, 'notebook_http_server.py')}")
+    client = TonyTpuClient(conf, workdir=str(tmp_path / "work"))
+    client.add_listener(listener)
+    t = threading.Thread(target=lambda: result.update(code=client.start()),
+                         daemon=True)
+    t.start()
+    try:
+        assert listener.ready.wait(timeout=60), "proxy never came up"
+        # The url is registered just before the server process starts, so
+        # the first connect can race the bind — retry briefly.
+        body = None
+        for _ in range(40):
+            try:
+                with urllib.request.urlopen(
+                        f"http://127.0.0.1:{listener.proxy.port}/",
+                        timeout=10) as r:
+                    body = r.read()
+                break
+            except (urllib.error.URLError, ConnectionError, OSError):
+                import time
+                time.sleep(0.25)
+        assert body == b"tony-notebook-ok"
+    finally:
+        client.force_kill()
+        t.join(timeout=30)
+    # killed by us after successful tunneling — any terminal outcome is
+    # fine; what matters is the bytes made the round trip
+    assert not t.is_alive()

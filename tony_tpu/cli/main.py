@@ -69,6 +69,17 @@ def _cmd_submit(args: argparse.Namespace) -> int:
     return client.start()
 
 
+def _cmd_notebook(args: argparse.Namespace) -> int:
+    from tony_tpu.conf.config import TonyTpuConfig
+    from tony_tpu.notebook import submit_notebook
+
+    conf = TonyTpuConfig.from_layers(config_file=args.conf_file,
+                                     overrides=tuple(args.conf or []))
+    return submit_notebook(conf, workdir=args.workdir,
+                           command=args.command or "",
+                           local_port=args.port)
+
+
 def _cmd_history(args: argparse.Namespace) -> int:
     from tony_tpu.events import history
 
@@ -122,6 +133,21 @@ def build_parser() -> argparse.ArgumentParser:
                    help="shortcut for tony.worker.instances")
     s.add_argument("--workdir", help="client workdir (default ~/.tony-tpu)")
     s.set_defaults(fn=_cmd_submit)
+
+    n = sub.add_parser(
+        "notebook",
+        help="run a notebook server as a single-node job and tunnel a "
+             "local port to it (reference NotebookSubmitter)")
+    n.add_argument("--conf-file", help="job config (json/yaml)")
+    n.add_argument("--conf", action="append", metavar="K=V",
+                   help="config override (repeatable)")
+    n.add_argument("--command",
+                   help="server command; $TB_PORT is the port to bind "
+                        "(default: jupyter notebook)")
+    n.add_argument("--port", type=int, default=0,
+                   help="local proxy port (default: auto)")
+    n.add_argument("--workdir", help="client workdir (default ~/.tony-tpu)")
+    n.set_defaults(fn=_cmd_notebook)
 
     h = sub.add_parser("history", help="list finished jobs")
     h.add_argument("--history-root")
