@@ -1,0 +1,58 @@
+"""Profiler capture: chief-only trace windows into the job dir + portal
+listing (SURVEY.md §5 tracing; VERDICT round-2 item 10)."""
+
+import os
+import json
+import urllib.request
+
+from tony_tpu.conf import keys as K
+from tony_tpu.profiler import trace_window
+from tony_tpu.events import history
+
+from test_e2e import _dump_task_logs, make_conf, submit
+
+
+def test_trace_window_noop_without_env(monkeypatch):
+    monkeypatch.delenv("TONY_PROFILE_DIR", raising=False)
+    with trace_window("x") as dest:
+        assert dest is None
+
+
+def test_trace_window_captures(tmp_path, monkeypatch):
+    monkeypatch.setenv("TONY_PROFILE_DIR", str(tmp_path))
+    import jax
+    import jax.numpy as jnp
+
+    with trace_window("unit") as dest:
+        jax.block_until_ready(jnp.ones((8, 8)) @ jnp.ones((8, 8)))
+    assert dest == str(tmp_path / "unit")
+    n = sum(len(fs) for _, _, fs in os.walk(dest))
+    assert n > 0
+
+
+def test_e2e_chief_trace_in_job_dir_and_portal(tmp_path):
+    conf = make_conf(tmp_path, "train_with_profile.py", workers=2,
+                     extra={K.APPLICATION_PROFILER_ENABLED: True})
+    client, rec, code = submit(conf, tmp_path)
+    assert code == 0, _dump_task_logs(client)
+
+    # trace landed in the job's history dir (where the portal looks)
+    job_dir = history.list_job_dirs(str(tmp_path / "history"))[rec.app_id]
+    trace_root = os.path.join(job_dir, "profile", "step0")
+    assert sum(len(fs) for _, _, fs in os.walk(trace_root)) > 0
+
+    # ... and the portal lists it
+    from tony_tpu.portal import PortalServer
+
+    srv = PortalServer(str(tmp_path / "history"), port=0,
+                       mover_interval_s=3600, purger_interval_s=3600)
+    srv.start()
+    try:
+        with urllib.request.urlopen(
+                f"{srv.url}/profiles/{rec.app_id}?format=json",
+                timeout=10) as r:
+            traces = json.load(r)
+    finally:
+        srv.stop()
+    assert [t["name"] for t in traces] == ["step0"]
+    assert traces[0]["files"] > 0

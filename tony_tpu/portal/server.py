@@ -127,7 +127,8 @@ class PortalServer:
             if not parts:
                 return self._jobs_index(req, as_json)
             view, *rest = parts
-            if view in ("config", "jobs", "logs", "logfile") and rest:
+            if view in ("config", "jobs", "logs", "logfile",
+                        "profiles") and rest:
                 job_id = rest[0]
                 if view == "config":
                     return self._config_view(req, job_id, as_json)
@@ -137,6 +138,8 @@ class PortalServer:
                     return self._logs_view(req, job_id, as_json)
                 if view == "logfile" and len(rest) >= 2:
                     return self._logfile_view(req, job_id, int(rest[1]))
+                if view == "profiles":
+                    return self._profiles_view(req, job_id, as_json)
             self._send(req, 404, "text/plain", b"not found")
         except Exception as e:  # noqa: BLE001
             log.exception("portal error for %s", req.path)
@@ -160,7 +163,8 @@ class PortalServer:
                 f"<td>{html.escape(r.user)}</td><td>{r.started_iso}</td>"
                 f"<td><a href='/jobs/{a}'>events</a> "
                 f"<a href='/config/{a}'>config</a> "
-                f"<a href='/logs/{a}'>logs</a></td></tr>")
+                f"<a href='/logs/{a}'>logs</a> "
+                f"<a href='/profiles/{a}'>profiles</a></td></tr>")
         body.append("</table>")
         self._send_html(req, "".join(body))
 
@@ -242,6 +246,30 @@ class PortalServer:
         body = f"<ul>{items}</ul>" if items else "<p>no logs recorded</p>"
         self._send_html(
             req, f"<h1>logs — {html.escape(job_id)}</h1>{body}")
+
+    def _profiles_view(self, req, job_id: str, as_json: bool) -> None:
+        """Profiler traces captured into <job_dir>/profile by the chief
+        (tony_tpu/profiler.py; SURVEY.md §5 tracing). Listed by trace-
+        window name; the files themselves are TensorBoard/Perfetto input,
+        so the portal points at paths rather than rendering."""
+        job_dir = self._job_dir(job_id)
+        if job_dir is None:
+            return self._send(req, 404, "text/plain", b"unknown job")
+        root = os.path.join(job_dir, "profile")
+        traces = []
+        if os.path.isdir(root):
+            for name in sorted(os.listdir(root)):
+                p = os.path.join(root, name)
+                n_files = sum(len(fs) for _, _, fs in os.walk(p))
+                traces.append(dict(name=name, path=p, files=n_files))
+        if as_json:
+            return self._send_json(req, traces)
+        items = "".join(
+            f"<li>{html.escape(t['name'])} — {t['files']} file(s) at "
+            f"<code>{html.escape(t['path'])}</code></li>" for t in traces)
+        body = f"<ul>{items}</ul>" if items else "<p>no traces captured</p>"
+        self._send_html(
+            req, f"<h1>profiler traces — {html.escape(job_id)}</h1>{body}")
 
     def _logfile_view(self, req, job_id: str, index: int) -> None:
         pairs = self._log_paths(job_id)

@@ -1,0 +1,57 @@
+"""User-side profiler capture: chief-only XLA trace windows into the job dir.
+
+The reference's observability is TensorBoard-only (chief reserves TB_PORT,
+url registered to the AM, ``TaskExecutor.java:311-319``); SURVEY.md §5
+calls for the TPU-native half: actual profiler traces (XLA/TPU timeline,
+viewable in TensorBoard's profile plugin or Perfetto) collected into the
+job's history dir and surfaced by the portal.
+
+Contract: when ``tony.application.profiler-enabled`` is set, the
+coordinator exports ``TONY_PROFILE_DIR`` to the CHIEF task only (one trace
+per job, from the process that sees the whole step). User code wraps the
+steps it wants captured:
+
+    from tony_tpu import profiler
+    with profiler.trace_window():
+        state, loss = train_step(state, batch)
+
+Everything no-ops when the env is absent, so the same training script runs
+unchanged with profiling on or off — the same design as the reference's
+TB_PORT contract (set for chief, ignored elsewhere).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import logging
+import os
+from typing import Iterator, Optional
+
+PROFILE_DIR_ENV = "TONY_PROFILE_DIR"
+
+log = logging.getLogger(__name__)
+
+
+def profile_dir() -> Optional[str]:
+    """The trace destination, or None when this task shouldn't profile."""
+    return os.environ.get(PROFILE_DIR_ENV) or None
+
+
+@contextlib.contextmanager
+def trace_window(name: str = "trace") -> Iterator[Optional[str]]:
+    """Capture a jax profiler trace of the enclosed block into
+    ``$TONY_PROFILE_DIR/<name>``; no-op (yields None) when unset."""
+    dest = profile_dir()
+    if not dest:
+        yield None
+        return
+    import jax
+
+    out = os.path.join(dest, name)
+    os.makedirs(out, exist_ok=True)
+    jax.profiler.start_trace(out)
+    try:
+        yield out
+    finally:
+        jax.profiler.stop_trace()
+        log.info("profiler trace written to %s", out)
