@@ -114,3 +114,52 @@ def test_pipeline_rejects_indivisible_layers(mesh, params):
     with pytest.raises(ValueError, match="divisible"):
         pipeline_forward(bad, mesh, params,
                          jnp.zeros((4, 16), jnp.int32), 2)
+
+
+def test_pipeline_composes_with_fsdp_tp():
+    """VERDICT r2 item 4: with fsdp>1 NO leaf of the pipeline state is
+    fully replicated — embedding/lm_head/final_norm shard over fsdp/tp and
+    block leaves shard over pp×fsdp (gathered just-in-time in the stage
+    loop) — and the composed step still matches the sequential oracle."""
+    import numpy as np
+    import flax.linen as nn
+    from jax.sharding import PartitionSpec as P
+    from tony_tpu.parallel.sharding import DEFAULT_RULES
+
+    cfg = TransformerConfig.tiny(n_layers=4)
+    mesh = build_mesh(MeshSpec(dp=1, pp=2, fsdp=2, tp=2))
+    params = init_pipeline_params(cfg, jax.random.key(0))
+    shardings = pipeline_param_shardings(mesh, params, cfg)
+
+    replicated = [
+        path for path, sh in jax.tree_util.tree_leaves_with_path(shardings)
+        if sh.spec == P() or all(a is None for a in sh.spec)
+    ]
+    assert not replicated, f"fully replicated leaves: {replicated}"
+
+    placed = jax.tree.map(jax.device_put, params, shardings)
+    tokens = jax.random.randint(jax.random.key(1), (4, 32), 0,
+                                cfg.vocab_size)
+    got = jax.jit(
+        lambda p, t: pipeline_forward(cfg, mesh, p, t, num_microbatches=2)
+    )(placed, tokens)
+
+    plain = _plain_params_from_pipeline(params, cfg.n_layers)
+    with nn.logical_axis_rules(list(DEFAULT_RULES)):
+        want = Transformer(cfg).apply({"params": plain}, tokens)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-4, atol=2e-4)
+
+    # gradients flow through the gather (transpose = reduce-scatter); the
+    # train step pins grad shardings to the param shardings via
+    # out_shardings, as a real optimizer step would
+    loss, grads = jax.jit(
+        jax.value_and_grad(
+            lambda p: pipeline_loss(cfg, mesh, p, tokens,
+                                    num_microbatches=2)),
+        out_shardings=(None, shardings),
+    )(placed)
+    assert jnp.isfinite(loss)
+    assert grads["embedding"].sharding.spec == shardings["embedding"].spec
+    leaf0 = jax.tree.leaves(grads["blocks"])[0]
+    assert "pp" in str(leaf0.sharding.spec)

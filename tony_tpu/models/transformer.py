@@ -225,9 +225,15 @@ class Transformer(nn.Module):
                 # single-shard apply, where no sp axis is bound).
                 pos = pos + _sp_offset() * tokens.shape[1]
             positions = jnp.broadcast_to(pos[None, :], tokens.shape)
+        # The table gets its own logical names: sharding its vocab dim over
+        # BOTH model axes (and leaving the embed dim whole) lets SPMD
+        # partition the lookup as masked-gather + all-reduce; an
+        # embed-sharded table instead makes the gather output embed-sharded
+        # and the reshard to batch-sharded activations is an "involuntary
+        # full rematerialization" in the partitioner (XLA b/433785288).
         emb = self.param(
             "embedding", nn.with_logical_partitioning(
-                nn.initializers.normal(0.02), ("vocab", "embed")),
+                nn.initializers.normal(0.02), ("vocab_table", "embed_table")),
             (cfg.vocab_size, cfg.dim), cfg.param_dtype)
         x = emb[tokens].astype(cfg.dtype)
         x = nn.with_logical_constraint(x, ("batch", "seq", "embed"))

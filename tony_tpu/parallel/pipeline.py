@@ -77,26 +77,94 @@ def init_pipeline_params(cfg: TransformerConfig, rng: jax.Array
     }
 
 
-def pipeline_param_shardings(mesh: Mesh, params: Dict[str, Any]
+def _block_fsdp_axes(cfg: TransformerConfig) -> Dict[str, Any]:
+    """Per-leaf index of the dimension to shard over ``fsdp`` in a block's
+    params (the dim whose logical name maps to fsdp under DEFAULT_RULES —
+    i.e. ``embed``), or None for leaves without one (norm scales). Indices
+    are for the UNSTACKED leaf; the stacked stage axis goes in front."""
+    from tony_tpu.parallel.sharding import DEFAULT_RULES
+
+    rules = dict(DEFAULT_RULES)
+    block = Block(cfg)
+    dummy_x = jnp.zeros((1, 8, cfg.dim), cfg.dtype)
+    dummy_pos = jnp.zeros((1, 8), jnp.int32)
+    boxed = jax.eval_shape(block.init, jax.random.key(0), dummy_x,
+                           dummy_pos)["params"]
+    spec_tree = nn.get_partition_spec(boxed)
+
+    def leaf_axis(spec):
+        # -1 = no fsdp dim (None would vanish from the pytree structure)
+        if not isinstance(spec, P):
+            return -1
+        for i, name in enumerate(spec):
+            if name is not None and rules.get(name) == "fsdp":
+                return i
+        return -1
+
+    return jax.tree.map(leaf_axis, spec_tree,
+                        is_leaf=lambda x: isinstance(x, P) or x is None)
+
+
+def _block_specs(fsdp_axes: Any, blocks: Any) -> Any:
+    """PartitionSpecs for the stacked block leaves: stage axis over ``pp``
+    plus each leaf's fsdp dim. Single source for BOTH the at-rest param
+    shardings and the shard_map in_specs — if they diverged, shard_map
+    would silently force a full reshard on entry."""
+    def leaf_spec(ax, leaf):
+        spec = [PP_AXIS] + [None] * (leaf.ndim - 1)
+        if ax >= 0:
+            spec[ax + 1] = "fsdp"
+        return P(*spec)
+
+    return jax.tree.map(leaf_spec, fsdp_axes, blocks)
+
+
+def pipeline_param_shardings(mesh: Mesh, params: Dict[str, Any],
+                             cfg: Optional[TransformerConfig] = None
                              ) -> Dict[str, Any]:
-    """Stacked blocks → leading axis over ``pp``; everything else replicated
-    (v1 — compose fsdp/tp sharding of the non-block leaves later)."""
+    """Composed shardings: stacked blocks over ``pp`` on the stage axis AND
+    ``fsdp`` on each leaf's embed dim (gathered just-in-time inside the
+    stage loop — see ``_stage_apply``); embedding/lm_head/final_norm —
+    exactly the tensors that dominate memory at 8B scale — shard over
+    fsdp/tp outside the shard_map. With fsdp>1, no leaf of the pipeline
+    state is fully replicated."""
+    if cfg is not None:
+        spec_tree = _block_specs(_block_fsdp_axes(cfg), params["blocks"])
+    else:   # stage-only sharding (no fsdp composition)
+        spec_tree = jax.tree.map(lambda _: P(PP_AXIS), params["blocks"])
+    # Embedding sharded on the VOCAB dim: an embed-sharded table makes the
+    # lookup's output embed-sharded, which SPMD can only reshard to the
+    # batch-sharded activations by full rematerialization (see
+    # models/transformer.py embedding comment; XLA b/433785288).
     return {
-        "embedding": NamedSharding(mesh, P()),
-        "blocks": jax.tree.map(
-            lambda _: NamedSharding(mesh, P(PP_AXIS)), params["blocks"]),
-        "final_norm": NamedSharding(mesh, P()),
-        "lm_head": NamedSharding(mesh, P()),
+        "embedding": NamedSharding(mesh, P("fsdp", None)),
+        "blocks": jax.tree.map(lambda s: NamedSharding(mesh, s), spec_tree,
+                               is_leaf=lambda x: isinstance(x, P)),
+        "final_norm": NamedSharding(mesh, P("fsdp")),
+        "lm_head": NamedSharding(mesh, P("fsdp", "tp")),
     }
 
 
-def _stage_apply(cfg: TransformerConfig, stage_params: Any, x: jax.Array,
+def _stage_apply(cfg: TransformerConfig, fsdp_axes: Any, n_fsdp: int,
+                 stage_params: Any, x: jax.Array,
                  positions: jax.Array) -> jax.Array:
-    """Apply this device's contiguous layer range ([L/S, ...] stacked)."""
+    """Apply this device's contiguous layer range ([L/S, ...] stacked).
+
+    With fsdp>1 the stage's params arrive as fsdp-local chunks; each
+    layer's weights are all-gathered just-in-time inside the (possibly
+    remat'd) apply — so the gather is recomputed in backward instead of
+    living as a residual, and its transpose is the FSDP reduce-scatter of
+    the gradients. This is FSDP-in-PP: at rest every block leaf is sharded
+    over pp×fsdp."""
     block = Block(cfg)
 
-    def apply_one(p, h):
-        return block.apply({"params": p}, h, positions)
+    def apply_one(p_local, h):
+        if n_fsdp > 1:
+            p_local = jax.tree.map(
+                lambda a, ax: a if ax < 0 else lax.all_gather(
+                    a, "fsdp", axis=ax, tiled=True),
+                p_local, fsdp_axes)
+        return block.apply({"params": p_local}, h, positions)
 
     if cfg.remat:
         apply_one = jax.checkpoint(apply_one, prevent_cse=False)
@@ -109,6 +177,7 @@ def _stage_apply(cfg: TransformerConfig, stage_params: Any, x: jax.Array,
 
 
 def _pipeline_blocks(cfg: TransformerConfig, num_microbatches: int,
+                     fsdp_axes: Any, n_fsdp: int,
                      blocks_local: Any, x: jax.Array,
                      positions: jax.Array) -> jax.Array:
     """Per-shard GPipe loop (runs inside shard_map over pp + batch axes).
@@ -133,7 +202,8 @@ def _pipeline_blocks(cfg: TransformerConfig, num_microbatches: int,
         inject = lax.dynamic_index_in_dim(
             mbs, jnp.clip(t, 0, m - 1), axis=0, keepdims=False)
         state = jnp.where(stage == 0, inject, state)
-        state = _stage_apply(cfg, blocks_local, state, pos_mb)
+        state = _stage_apply(cfg, fsdp_axes, n_fsdp, blocks_local, state,
+                             pos_mb)
         done_idx = t - (n_stages - 1)
         banked = lax.dynamic_update_index_in_dim(
             out, state, jnp.clip(done_idx, 0, m - 1), axis=0)
@@ -168,10 +238,18 @@ def pipeline_forward(cfg: TransformerConfig, mesh: Mesh,
     positions = jnp.broadcast_to(
         jnp.arange(tokens.shape[1], dtype=jnp.int32)[None, :], tokens.shape)
 
-    fn = functools.partial(_pipeline_blocks, cfg, num_microbatches)
+    n_fsdp = mesh.shape.get("fsdp", 1)
+    if n_fsdp > 1:
+        fsdp_axes = _block_fsdp_axes(cfg)
+    else:
+        fsdp_axes = jax.tree.map(lambda _: -1, params["blocks"])
+    blocks_spec = _block_specs(fsdp_axes, params["blocks"])
+
+    fn = functools.partial(_pipeline_blocks, cfg, num_microbatches,
+                           fsdp_axes, n_fsdp)
     x = shard_map(
         fn, mesh=mesh,
-        in_specs=(P(PP_AXIS), P(BATCH_AXES), P(BATCH_AXES)),
+        in_specs=(blocks_spec, P(BATCH_AXES), P(BATCH_AXES)),
         out_specs=P(BATCH_AXES), check_vma=False,
     )(params["blocks"], x, positions)
 

@@ -32,6 +32,12 @@ DEFAULT_RULES: Tuple[Tuple[str, Any], ...] = (
     ("kv", None),
     ("qkv", None),
     ("vocab", "tp"),
+    # Embedding-table dims (see models/transformer.py): vocab rows over
+    # both model axes, embed dim whole — the gather then partitions as
+    # masked-lookup + all-reduce instead of an embed-sharded output that
+    # SPMD can only reshard by full rematerialization.
+    ("vocab_table", ("tp", "fsdp")),
+    ("embed_table", None),
     ("layers", None),
     ("stage", "pp"),
     ("expert", "ep"),
