@@ -102,12 +102,15 @@ def test_e2e_missed_heartbeats_fail_job(tmp_path, monkeypatch):
 def test_e2e_skewed_straggler_still_passes(tmp_path, monkeypatch):
     """Reference ``TestTonyE2E.java:161-176``: one executor lingers after
     its user process exits; completion must not wait on the straggler."""
-    monkeypatch.setenv(constants.TEST_EXECUTOR_SKEW, "worker#0#15")
+    # 30 s skew against a 25 s budget: the margin is what's being tested
+    # (waiting on the straggler costs the full 30 s), and the slack keeps
+    # a loaded CI machine from failing on startup time alone.
+    monkeypatch.setenv(constants.TEST_EXECUTOR_SKEW, "worker#0#30")
     conf = make_conf(tmp_path, "exit_0.py", workers=2)
     t0 = time.monotonic()
     client, rec, code = submit(conf, tmp_path)
     assert code == 0, _dump_task_logs(client)
-    assert time.monotonic() - t0 < 15, "job waited on the skewed straggler"
+    assert time.monotonic() - t0 < 25, "job waited on the skewed straggler"
 
 
 def test_e2e_delayed_completion_notification(tmp_path, monkeypatch):
