@@ -66,12 +66,12 @@ def main():
         # comfortably at this scale and remat would re-run all 16 forward
         # flash kernels inside the backward pass.
         bq = int(os.environ.get("TONY_BENCH_BLOCK_Q", "1024"))
-        bk = int(os.environ.get("TONY_BENCH_BLOCK_K", "512"))
+        bk = int(os.environ.get("TONY_BENCH_BLOCK_K", "1024"))
         cfg = TransformerConfig(
             vocab_size=32000, dim=1024, n_layers=16, n_heads=16,
             n_kv_heads=8, mlp_dim=4096, max_seq_len=2048, remat=False,
             attn_block_q=bq, attn_block_k=bk)
-        batch, seq, steps = 4, 2048, 10
+        batch, seq, steps = 4, 2048, 30
     else:
         cfg = TransformerConfig.tiny()
         batch, seq, steps = 4, 64, 3

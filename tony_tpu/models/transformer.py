@@ -49,7 +49,7 @@ class TransformerConfig:
     remat_policy: Optional[str] = None
     # Flash kernel tile sizes (see ops/attention.py block sweep notes).
     attn_block_q: int = 1024
-    attn_block_k: int = 512
+    attn_block_k: int = 1024
     tie_embeddings: bool = False
     # LM-head matmul dtype; None → activation dtype (bf16 on TPU: the
     # [dim, vocab] projection is ~20% of model FLOPs and f32 runs at half
@@ -262,11 +262,17 @@ class Transformer(nn.Module):
 
 def causal_lm_loss(logits: jax.Array, tokens: jax.Array,
                    mask: Optional[jax.Array] = None) -> jax.Array:
-    """Next-token cross entropy; logits [B,S,V] predict tokens shifted."""
+    """Next-token cross entropy; logits [B,S,V] predict tokens shifted.
+
+    Computed as logsumexp − picked-logit rather than via log_softmax: the
+    reductions fuse into passes over the logits, where log_softmax would
+    materialize a second [B,S,V] f32 tensor (1 GB at the bench shape) just
+    to gather one column from it."""
     targets = tokens[:, 1:]
-    logits = logits[:, :-1]
-    logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
-    nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+    logits = logits[:, :-1].astype(jnp.float32)
+    lse = jax.scipy.special.logsumexp(logits, axis=-1)
+    picked = jnp.take_along_axis(logits, targets[..., None], axis=-1)[..., 0]
+    nll = lse - picked
     if mask is not None:
         m = mask[:, 1:].astype(jnp.float32)
         return jnp.sum(nll * m) / jnp.maximum(jnp.sum(m), 1.0)

@@ -508,7 +508,7 @@ _flash.defvjp(_flash_fwd, _flash_bwd)
 
 def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
                     causal: bool = True, scale: Optional[float] = None,
-                    block_q: int = 1024, block_k: int = 512) -> jax.Array:
+                    block_q: int = 1024, block_k: int = 1024) -> jax.Array:
     """Flash attention, layout ``[B, S, H, D]`` (GQA: H_kv may divide H).
 
     Differentiable (custom flash backward); accumulation in f32 regardless
@@ -516,11 +516,13 @@ def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     at the input dtype's MXU rate. GQA K/V are indexed in the BlockSpecs,
     never repeated.
 
-    Default blocks (1024, 512) come from a v5e sweep on the 317M flagship
-    at seq 2048: 128×128 grid points are too small to amortize per-tile
-    overhead at head_dim 64 (measured 14% MFU end-to-end vs 31.5% at
-    1024×512; 1024×1024 regresses — VMEM pressure). Blocks clamp to the
-    actual (rounded-up) sequence, so short-seq/test calls are unaffected.
+    Default blocks (1024, 1024) come from v5e sweeps on the 317M flagship
+    at seq 2048 (round 3, bf16 VMEM loads): 1024×1024 → 0.526 MFU
+    end-to-end vs 0.477 at 512×512, 0.473 at 1024×512, 0.39 at ·×256;
+    2048-wide k blocks exceed VMEM (the [bq, bk] f32 score tile is the
+    limiter). Small tiles lose to per-tile VPU overhead at head_dim 64.
+    Blocks clamp to the actual (rounded-up) sequence, so short-seq/test
+    calls are unaffected.
     """
     b, sq, h, d = q.shape
     hk = k.shape[2]
