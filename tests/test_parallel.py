@@ -55,7 +55,7 @@ def test_logical_sharding_maps_rules():
     # fsdp is consumed by batch, so a [batch, embed] activation can't reuse
     # it on dim 1 (one mesh axis shards at most one dim of a tensor).
     sh = logical_sharding(mesh, "batch", "embed")
-    assert sh.spec == P(("dp", "fsdp"), None)
+    assert sh.spec == P(("dcn_dp", "dp", "fsdp"), None)
     # A weight [embed, mlp] shards fsdp x tp.
     sh = logical_sharding(mesh, "embed", "mlp")
     assert sh.spec == P("fsdp", "tp")
@@ -105,3 +105,39 @@ def test_batch_sharding_splits_batch_dim():
     sh = batch_sharding(mesh, extra_dims=2)
     x = jax.device_put(jnp.ones((16, 3, 3)), sh)
     assert x.sharding.shard_shape(x.shape) == (2, 3, 3)
+
+
+def test_multislice_dcn_dp_train_step():
+    """Multislice: dcn_dp is an outermost pure-DP axis across (virtual)
+    slices — only the gradient psum crosses it, everything else stays
+    inside a slice. Contiguous device groups stand in for slices on the
+    CPU mesh (mesh.py build_mesh)."""
+    import optax
+
+    from tony_tpu.models import Transformer, TransformerConfig
+    from tony_tpu.models.transformer import causal_lm_loss
+    from tony_tpu.parallel import init_sharded_state, jit_train_step
+    from tony_tpu.parallel.mesh import batch_sharding
+
+    mesh = build_mesh(MeshSpec(dcn_dp=2, dp=2, fsdp=1, tp=2))
+    assert dict(mesh.shape)["dcn_dp"] == 2
+    cfg = TransformerConfig.tiny()
+    model = Transformer(cfg)
+    tokens = jax.random.randint(jax.random.key(0), (8, 32), 0,
+                                cfg.vocab_size)
+    batch = {"tokens": tokens}
+
+    def loss_fn(params, b, rng):
+        return causal_lm_loss(
+            model.apply({"params": params}, b["tokens"]), b["tokens"]), {}
+
+    state, state_sh = init_sharded_state(model, tokens, optax.adam(1e-3),
+                                         mesh)
+    step = jit_train_step(loss_fn, mesh, state_sh, batch)
+    state, m = step(state, batch, jax.random.key(1))
+    assert jnp.isfinite(m["loss"])
+    # the batch really spreads over dcn_dp x dp: 8 rows / 4 = 2 per group
+    sh = batch_sharding(mesh)
+    tokens_sharded = jax.device_put(tokens, sh)
+    shapes = {s.data.shape for s in tokens_sharded.addressable_shards}
+    assert shapes == {(2, 32)}

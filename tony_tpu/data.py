@@ -1,0 +1,110 @@
+"""Multi-host input pipeline: per-process shards assembled into global
+device arrays.
+
+The reference has no data subsystem — feeding was entirely the user
+script's problem (SURVEY.md §2.2 examples read MNIST locally per worker).
+On TPU the idiomatic shape is: every process loads ONLY its slice of the
+global batch, and `jax.make_array_from_process_local_data` assembles the
+logical global array laid out by a `NamedSharding` — no host ever
+materializes the full batch, and the arrays land already sharded for the
+train step (scaling-book input recipe).
+
+Pieces:
+- ``global_batch_sharding(mesh)`` — the standard batch layout (leading
+  dim over ``dcn_dp × dp × fsdp``; alias of ``parallel.mesh
+  .batch_sharding``, the single source of truth).
+- ``ShardedBatchIterator`` — wraps any per-sample source callable and
+  yields globally-sharded pytrees; deterministic per (seed, step,
+  process), so restarts resume identically (checkpoint/resume
+  composability).
+- ``synthetic_lm_batches`` — the zero-dependency token source used by
+  benches/examples (swap for a real tokenized dataset reader).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Dict, Iterator, Optional
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding
+
+from tony_tpu.parallel.mesh import batch_sharding as global_batch_sharding
+
+
+def process_batch_slice(global_batch: int) -> slice:
+    """This process's contiguous row range of the global batch."""
+    n = jax.process_count()
+    if global_batch % n:
+        raise ValueError(
+            f"global batch {global_batch} not divisible by process count {n}")
+    per = global_batch // n
+    i = jax.process_index()
+    return slice(i * per, (i + 1) * per)
+
+
+@dataclasses.dataclass
+class ShardedBatchIterator:
+    """Yield globally-sharded batches from a per-process loader.
+
+    ``load_local(step, rows)`` returns this process's rows of the global
+    batch for ``step`` as a pytree of numpy/jax arrays with leading dim
+    ``rows.stop - rows.start``. The iterator assembles them into global
+    ``jax.Array``s laid out by ``shardings`` (a pytree matching the batch,
+    or a single sharding applied to every leaf)."""
+
+    mesh: Mesh
+    global_batch: int
+    load_local: Callable[[int, slice], Dict[str, Any]]
+    shardings: Optional[Any] = None
+    start_step: int = 0
+
+    def __post_init__(self):
+        self._step = self.start_step
+        self._rows = process_batch_slice(self.global_batch)
+
+    @property
+    def step(self) -> int:
+        return self._step
+
+    def __iter__(self) -> Iterator[Dict[str, Any]]:
+        return self
+
+    def __next__(self) -> Dict[str, Any]:
+        local = self.load_local(self._step, self._rows)
+        self._step += 1
+
+        def to_global(x, sharding):
+            return jax.make_array_from_process_local_data(
+                sharding, np.asarray(x))
+
+        if self.shardings is None or isinstance(self.shardings,
+                                                NamedSharding):
+            default = self.shardings
+            return jax.tree.map(
+                lambda x: to_global(
+                    x, default or global_batch_sharding(
+                        self.mesh, extra_dims=np.asarray(x).ndim - 1)),
+                local)
+        return jax.tree.map(to_global, local, self.shardings)
+
+
+def synthetic_lm_batches(mesh: Mesh, global_batch: int, seq: int,
+                         vocab_size: int, seed: int = 0,
+                         start_step: int = 0) -> ShardedBatchIterator:
+    """Deterministic synthetic token batches: row ``r`` of step ``s`` is a
+    pure function of (seed, s, r), so any process layout — and any restart
+    — sees the same global batch."""
+
+    def load_local(step: int, rows: slice) -> Dict[str, Any]:
+        out = np.empty((rows.stop - rows.start, seq), np.int32)
+        for j, r in enumerate(range(rows.start, rows.stop)):
+            rng = np.random.default_rng(
+                np.random.SeedSequence([seed, step, r]))
+            out[j] = rng.integers(0, vocab_size, size=seq, dtype=np.int32)
+        return {"tokens": out}
+
+    return ShardedBatchIterator(mesh=mesh, global_batch=global_batch,
+                                load_local=load_local,
+                                start_step=start_step)

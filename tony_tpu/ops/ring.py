@@ -126,7 +126,7 @@ def ring_attention_sharded(mesh: Mesh, q: jax.Array, k: jax.Array,
                            axis_name: str = "sp") -> jax.Array:
     """Global-array wrapper: [B, S, H, D] with S sharded over ``axis_name``,
     batch over (dp, fsdp), heads replicated along sp."""
-    spec = P(("dp", "fsdp"), axis_name, None, None)
+    spec = P(("dcn_dp", "dp", "fsdp"), axis_name, None, None)
     fn = functools.partial(ring_attention, axis_name=axis_name,
                            causal=causal, scale=scale)
     return shard_map(fn, mesh=mesh, in_specs=(spec, spec, spec),

@@ -62,7 +62,7 @@ def ulysses_attention_sharded(mesh: Mesh, q: jax.Array, k: jax.Array,
                          f"heads ({k.shape[2]}) divisible by the "
                          f"{axis_name!r} axis size ({n}); use ring "
                          f"attention instead")
-    spec = P(("dp", "fsdp"), axis_name, None, None)
+    spec = P(("dcn_dp", "dp", "fsdp"), axis_name, None, None)
     fn = functools.partial(ulysses_attention, axis_name=axis_name,
                            causal=causal, scale=scale)
     return shard_map(fn, mesh=mesh, in_specs=(spec, spec, spec),

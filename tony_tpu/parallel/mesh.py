@@ -32,7 +32,14 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 # Outermost (slow, DCN-tolerant) → innermost (fast, wants ICI neighbours).
-MESH_AXES = ("dp", "fsdp", "pp", "ep", "sp", "tp")
+# ``dcn_dp`` is the multislice axis: pure data parallelism ACROSS slices,
+# whose only collective (the gradient psum) is the one thing DCN bandwidth
+# can afford — every other axis stays inside a slice on ICI. Size 1 on a
+# single slice, so single-slice code never notices it.
+MESH_AXES = ("dcn_dp", "dp", "fsdp", "pp", "ep", "sp", "tp")
+# Every axis that consumes the batch dim — the single source of truth
+# (rules, pipeline, data pipeline all import this).
+BATCH_AXES = ("dcn_dp", "dp", "fsdp")
 
 
 @dataclasses.dataclass(frozen=True)
@@ -41,6 +48,7 @@ class MeshSpec:
     product equals the device count). Unused axes stay 1 — they are kept in
     the mesh so sharding rules are uniform across strategies."""
 
+    dcn_dp: int = 1
     dp: int = -1
     fsdp: int = 1
     pp: int = 1
@@ -104,16 +112,26 @@ def build_mesh(spec: Optional[MeshSpec] = None,
     sizes = spec.sizes()
     if devices[0].platform == "tpu":
         from jax.experimental import mesh_utils
-        dev_array = mesh_utils.create_device_mesh(sizes, devices=devices)
+        if spec.dcn_dp > 1:
+            # Multislice: per-slice axes laid out on each slice's torus,
+            # dcn_dp across slices (grouped by device.slice_index).
+            dev_array = mesh_utils.create_hybrid_device_mesh(
+                (1,) + tuple(sizes[1:]),
+                (spec.dcn_dp,) + (1,) * (len(sizes) - 1),
+                devices=devices)
+        else:
+            dev_array = mesh_utils.create_device_mesh(sizes,
+                                                      devices=devices)
     else:
+        # Virtual/CPU: contiguous groups stand in for slices.
         dev_array = np.asarray(devices).reshape(sizes)
     return Mesh(dev_array, MESH_AXES)
 
 
 def batch_sharding(mesh: Mesh, extra_dims: int = 1) -> NamedSharding:
     """Sharding for a [batch, ...] input: batch split over every
-    data-parallel-ish axis (dp and fsdp both consume batch)."""
-    return NamedSharding(mesh, P(("dp", "fsdp"), *([None] * extra_dims)))
+    data-parallel-ish axis (dcn_dp, dp and fsdp all consume batch)."""
+    return NamedSharding(mesh, P(BATCH_AXES, *([None] * extra_dims)))
 
 
 def replicated_sharding(mesh: Mesh) -> NamedSharding:

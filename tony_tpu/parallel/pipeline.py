@@ -49,8 +49,9 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from tony_tpu.models.transformer import (Block, TransformerConfig,
                                          causal_lm_loss)
 
+from tony_tpu.parallel.mesh import BATCH_AXES
+
 PP_AXIS = "pp"
-BATCH_AXES = ("dp", "fsdp")
 
 
 def init_pipeline_params(cfg: TransformerConfig, rng: jax.Array

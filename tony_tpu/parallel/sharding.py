@@ -19,11 +19,13 @@ import flax.linen as nn
 import jax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from tony_tpu.parallel.mesh import BATCH_AXES
+
 # Logical name → mesh axis (or tuple of axes). Maxtext-style assignment:
 # batch over dp+fsdp, params sharded over fsdp (FSDP) with the model
 # dimension split over tp, sequence over sp.
 DEFAULT_RULES: Tuple[Tuple[str, Any], ...] = (
-    ("batch", ("dp", "fsdp")),
+    ("batch", BATCH_AXES),
     ("seq", "sp"),
     ("embed", "fsdp"),
     ("mlp", "tp"),
