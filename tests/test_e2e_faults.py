@@ -102,15 +102,24 @@ def test_e2e_missed_heartbeats_fail_job(tmp_path, monkeypatch):
 def test_e2e_skewed_straggler_still_passes(tmp_path, monkeypatch):
     """Reference ``TestTonyE2E.java:161-176``: one executor lingers after
     its user process exits; completion must not wait on the straggler."""
-    # 30 s skew against a 25 s budget: the margin is what's being tested
-    # (waiting on the straggler costs the full 30 s), and the slack keeps
-    # a loaded CI machine from failing on startup time alone.
-    monkeypatch.setenv(constants.TEST_EXECUTOR_SKEW, "worker#0#30")
+    # The property: completion keys off the REPORTED result, not the
+    # executor process's exit — waiting on the straggler would push the
+    # coordinator-internal INITED→FINISHED interval past the 90 s sleep.
+    # Event timestamps, not wall clock (pytest/client startup must not
+    # count), and a 30 s slack below the skew: on a heavily oversubscribed
+    # CI machine the result RPC can exhaust its retry budget (~20 s)
+    # before the completion falls back to the process poll.
+    monkeypatch.setenv(constants.TEST_EXECUTOR_SKEW, "worker#0#90")
     conf = make_conf(tmp_path, "exit_0.py", workers=2)
-    t0 = time.monotonic()
     client, rec, code = submit(conf, tmp_path)
     assert code == 0, _dump_task_logs(client)
-    assert time.monotonic() - t0 < 25, "job waited on the skewed straggler"
+    from tony_tpu.events import history
+    evs = {e.type: e.timestamp_ms
+           for e in history.read_job_events(str(tmp_path / "history"),
+                                            rec.app_id)}
+    took_s = (evs["APPLICATION_FINISHED"] - evs["APPLICATION_INITED"]) / 1000
+    assert took_s < 60, \
+        f"job took {took_s:.1f}s — waited on the 90s skewed straggler"
 
 
 def test_e2e_delayed_completion_notification(tmp_path, monkeypatch):
