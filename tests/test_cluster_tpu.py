@@ -155,3 +155,56 @@ def test_e2e_host_loss_triggers_retry_and_recovers(tmp_path, monkeypatch):
     assert code == 0, _dump_task_logs(client)
     assert rec.finished[0] == "SUCCEEDED"
     assert int(rec.finished[1].get("attempt", 0)) == 1  # recovered on retry
+
+
+# ---------------------------------------------------------------------------
+# Backend selection plumbing (coordinator __main__._make_backend)
+# ---------------------------------------------------------------------------
+def test_make_backend_dispatch(tmp_path):
+    from tony_tpu.cluster.local import LocalProcessBackend
+    from tony_tpu.cluster.tpu import StaticSshProvisioner
+    from tony_tpu.conf.config import TonyTpuConfig
+    from tony_tpu.coordinator.__main__ import _make_backend
+
+    conf = TonyTpuConfig()
+    assert isinstance(_make_backend(conf, str(tmp_path)),
+                      LocalProcessBackend)
+
+    conf.set(K.APPLICATION_BACKEND, "tpu-slice")
+    conf.set(K.SLICE_PROVISIONER, "ssh")
+    conf.set(K.SLICE_HOSTS, "tpu-vm-a, tpu-vm-b,tpu-vm-c")
+    conf.set(K.SLICE_NUM_HOSTS, 2)
+    b = _make_backend(conf, str(tmp_path))
+    assert isinstance(b, TpuSliceBackend)
+    assert isinstance(b.provisioner, StaticSshProvisioner)
+    assert b.provisioner.targets == ["tpu-vm-a", "tpu-vm-b", "tpu-vm-c"]
+    assert b.n_hosts == 2
+
+    conf.set(K.SLICE_PROVISIONER, "fake")
+    b = _make_backend(conf, str(tmp_path))
+    assert isinstance(b.provisioner, FakeSliceProvisioner)
+
+    conf.set(K.SLICE_PROVISIONER, "bogus")
+    with pytest.raises(ValueError, match="provisioner"):
+        _make_backend(conf, str(tmp_path))
+    conf.set(K.APPLICATION_BACKEND, "bogus")
+    with pytest.raises(ValueError, match="backend"):
+        _make_backend(conf, str(tmp_path))
+
+
+def test_ssh_provisioner_lease_bookkeeping(tmp_path):
+    """StaticSshProvisioner: atomic grants from the fixed inventory, no
+    double-lease, release frees hosts (no ssh traffic — lease bookkeeping
+    only)."""
+    from tony_tpu.cluster.tpu import SshHostChannel, StaticSshProvisioner
+
+    prov = StaticSshProvisioner(["a", "b", "c"])
+    l1 = prov.acquire(2)
+    assert [h.host_id for h in l1.hosts] == ["a", "b"]
+    assert all(isinstance(h, SshHostChannel) for h in l1.hosts)
+    with pytest.raises(SliceProvisionError):
+        prov.acquire(2)          # only c is free
+    l2 = prov.acquire(1)
+    assert [h.host_id for h in l2.hosts] == ["c"]
+    prov.release(l1)
+    assert len(prov.acquire(2).hosts) == 2
