@@ -1,0 +1,22 @@
+#!/usr/bin/env bash
+# Provision a Cloud TPU pod slice for tony-tpu jobs (the analogue of the
+# reference's tony-in-gcp Dataproc setup scripts — here the substrate is
+# TPU VMs instead of a Hadoop cluster).
+#
+# Usage: ./create-tpu-slice.sh NAME ZONE ACCEL_TYPE [VERSION]
+#   e.g. ./create-tpu-slice.sh tony-v5p us-east5-a v5p-32
+set -euo pipefail
+
+NAME=${1:?slice name}
+ZONE=${2:?zone, e.g. us-east5-a}
+TYPE=${3:?accelerator type, e.g. v5p-32}
+VERSION=${4:-tpu-ubuntu2204-base}
+
+gcloud compute tpus tpu-vm create "$NAME" \
+    --zone="$ZONE" \
+    --accelerator-type="$TYPE" \
+    --version="$VERSION"
+
+# The per-host inventory for tony.slice.hosts (ssh provisioner):
+gcloud compute tpus tpu-vm describe "$NAME" --zone="$ZONE" \
+    --format='value(networkEndpoints[].ipAddress)' | tr ';' ','

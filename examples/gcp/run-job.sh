@@ -1,0 +1,15 @@
+#!/usr/bin/env bash
+# Submit a job onto a provisioned slice with the ssh provisioner.
+#
+# Usage: HOSTS=ip1,ip2,... ./run-job.sh path/to/job-config.yaml
+set -euo pipefail
+
+CONF=${1:?job config file}
+: "${HOSTS:?comma-separated TPU VM hosts (from create-tpu-slice.sh)}"
+
+N_HOSTS=$(awk -F, '{print NF}' <<<"$HOSTS")
+python -m tony_tpu.cli submit --conf-file "$CONF" \
+    --conf tony.application.backend=tpu-slice \
+    --conf tony.slice.provisioner=ssh \
+    --conf "tony.slice.hosts=$HOSTS" \
+    --conf "tony.slice.num-hosts=$N_HOSTS"

@@ -1,0 +1,13 @@
+#!/usr/bin/env bash
+# Install tony-tpu + jax[tpu] on every host of a slice (runs the command
+# on all workers via the TPU VM ssh fanout).
+#
+# Usage: ./setup-hosts.sh NAME ZONE [WHEEL_OR_GIT_URL]
+set -euo pipefail
+
+NAME=${1:?slice name}
+ZONE=${2:?zone}
+SRC=${3:-tony-tpu}
+
+gcloud compute tpus tpu-vm ssh "$NAME" --zone="$ZONE" --worker=all \
+    --command="pip install -U 'jax[tpu]' -f https://storage.googleapis.com/jax-releases/libtpu_releases.html && pip install '$SRC'"

@@ -149,3 +149,40 @@ def _dump_task_logs(client):
         with open(coord) as fh:
             out.append(f"--- coordinator.log ---\n{fh.read()}")
     return "\n".join(out)[-8000:]
+
+
+def test_cli_kill_terminates_running_job(tmp_path):
+    """`tony-tpu kill <app_id>`: standalone force-kill via the job dir's
+    coordinator address (reference forceKillApplication
+    TonyClient.java:959)."""
+    import threading
+    import time as _time
+
+    from tony_tpu.cli.main import main
+
+    conf = make_conf(tmp_path, "sleep_5.py", workers=1,
+                     extra={K.TASK_EXECUTOR_EXECUTION_TIMEOUT_S: 120})
+    conf.set("tony.worker.command",
+             f"{sys.executable} -c 'import time; time.sleep(120)'")
+    client = TonyTpuClient(conf, workdir=str(tmp_path / "work"))
+    rec = Recorder()
+    client.add_listener(rec)
+    result = {}
+    t = threading.Thread(target=lambda: result.update(code=client.start()),
+                         daemon=True)
+    t.start()
+    deadline = _time.time() + 60
+    while _time.time() < deadline and not (
+            rec.updates and any(x["status"] == "RUNNING"
+                                for x in rec.updates[-1])):
+        _time.sleep(0.2)
+    assert rec.app_id, "job never submitted"
+    code = main(["kill", rec.app_id, "--workdir", str(tmp_path / "work")])
+    assert code == 0
+    t.join(timeout=60)
+    assert not t.is_alive(), "client did not return after kill"
+    assert rec.finished and rec.finished[0] == "KILLED"
+
+    # unknown app id → clean error, not a traceback
+    assert main(["kill", "app_nope", "--workdir",
+                 str(tmp_path / "work")]) == 1

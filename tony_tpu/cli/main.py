@@ -80,13 +80,49 @@ def _cmd_notebook(args: argparse.Namespace) -> int:
                            local_port=args.port)
 
 
+def _default_workdir(arg):
+    """Single source for the client workdir default (must match what
+    submit used, or kill/history look in the wrong place)."""
+    return arg or os.environ.get(
+        "TONY_TPU_WORKDIR",
+        os.path.join(os.path.expanduser("~"), ".tony-tpu"))
+
+
+def _cmd_kill(args: argparse.Namespace) -> int:
+    """Force-kill a running application by id (reference
+    ``forceKillApplication`` TonyClient.java:959, as a standalone command:
+    the coordinator's RPC endpoint is discovered from the job dir's
+    address file, like the client does at submit)."""
+    import json
+
+    from tony_tpu.rpc.wire import RpcClient
+
+    workdir = _default_workdir(args.workdir)
+    addr_file = os.path.join(workdir, "jobs", args.app_id,
+                             "coordinator.addr")
+    if not os.path.exists(addr_file):
+        print(f"no coordinator address for {args.app_id} under {workdir} "
+              f"(wrong --workdir, or the job already finished)",
+              file=sys.stderr)
+        return 1
+    with open(addr_file, encoding="utf-8") as f:
+        addr = json.load(f)
+    try:
+        RpcClient(addr["host"], addr["port"],
+                  token=addr.get("token") or None,
+                  max_retries=2, retry_sleep_s=0.5).call("kill_application")
+    except Exception as e:  # noqa: BLE001
+        print(f"kill failed (coordinator gone?): {e}", file=sys.stderr)
+        return 1
+    print(f"kill signal sent to {args.app_id}")
+    return 0
+
+
 def _cmd_history(args: argparse.Namespace) -> int:
     from tony_tpu.events import history
 
-    root = args.history_root or os.path.join(
-        os.environ.get("TONY_TPU_WORKDIR",
-                       os.path.join(os.path.expanduser("~"), ".tony-tpu")),
-        "history")
+    root = args.history_root or os.path.join(_default_workdir(None),
+                                             "history")
     rows = history.list_jobs(root)
     if not rows:
         print(f"no job history under {root}")
@@ -102,10 +138,8 @@ def _cmd_history(args: argparse.Namespace) -> int:
 def _cmd_events(args: argparse.Namespace) -> int:
     from tony_tpu.events import history
 
-    root = args.history_root or os.path.join(
-        os.environ.get("TONY_TPU_WORKDIR",
-                       os.path.join(os.path.expanduser("~"), ".tony-tpu")),
-        "history")
+    root = args.history_root or os.path.join(_default_workdir(None),
+                                             "history")
     events = history.read_job_events(root, args.app_id)
     if events is None:
         print(f"no history for {args.app_id} under {root}", file=sys.stderr)
@@ -148,6 +182,12 @@ def build_parser() -> argparse.ArgumentParser:
                    help="local proxy port (default: auto)")
     n.add_argument("--workdir", help="client workdir (default ~/.tony-tpu)")
     n.set_defaults(fn=_cmd_notebook)
+
+    k = sub.add_parser("kill", help="force-kill a running application")
+    k.add_argument("app_id")
+    k.add_argument("--workdir", help="client workdir the job was "
+                                     "submitted from (default ~/.tony-tpu)")
+    k.set_defaults(fn=_cmd_kill)
 
     h = sub.add_parser("history", help="list finished jobs")
     h.add_argument("--history-root")

@@ -303,6 +303,13 @@ class Coordinator:
             # status, never transient session state) must not observe the
             # transient FAILED here.
             status = SessionStatus.RUNNING
+        if self._stop_requested.is_set() and status == SessionStatus.FAILED:
+            # Kill teardown window: session.fail(stop_reason) lands before
+            # run()'s finally block remaps the final status, and killing
+            # the gang can take seconds — a poll here must already read
+            # KILLED, not the transient FAILED (same YARN semantics as the
+            # finally-block mapping).
+            status = SessionStatus.KILLED
         return {
             "app_id": self.app_id,
             "status": status.value,
@@ -434,8 +441,12 @@ class Coordinator:
                 attempt += 1
         finally:
             self.final_status = self.session.update_status()
-            if self._stop_requested.is_set() and \
-                    self.final_status == SessionStatus.RUNNING:
+            if self._stop_requested.is_set() and self.final_status in (
+                    SessionStatus.RUNNING, SessionStatus.FAILED):
+                # A requested stop reads as KILLED even when the teardown
+                # itself made tasks exit nonzero first (killing the gang
+                # races the chief-failure policy) — YARN semantics: a
+                # user-killed app is KILLED, not FAILED.
                 self.final_status = SessionStatus.KILLED
             self._stop()
         return self.final_status
