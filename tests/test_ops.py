@@ -114,3 +114,36 @@ def test_ulysses_matches_reference(causal):
     out = ulysses_attention_sharded(mesh, q, k, v, causal=causal)
     ref = reference_attention(q, k, v, causal=causal)
     np.testing.assert_allclose(out, ref, atol=2e-5, rtol=2e-5)
+
+
+def test_flash_bf16_gradients_within_tolerance():
+    """Pin bf16 gradient accuracy: the fused MXU row-sum accumulates l
+    from bf16-rounded p, which must not bias lse (and through it dq/dk/dv)
+    beyond bf16-expected error vs the f32 oracle."""
+    q, k, v = _qkv(b=1, s=128, h=2, d=32, dtype=jnp.bfloat16)
+
+    def loss_flash(q, k, v):
+        return jnp.sum(flash_attention(q, k, v, block_q=64,
+                                       block_k=64).astype(jnp.float32) ** 2)
+
+    qf, kf, vf = (x.astype(jnp.float32) for x in (q, k, v))
+
+    def loss_ref(q, k, v):
+        return jnp.sum(reference_attention(q, k, v) ** 2)
+
+    g_flash = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    g_ref = jax.grad(loss_ref, argnums=(0, 1, 2))(qf, kf, vf)
+    for gf, gr, name in zip(g_flash, g_ref, "qkv"):
+        err = np.abs(np.asarray(gf, np.float32) - np.asarray(gr))
+        scale_ = np.abs(np.asarray(gr)).max()
+        assert err.max() / scale_ < 0.03, \
+            f"d{name} rel err {err.max() / scale_:.4f}"
+
+
+def test_flash_head_dim_128_and_wider():
+    """d=128 takes the unfused row-sum path (the ones column would spill
+    into a second lane tile); results must match the oracle either way."""
+    q, k, v = _qkv(b=1, s=64, h=2, d=128)
+    out = flash_attention(q, k, v, block_q=32, block_k=32)
+    ref = reference_attention(q, k, v)
+    np.testing.assert_allclose(out, ref, atol=2e-5, rtol=2e-5)

@@ -158,17 +158,24 @@ def reference_attention(q: jax.Array, k: jax.Array, v: jax.Array,
 # ---------------------------------------------------------------------------
 # Forward kernel
 # ---------------------------------------------------------------------------
-def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
-                *, scale: float, causal: bool, block_q: int, block_k: int,
-                num_k_blocks: int, seq_q: int, seq_k: int):
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *scratch,
+                scale: float, causal: bool, block_q: int, block_k: int,
+                num_k_blocks: int, seq_q: int, seq_k: int,
+                fused_rowsum: bool):
+    if fused_rowsum:
+        m_scr, acc_scr = scratch
+        l_scr = None
+    else:
+        m_scr, l_scr, acc_scr = scratch
     qi = pl.program_id(2)
     kj = pl.program_id(3)
 
     @pl.when(kj == 0)
     def _init():
         m_scr[:] = jnp.full_like(m_scr, NEG_INF)
-        l_scr[:] = jnp.zeros_like(l_scr)
         acc_scr[:] = jnp.zeros_like(acc_scr)
+        if not fused_rowsum:
+            l_scr[:] = jnp.zeros_like(l_scr)
 
     # Causal: skip fully-masked tiles (k strictly after the q tile's end).
     run = True
@@ -197,16 +204,34 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
         m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
         alpha = jnp.exp(m_prev - m_new)
         p = jnp.exp(s - m_new)
-        l_scr[:] = l_scr[:] * alpha + jnp.sum(p, axis=1, keepdims=True)
-        acc_scr[:] = acc_scr[:] * alpha + jax.lax.dot(
-            p.astype(v.dtype), v, preferred_element_type=jnp.float32,
-            precision=_prec(v))
+        if fused_rowsum:
+            # The row-sum rides the MXU: a ones column appended to v makes
+            # the pv dot produce [o_partial | l_partial] in one accumulator
+            # — free while d+1 fits the 128-wide MXU/lane tile, deleting
+            # the VPU sum-reduce pass over the score tile. (At d >= 128
+            # the extra column would pad to a second lane tile, doubling
+            # accumulator VMEM — the plain reduce is used instead.)
+            v1 = jnp.concatenate(
+                [v, jnp.ones((v.shape[0], 1), v.dtype)], axis=1)
+            acc_scr[:] = acc_scr[:] * alpha + jax.lax.dot(
+                p.astype(v.dtype), v1, preferred_element_type=jnp.float32,
+                precision=_prec(v))
+        else:
+            l_scr[:] = l_scr[:] * alpha + jnp.sum(p, axis=1, keepdims=True)
+            acc_scr[:] = acc_scr[:] * alpha + jax.lax.dot(
+                p.astype(v.dtype), v, preferred_element_type=jnp.float32,
+                precision=_prec(v))
         m_scr[:] = m_new
 
     @pl.when(kj == num_k_blocks - 1)
     def _finalize():
-        l = jnp.maximum(l_scr[:], 1e-30)
-        o_ref[0, 0] = (acc_scr[:] / l).astype(o_ref.dtype)
+        if fused_rowsum:
+            acc = acc_scr[:]
+            l = jnp.maximum(acc[:, -1:], 1e-30)
+            o_ref[0, 0] = (acc[:, :-1] / l).astype(o_ref.dtype)
+        else:
+            l = jnp.maximum(l_scr[:], 1e-30)
+            o_ref[0, 0] = (acc_scr[:] / l).astype(o_ref.dtype)
         _store_stat(lse_ref, m_scr[:] + jnp.log(l))
 
 
@@ -356,9 +381,11 @@ def _fwd_impl(q, k, v, scale, causal, block_q, block_k):
         return jnp.minimum(j, _last_valid_kj(i, block_q, block_k)) \
             if causal else j
 
+    fused_rowsum = d < 128
     kernel = functools.partial(
         _fwd_kernel, scale=scale, causal=causal, block_q=block_q,
-        block_k=block_k, num_k_blocks=nk, seq_q=sq, seq_k=sk)
+        block_k=block_k, num_k_blocks=nk, seq_q=sq, seq_k=sk,
+        fused_rowsum=fused_rowsum)
     o, lse = pl.pallas_call(
         kernel,
         grid=(b, h, nq, nk),
@@ -378,11 +405,13 @@ def _fwd_impl(q, k, v, scale, causal, block_q, block_k):
             jax.ShapeDtypeStruct((b, h, sq, d), q.dtype),
             jax.ShapeDtypeStruct((b, h, STAT_SUB, sq), jnp.float32),
         ],
-        scratch_shapes=[
-            pltpu.VMEM((block_q, 1), jnp.float32),
-            pltpu.VMEM((block_q, 1), jnp.float32),
-            pltpu.VMEM((block_q, d), jnp.float32),
-        ],
+        scratch_shapes=(
+            [pltpu.VMEM((block_q, 1), jnp.float32),
+             pltpu.VMEM((block_q, d + 1), jnp.float32)]
+            if fused_rowsum else
+            [pltpu.VMEM((block_q, 1), jnp.float32),
+             pltpu.VMEM((block_q, 1), jnp.float32),
+             pltpu.VMEM((block_q, d), jnp.float32)]),
         interpret=_interpret(),
     )(q, k, v)
     return o, lse
