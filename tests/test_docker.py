@@ -1,0 +1,90 @@
+"""Per-jobtype container images: the executor launch is wrapped in
+`docker run` when `tony.<job>.docker-image` is set (reference per-job
+docker support, TonyConfigurationKeys.java:178-239 + Utils.java:729-776).
+
+A stub `docker` binary on PATH stands in for the daemon: it records the
+image, applies the -e env exactly as docker would, and execs the
+contained command — so the full client→coordinator→executor e2e runs
+through the wrapper without requiring dockerd.
+"""
+
+import os
+import stat
+import sys
+
+from tony_tpu.cluster.base import TaskLaunchSpec, build_executor_argv
+from tony_tpu.conf import keys as K
+
+from test_e2e import _dump_task_logs, make_conf, submit
+
+
+def test_build_executor_argv_plain_vs_docker(tmp_path):
+    spec = TaskLaunchSpec(task_id="worker:0", job_name="worker", index=0,
+                          command="python t.py", env={"A": "1", "B": "x y"})
+    assert build_executor_argv("py", spec, "/wd") == \
+        ["py", "-m", "tony_tpu.executor"]
+    spec.docker_image = "gcr.io/proj/train:1"
+    argv = build_executor_argv("py", spec, "/wd")
+    assert argv[:4] == ["docker", "run", "--rm", "--network=host"]
+    assert "-v" in argv and "/wd:/wd" in argv
+    assert argv[argv.index("A=1") - 1] == "-e"
+    assert ["-e", "B=x y"] == argv[argv.index("B=x y") - 1:
+                                   argv.index("B=x y") + 1]
+    i = argv.index("gcr.io/proj/train:1")
+    assert argv[i + 1:] == ["python3", "-m", "tony_tpu.executor"]
+
+
+def _write_docker_stub(stub_dir, log_file):
+    """A faithful-enough docker CLI: applies -e, records the image, execs
+    the command (with python3 resolved to this interpreter so the in-
+    container executor finds the test environment's packages)."""
+    stub = os.path.join(stub_dir, "docker")
+    with open(stub, "w", encoding="utf-8") as f:
+        f.write(f'''#!{sys.executable}
+import os, sys
+args = sys.argv[1:]
+assert args[0] == "run", args
+rest = args[1:]
+env = {{}}
+i = 0
+while i < len(rest):
+    a = rest[i]
+    if a in ("--rm", "--network=host"):
+        i += 1
+    elif a in ("-v", "-w", "--name"):
+        i += 2
+    elif a == "-e":
+        k, v = rest[i + 1].split("=", 1)
+        env[k] = v
+        i += 2
+    else:
+        break
+image, cmd = rest[i], rest[i + 1:]
+with open({log_file!r}, "a") as lf:
+    lf.write(image + "\\n")
+os.environ.update(env)
+if cmd[0] == "python3":
+    cmd[0] = {sys.executable!r}
+os.execvp(cmd[0], cmd)
+''')
+    os.chmod(stub, os.stat(stub).st_mode | stat.S_IEXEC)
+    return stub
+
+
+def test_e2e_dockerized_jobtype(tmp_path, monkeypatch):
+    log_file = str(tmp_path / "docker_calls.log")
+    stub_dir = tmp_path / "bin"
+    stub_dir.mkdir()
+    _write_docker_stub(str(stub_dir), log_file)
+    monkeypatch.setenv("PATH", f"{stub_dir}{os.pathsep}" +
+                       os.environ.get("PATH", ""))
+
+    conf = make_conf(tmp_path, "check_env.py", workers=2)
+    conf.set(K.DOCKER_IMAGE_FORMAT.format(job="worker"),
+             "gcr.io/test/tony-train:latest")
+    client, rec, code = submit(conf, tmp_path)
+    assert code == 0, _dump_task_logs(client)
+    # both executors launched through the docker wrapper with the image
+    with open(log_file) as f:
+        images = f.read().split()
+    assert images == ["gcr.io/test/tony-train:latest"] * 2

@@ -22,6 +22,7 @@ from __future__ import annotations
 
 import abc
 import dataclasses
+import os
 from typing import Dict, List, Optional, Tuple
 
 
@@ -36,6 +37,61 @@ class TaskLaunchSpec:
     memory: str = "2g"
     chips: int = 0
     node_pool: str = ""
+    docker_image: str = ""
+
+
+def container_name(spec: TaskLaunchSpec) -> str:
+    """Deterministic docker container name for a task, so teardown can
+    ``docker kill`` it by name (killing the ``docker run`` client process
+    does NOT kill the container — it is containerd's child)."""
+    raw = f"tony-{spec.env.get('TONY_APP_ID', 'app')}-{spec.task_id}"
+    return "".join(c if c.isalnum() or c in "_.-" else "-" for c in raw)
+
+
+def build_executor_argv(python: str, spec: TaskLaunchSpec,
+                        workdir: str) -> list:
+    """argv that launches this task's executor — wrapped in ``docker run``
+    when the jobtype configures a container image (reference per-job docker
+    support, ``TonyConfigurationKeys.java:178-239`` + docker env
+    ``Utils.java:729-776``). Host networking keeps the rendezvous port
+    contract unchanged; every task env var crosses with ``-e``; the task
+    workdir, the job dir (frozen config + locally-staged bundle/resources/
+    venv), and the checkpoint dir are bind-mounted at their host paths so
+    localization works unchanged — with a remote store configured nothing
+    but the workdir needs mounting. The image must contain python3 with
+    tony-tpu installed (and, for accelerator jobs, ``jax[tpu]`` plus TPU
+    device access — typically ``--privileged`` baked into a wrapper image
+    or the docker daemon's default runtime on TPU VMs)."""
+    if not spec.docker_image:
+        return [python, "-m", "tony_tpu.executor"]
+    argv = ["docker", "run", "--rm", "--network=host",
+            "--name", container_name(spec),
+            "-v", f"{workdir}:{workdir}", "-w", workdir]
+    mounts = set()
+    conf_path = spec.env.get("TONY_EXECUTOR_CONF", "")
+    if conf_path and "://" not in conf_path:
+        mounts.add(os.path.dirname(os.path.abspath(conf_path)))
+    ckpt = spec.env.get("TONY_CHECKPOINT_DIR", "")
+    if ckpt and "://" not in ckpt:
+        mounts.add(os.path.abspath(ckpt))
+    for m in sorted(mounts):
+        argv += ["-v", f"{m}:{m}"]
+    for k, v in spec.env.items():
+        argv += ["-e", f"{k}={v}"]
+    argv += [spec.docker_image, "python3", "-m", "tony_tpu.executor"]
+    return argv
+
+
+def docker_kill(name: str) -> None:
+    """Best-effort ``docker kill`` of a named task container (teardown
+    companion of build_executor_argv; see container_name)."""
+    import subprocess
+
+    try:
+        subprocess.run(["docker", "kill", name], timeout=15,
+                       stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
+    except Exception:  # noqa: BLE001 — teardown is best-effort
+        pass
 
 
 class Backend(abc.ABC):

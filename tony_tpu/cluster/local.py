@@ -24,16 +24,20 @@ import threading
 import time
 from typing import Dict, List, Optional, Tuple
 
-from tony_tpu.cluster.base import Backend, TaskLaunchSpec
+from tony_tpu.cluster.base import (Backend, TaskLaunchSpec,
+                                   build_executor_argv, container_name,
+                                   docker_kill)
 
 log = logging.getLogger(__name__)
 
 
 class _Proc:
-    def __init__(self, task_id: str, popen: subprocess.Popen, workdir: str):
+    def __init__(self, task_id: str, popen: subprocess.Popen, workdir: str,
+                 container: str = ""):
         self.task_id = task_id
         self.popen = popen
         self.workdir = workdir
+        self.container = container   # docker container name, if dockerized
         self.reported = False
 
 
@@ -61,10 +65,12 @@ class LocalProcessBackend(Backend):
         stdout = open(os.path.join(task_dir, "stdout.log"), "ab")
         stderr = open(os.path.join(task_dir, "stderr.log"), "ab")
         popen = subprocess.Popen(
-            [self.python, "-m", "tony_tpu.executor"],
+            build_executor_argv(self.python, spec, task_dir),
             cwd=task_dir, env=env, stdout=stdout, stderr=stderr,
             start_new_session=True)
-        proc = _Proc(spec.task_id, popen, task_dir)
+        proc = _Proc(spec.task_id, popen, task_dir,
+                     container=container_name(spec) if spec.docker_image
+                     else "")
         with self._lock:
             self._procs[spec.task_id] = proc
         log.info("launched %s pid=%d dir=%s", spec.task_id, popen.pid, task_dir)
@@ -74,6 +80,10 @@ class LocalProcessBackend(Backend):
         proc = handle
         if not isinstance(proc, _Proc) or proc.popen.poll() is not None:
             return
+        if proc.container:
+            # The containerized executor is containerd's child, not ours:
+            # signal the container by name, then the docker-run client.
+            docker_kill(proc.container)
         try:
             # Kill the whole process group (executor + user child).
             os.killpg(proc.popen.pid, signal.SIGTERM)

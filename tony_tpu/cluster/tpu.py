@@ -41,7 +41,8 @@ import threading
 import time
 from typing import Dict, List, Optional, Sequence, Tuple
 
-from tony_tpu.cluster.base import Backend, TaskLaunchSpec
+from tony_tpu.cluster.base import (Backend, TaskLaunchSpec,
+                                   build_executor_argv)
 
 log = logging.getLogger(__name__)
 
@@ -186,10 +187,24 @@ class SshHostChannel(HostChannel):
             f"&& echo $$ > task.pid && {exports} exec {cmd} "
             f"> stdout.log 2> stderr.log")
         popen = self._ssh(remote)
-        return {"popen": popen, "workdir": workdir}
+        container = ""
+        if argv and argv[0] == "docker" and "--name" in argv:
+            container = argv[argv.index("--name") + 1]
+        return {"popen": popen, "workdir": workdir, "container": container}
 
     def kill(self, handle, grace_s: float = 0.0) -> None:
         wd = shlex.quote(handle["workdir"])
+        if handle.get("container"):
+            # Kill the container by name first: signalling the docker-run
+            # client's process group does not reach containerd's child.
+            k = self._ssh(f"docker kill {shlex.quote(handle['container'])} "
+                          f">/dev/null 2>&1 || true",
+                          stdout=subprocess.DEVNULL,
+                          stderr=subprocess.DEVNULL)
+            try:
+                k.wait(timeout=15)
+            except subprocess.TimeoutExpired:
+                k.kill()
         sig = "TERM"
         for attempt in range(2):
             k = self._ssh(
@@ -433,8 +448,8 @@ class TpuSliceBackend(Backend):
         workdir = os.path.join(self.workdir, host.host_id,
                                spec.task_id.replace(":", "_"))
         handle = host.exec_task(
-            spec.task_id, [self.python, "-m", "tony_tpu.executor"], env,
-            workdir)
+            spec.task_id, build_executor_argv(self.python, spec, workdir),
+            env, workdir)
         st = _SliceTask(spec, host, handle)
         with self._lock:
             self._tasks[spec.task_id] = st

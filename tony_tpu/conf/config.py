@@ -40,6 +40,7 @@ class JobType:
     depends_on: Tuple[str, ...] = ()
     env: Dict[str, str] = dataclasses.field(default_factory=dict)
     node_pool: str = ""
+    docker_image: str = ""
 
     @property
     def is_chief_type(self) -> bool:
@@ -199,6 +200,8 @@ class TonyTpuConfig:
                 depends_on=tuple(self.get_list(K.DEPENDS_ON_FORMAT.format(job=job))),
                 env=env_pairs,
                 node_pool=str(self.get(K.NODE_POOL_FORMAT.format(job=job), "") or ""),
+                docker_image=str(self.get(
+                    K.DOCKER_IMAGE_FORMAT.format(job=job), "") or ""),
             )
         return jobs
 

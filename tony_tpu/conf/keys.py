@@ -317,10 +317,17 @@ MAX_INSTANCES_FORMAT = "tony.{job}.max-instances"
 DEPENDS_ON_FORMAT = "tony.{job}.depends-on"
 ENV_FORMAT = "tony.{job}.env"
 NODE_POOL_FORMAT = "tony.{job}.node-pool"  # replaces tony.X.node-label
+# Container image for the jobtype's executors (reference per-job docker
+# support, TonyConfigurationKeys.java:178-239 + Utils docker env :729-776).
+# The backend wraps the executor launch in `docker run` (host networking;
+# task workdir bind-mounted; task env passed with -e). TPU device access
+# additionally needs a privileged image with /dev/accel* — bake jax[tpu]
+# and tony-tpu into the image.
+DOCKER_IMAGE_FORMAT = "tony.{job}.docker-image"
 
 _JOB_KEY_RE: Pattern[str] = re.compile(
     r"^tony\.([a-z][a-z0-9_]*)\.(instances|command|chips|vcores|memory|"
-    r"max-instances|depends-on|env|node-pool)$")
+    r"max-instances|depends-on|env|node-pool|docker-image)$")
 
 _RESERVED_NON_JOB_SEGMENTS = {
     "application", "task", "coordinator", "client", "history", "tpu", "portal",
@@ -363,7 +370,8 @@ def defaults_markdown() -> str:
     ]
     for fmt in (INSTANCES_FORMAT, COMMAND_FORMAT, CHIPS_FORMAT,
                 VCORES_FORMAT, MEMORY_FORMAT, MAX_INSTANCES_FORMAT,
-                DEPENDS_ON_FORMAT, ENV_FORMAT, NODE_POOL_FORMAT):
+                DEPENDS_ON_FORMAT, ENV_FORMAT, NODE_POOL_FORMAT,
+                DOCKER_IMAGE_FORMAT):
         lines.append(f"- `{fmt.format(job='<jobtype>')}`")
     lines.append("")
     return "\n".join(lines)

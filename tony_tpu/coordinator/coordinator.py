@@ -217,7 +217,7 @@ class Coordinator:
                 task_id=task.task_id, job_name=job_name, index=i,
                 command=job.command, env=self._task_env(task),
                 vcores=job.vcores, memory=job.memory, chips=job.chips,
-                node_pool=job.node_pool)
+                node_pool=job.node_pool, docker_image=job.docker_image)
             try:
                 task.handle = self.backend.launch_task(spec)
             except Exception as e:  # noqa: BLE001 — e.g. SliceProvisionError
