@@ -25,7 +25,7 @@ import subprocess
 import sys
 import time
 import uuid
-from typing import Dict, List, Optional
+from typing import List, Optional
 
 from tony_tpu import constants
 from tony_tpu.conf.config import ConfigError, TonyTpuConfig

@@ -16,7 +16,7 @@ from __future__ import annotations
 
 import dataclasses
 import re
-from typing import Any, Callable, Dict, Optional, Pattern, Tuple
+from typing import Any, Dict, Optional, Pattern, Tuple
 
 
 @dataclasses.dataclass(frozen=True)

@@ -16,7 +16,7 @@ matching has no equivalent here (SURVEY.md §7 hard part (a)).
 from __future__ import annotations
 
 import threading
-from typing import Callable, Dict, List, Sequence, Set
+from typing import Callable, Dict, List, Set
 
 from tony_tpu.conf.config import JobType, TonyTpuConfig
 from tony_tpu.conf import keys as K

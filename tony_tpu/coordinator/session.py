@@ -16,7 +16,7 @@ from __future__ import annotations
 import dataclasses
 import enum
 import threading
-from typing import Dict, List, Optional, Tuple
+from typing import Dict, List, Optional
 
 from tony_tpu import constants
 from tony_tpu.conf.config import JobType, TonyTpuConfig

@@ -28,7 +28,7 @@ import socketserver
 import struct
 import threading
 import time
-from typing import Any, Callable, Dict, Optional, Tuple
+from typing import Any, Dict, Optional, Tuple
 
 import msgpack
 
