@@ -35,8 +35,15 @@ SEQ = int(os.environ.get("LLAMA_SEQ", "8192"))
 STEPS = int(os.environ.get("LLAMA_STEPS", "100"))
 TP = int(os.environ.get("LLAMA_TP", "4"))
 
-cfg = TransformerConfig.llama3_8b(remat=True,
-                                  remat_policy="dots_with_no_batch_dims_saveable")
+if os.environ.get("LLAMA_TINY"):
+    # CI shape: same code path (mesh, remat policy, checkpointing), toy
+    # geometry — lets the flagship script run on the virtual CPU mesh.
+    cfg = TransformerConfig.tiny(
+        n_layers=2, remat=True,
+        remat_policy="dots_with_no_batch_dims_saveable")
+else:
+    cfg = TransformerConfig.llama3_8b(
+        remat=True, remat_policy="dots_with_no_batch_dims_saveable")
 mesh = build_mesh(MeshSpec(dp=1, fsdp=-1, tp=TP))
 model = Transformer(cfg)
 tokens = jax.random.randint(jax.random.key(0), (BATCH, SEQ), 0,
