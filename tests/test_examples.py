@@ -84,5 +84,4 @@ def test_llama3_flagship_script_runs_tiny(tmp_path):
         capture_output=True, text=True, timeout=300)
     assert r.returncode == 0, f"stdout:{r.stdout}\nstderr:{r.stderr}"
     assert "final loss" in r.stdout
-    import os as _os
-    assert _os.path.isdir(str(tmp_path / "ckpt"))  # manager initialized
+    assert os.path.isdir(str(tmp_path / "ckpt"))  # manager initialized

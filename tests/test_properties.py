@@ -1,0 +1,112 @@
+"""Property-based tests (hypothesis) for the parse/serialize surfaces:
+config freeze/load round-trip, the resource-spec grammar, and mesh-spec
+resolution — the layers where a malformed string is most likely to arrive
+from user input (reference analogue: the grammar unit tests
+``TestLocalizableResource.java`` + config parity tests, SURVEY.md §4.2)."""
+
+import json
+import math
+
+from hypothesis import given, settings, strategies as st
+
+from tony_tpu.conf.config import TonyTpuConfig
+from tony_tpu.parallel.mesh import MESH_AXES, MeshSpec
+from tony_tpu.utils.localize import LocalizableResource
+
+# keep CI latency sane; these are parse functions, not simulations
+settings.register_profile("ci", max_examples=200, deadline=None)
+settings.load_profile("ci")
+
+_name = st.text(
+    alphabet=st.characters(whitelist_categories=("Ll", "Nd"),
+                           whitelist_characters="_-."),
+    min_size=1, max_size=20).filter(
+        lambda s: "::" not in s and not s.endswith("#archive")
+        and s.strip() == s and not s.startswith("-"))
+
+
+@given(src=_name, name=st.none() | _name, archive=st.booleans())
+def test_resource_grammar_roundtrip(src, name, archive):
+    spec = src
+    if name:
+        spec += f"::{name}"
+    if archive:
+        spec += "#archive"
+    r = LocalizableResource.parse(spec)
+    assert r.source == src
+    assert r.archive == archive
+    assert r.name == (name or src.rstrip("/").split("/")[-1])
+    # unparse → parse is a fixed point
+    r2 = LocalizableResource.parse(r.unparse())
+    assert r2 == r
+
+
+_INT_KEYS = ["tony.worker.instances", "tony.task.heartbeat-interval-ms",
+             "tony.application.retry-count"]
+_STR_KEYS = ["tony.worker.command", "tony.application.name",
+             "custom.passthrough"]
+
+
+@given(st.dictionaries(
+    st.sampled_from(_INT_KEYS), st.integers(0, 10**6), max_size=3),
+    st.dictionaries(
+        st.sampled_from(_STR_KEYS),
+        st.text(max_size=40).filter(lambda s: "\x00" not in s), max_size=3))
+def test_config_freeze_load_roundtrip(tmp_path_factory, int_conf, str_conf):
+    conf_dict = {**int_conf, **str_conf}
+    tmp = tmp_path_factory.mktemp("conf")
+    conf = TonyTpuConfig()
+    for k, v in conf_dict.items():
+        conf.set(k, v)
+    frozen = conf.freeze(str(tmp / "final.json"))
+    loaded = TonyTpuConfig.load_final(frozen)
+    for k in conf_dict:
+        assert loaded.get(k) == conf.get(k), k
+    # the artifact is valid JSON, every registered default present
+    data = json.load(open(frozen))
+    assert "tony.application.name" in data
+
+
+@given(st.lists(st.sampled_from([1, 2, 4, 8]), min_size=0, max_size=3),
+       st.sampled_from([1, 2, 4, 8, 16, 32, 64]))
+def test_mesh_spec_resolution_invariants(fixed, n_devices):
+    axes = list(MESH_AXES)
+    kwargs = {"dp": -1}
+    for i, size in enumerate(fixed):
+        kwargs[axes[(i + 2) % len(axes)]] = size  # skip dcn_dp/dp slots
+    spec = MeshSpec(**kwargs)
+    known = math.prod(s for s in spec.sizes() if s != -1)
+    if n_devices % known:
+        try:
+            spec.resolve(n_devices)
+            assert False, "expected ValueError"
+        except ValueError:
+            return
+    r = spec.resolve(n_devices)
+    assert math.prod(r.sizes()) == n_devices
+    assert all(s >= 1 for s in r.sizes())
+
+
+@given(st.sampled_from(MESH_AXES), st.integers(1, 64))
+def test_mesh_spec_from_string(axis, size):
+    spec = MeshSpec.from_string(f"{axis}={size}")
+    assert getattr(spec, axis) == size
+    # dp defaults to inferred unless given explicitly
+    if axis != "dp":
+        assert spec.dp == -1
+
+
+def test_int_key_error_names_the_key():
+    import pytest
+
+    conf = TonyTpuConfig()
+    conf.set("tony.worker.instances", "")           # empty = unset
+    assert conf.get_int("tony.worker.instances", 0) == 0
+    conf.set("tony.worker.max-instances", "")       # unset ≠ zero cap
+    assert conf.get_int("tony.worker.max-instances", -1) == -1
+    conf.set("tony.worker.vcores", "")
+    assert conf.get_int("tony.worker.vcores", 1) == 1
+    conf.set("tony.task.heartbeat-interval-ms", "")  # empty → default
+    assert conf.get("tony.task.heartbeat-interval-ms") == 1000
+    with pytest.raises(ValueError, match="tony.worker.instances"):
+        conf.set("tony.worker.instances", ":")

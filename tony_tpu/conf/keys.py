@@ -398,17 +398,35 @@ def parse_job_key(name: str) -> Optional[Tuple[str, str]]:
 
 
 def coerce(name: str, value: Any) -> Any:
-    """Coerce a raw (possibly string) value to the registered key type."""
+    """Coerce a raw (possibly string) value to the registered key type.
+    An empty string means "unset" and falls back to the key's default
+    (Hadoop Configuration getInt semantics — found by the config
+    round-trip property test)."""
     key = _REGISTRY.get(name)
     if key is None:
         jk = parse_job_key(name)
         if jk and jk[1] in ("instances", "chips", "vcores", "max-instances"):
-            return int(value)
+            if value in ("", None):
+                # Empty = unset: keep it empty so each call site's get_int
+                # default applies (vcores→1, max-instances→-1/unlimited) —
+                # a hardcoded 0 here would turn "no cap" into a zero cap.
+                return ""
+            try:
+                return int(value)
+            except (TypeError, ValueError) as e:
+                raise ValueError(f"config key {name!r} needs an integer, "
+                                 f"got {value!r}") from e
         return value
+    if value in ("", None) and key.type in (int, bool):
+        return key.default
     if key.type is bool and isinstance(value, str):
         return value.strip().lower() in ("true", "1", "yes", "on")
     if key.type is int and not isinstance(value, bool):
-        return int(value)
+        try:
+            return int(value)
+        except (TypeError, ValueError) as e:
+            raise ValueError(f"config key {name!r} needs an integer, "
+                             f"got {value!r}") from e
     if key.type is str:
         return str(value)
     return value
