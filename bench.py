@@ -71,7 +71,7 @@ def main():
             vocab_size=32000, dim=1024, n_layers=16, n_heads=16,
             n_kv_heads=8, mlp_dim=4096, max_seq_len=2048, remat=False,
             attn_block_q=bq, attn_block_k=bk)
-        batch, seq, steps = 4, 2048, 30
+        batch, seq, steps = 4, 2048, 50
     else:
         cfg = TransformerConfig.tiny()
         batch, seq, steps = 4, 64, 3
