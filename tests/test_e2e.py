@@ -186,3 +186,17 @@ def test_cli_kill_terminates_running_job(tmp_path):
     # unknown app id → clean error, not a traceback
     assert main(["kill", "app_nope", "--workdir",
                  str(tmp_path / "work")]) == 1
+
+
+@pytest.mark.slow
+def test_e2e_wide_gang_barrier(tmp_path):
+    """16-task gang: the rendezvous barrier, heartbeat book-keeping, and
+    completion accounting hold at width (the reference's e2e never exceeds
+    a handful of containers; slices have dozens of hosts)."""
+    conf = make_conf(tmp_path, "check_env.py", workers=16)
+    client, rec, code = submit(conf, tmp_path)
+    assert code == 0, _dump_task_logs(client)
+    final = {f"{t['name']}:{t['index']}": t["status"]
+             for t in rec.updates[-1]}
+    assert len(final) == 16
+    assert set(final.values()) == {"SUCCEEDED"}
