@@ -91,3 +91,18 @@ def test_mover_then_views_still_work(portal, finished_job):
     assert any(r["app_id"] == app_id for r in rows)
     conf = _get(f"{portal.url}/config/{app_id}?format=json")
     assert conf["tony.worker.instances"] == 2
+
+
+def test_profiles_view_empty_and_unknown(portal, finished_job):
+    """No traces captured → empty list (json) / friendly message (html);
+    unknown job → 404."""
+    _, app_id = finished_job
+    assert _get(f"{portal.url}/profiles/{app_id}?format=json") == []
+    html_body = _get(f"{portal.url}/profiles/{app_id}", as_json=False)
+    assert "no traces captured" in html_body
+    import urllib.error
+    try:
+        _get(f"{portal.url}/profiles/app_does_not_exist?format=json")
+        assert False, "expected 404"
+    except urllib.error.HTTPError as e:
+        assert e.code == 404
