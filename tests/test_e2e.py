@@ -200,3 +200,25 @@ def test_e2e_wide_gang_barrier(tmp_path):
              for t in rec.updates[-1]}
     assert len(final) == 16
     assert set(final.values()) == {"SUCCEEDED"}
+
+
+def test_cli_history_and_events_commands(tmp_path, capsys):
+    """`tony-tpu history` lists the finished job; `tony-tpu events` dumps
+    its stream; unknown app id errors cleanly (reference: the portal's
+    jobs-index/events views, for terminals)."""
+    from tony_tpu.cli.main import main
+
+    conf = make_conf(tmp_path, "exit_0.py", workers=1)
+    client, rec, code = submit(conf, tmp_path)
+    assert code == 0
+    hist = str(tmp_path / "history")
+
+    assert main(["history", "--history-root", hist]) == 0
+    out = capsys.readouterr().out
+    assert rec.app_id in out and "SUCCEEDED" in out
+
+    assert main(["events", rec.app_id, "--history-root", hist]) == 0
+    out = capsys.readouterr().out
+    assert "APPLICATION_INITED" in out and "APPLICATION_FINISHED" in out
+
+    assert main(["events", "app_nope", "--history-root", hist]) == 1
