@@ -147,3 +147,16 @@ def test_flash_head_dim_128_and_wider():
     out = flash_attention(q, k, v, block_q=32, block_k=32)
     ref = reference_attention(q, k, v)
     np.testing.assert_allclose(out, ref, atol=2e-5, rtol=2e-5)
+
+
+@pytest.mark.parametrize("causal", [True, False])
+def test_ring_attention_gqa_native(causal):
+    """GQA K/V ride the ring at kv-head width — results must match the
+    repeated-head oracle exactly (the repeat is what the native path
+    deletes; ppermute payload shrinks by the group factor)."""
+    mesh = build_mesh(MeshSpec(dp=2, sp=4))
+    q, k, v = _qkv(b=4, s=64, h=4, d=16, hk=2)
+    out = ring_attention_sharded(mesh, q, k, v, causal=causal)
+    kr, vr = jnp.repeat(k, 2, axis=2), jnp.repeat(v, 2, axis=2)
+    ref = reference_attention(q, kr, vr, causal=causal)
+    np.testing.assert_allclose(out, ref, atol=2e-5, rtol=2e-5)

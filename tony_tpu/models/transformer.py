@@ -155,10 +155,8 @@ class Attention(nn.Module):
             o = reference_attention(q, jnp.repeat(k, g, axis=2),
                                     jnp.repeat(v, g, axis=2), causal=True)
         elif cfg.attn_impl == "ring":
-            g = cfg.n_heads // cfg.n_kv_heads
-            o = ring_attention(q, jnp.repeat(k, g, axis=2),
-                               jnp.repeat(v, g, axis=2), axis_name="sp",
-                               causal=True)
+            # GQA-native: K/V ride the ring at kv-head width (no repeat).
+            o = ring_attention(q, k, v, axis_name="sp", causal=True)
         elif cfg.attn_impl == "ulysses":
             o = ulysses_attention(q, k, v, axis_name="sp", causal=True)
         else:

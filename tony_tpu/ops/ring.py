@@ -71,20 +71,38 @@ def bound_axis_size(axis_name: str):
 def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array,
                    axis_name: str = "sp", causal: bool = True,
                    scale: Optional[float] = None) -> jax.Array:
-    """Per-shard ring attention ([B, S_local, H, D] in/out). Call inside
-    shard_map with the sequence dim sharded over ``axis_name``."""
+    """Per-shard ring attention ([B, S_local, H, D] in/out; GQA: K/V may
+    carry H_kv heads with H_kv | H). Call inside shard_map with the
+    sequence dim sharded over ``axis_name``.
+
+    GQA is native: K/V rotate around the ring at their H_kv width, so the
+    per-hop ppermute payload — ring attention's bandwidth bottleneck at
+    long context — is H/H_kv× smaller than with repeated heads."""
     b, s_loc, h, d = q.shape
+    hk = k.shape[2]
+    if k.shape[2] != v.shape[2]:
+        raise ValueError(f"k heads ({k.shape[2]}) != v heads "
+                         f"({v.shape[2]})")
+    if h % hk:
+        raise ValueError(f"q heads {h} not a multiple of kv heads {hk}")
+    g = h // hk
     n = bound_axis_size(axis_name)
     if n is None:
         # No axes bound at all (model init / single-shard apply): the
         # "ring" is a single chunk — plain causal attention.
         from tony_tpu.ops.attention import reference_attention
+        if g > 1:
+            k = jnp.repeat(k, g, axis=2)
+            v = jnp.repeat(v, g, axis=2)
         return reference_attention(q, k, v, causal=causal, scale=scale)
     my = jax.lax.axis_index(axis_name)
     scale = scale if scale is not None else d ** -0.5
     perm = [(j, (j + 1) % n) for j in range(n)]
 
-    q_f = q.astype(jnp.float32).transpose(0, 2, 1, 3)      # [B,H,Sq,D]
+    # [B,S,H,D] → [B,Hk,G,Sq,D]: group axis next to its kv head so the
+    # dots batch over (B, Hk) and broadcast over G.
+    q_f = q.astype(jnp.float32).transpose(0, 2, 1, 3).reshape(
+        b, hk, g, s_loc, d)
 
     def step(carry, i):
         k_c, v_c, m, l, acc = carry
@@ -92,13 +110,13 @@ def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array,
         kv_idx = (my - i) % n
         s = jax.lax.dot_general(
             q_f, k_c.astype(jnp.float32).transpose(0, 2, 1, 3),
-            (((3,), (3,)), ((0, 1), (0, 1))),
-            preferred_element_type=jnp.float32) * scale     # [B,H,Sq,Sk]
+            (((4,), (3,)), ((0, 1), (0, 1))),
+            preferred_element_type=jnp.float32) * scale  # [B,Hk,G,Sq,Sk]
         if causal:
             rows = my * s_loc + jax.lax.broadcasted_iota(
-                jnp.int32, s.shape, 2)
-            cols = kv_idx * s_loc + jax.lax.broadcasted_iota(
                 jnp.int32, s.shape, 3)
+            cols = kv_idx * s_loc + jax.lax.broadcasted_iota(
+                jnp.int32, s.shape, 4)
             s = jnp.where(rows >= cols, s, NEG_INF)
         m_new = jnp.maximum(m, jnp.max(s, axis=-1))
         alpha = jnp.exp(m - m_new)
@@ -106,18 +124,18 @@ def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array,
         l_new = l * alpha + jnp.sum(p, axis=-1)
         acc_new = acc * alpha[..., None] + jax.lax.dot_general(
             p, v_c.astype(jnp.float32).transpose(0, 2, 1, 3),
-            (((3,), (2,)), ((0, 1), (0, 1))),
-            preferred_element_type=jnp.float32)             # [B,H,Sq,D]
+            (((4,), (2,)), ((0, 1), (0, 1))),
+            preferred_element_type=jnp.float32)          # [B,Hk,G,Sq,D]
         k_c, v_c = jax.lax.ppermute((k_c, v_c), axis_name, perm)
         return (k_c, v_c, m_new, l_new, acc_new), None
 
-    m0 = jnp.full((b, h, s_loc), NEG_INF, jnp.float32)
-    l0 = jnp.zeros((b, h, s_loc), jnp.float32)
-    acc0 = jnp.zeros((b, h, s_loc, d), jnp.float32)
+    m0 = jnp.full((b, hk, g, s_loc), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((b, hk, g, s_loc), jnp.float32)
+    acc0 = jnp.zeros((b, hk, g, s_loc, d), jnp.float32)
     (_, _, _, l, acc), _ = jax.lax.scan(
         step, (k, v, m0, l0, acc0), jnp.arange(n))
     out = acc / jnp.maximum(l, 1e-30)[..., None]
-    return out.transpose(0, 2, 1, 3).astype(q.dtype)
+    return out.reshape(b, h, s_loc, d).transpose(0, 2, 1, 3).astype(q.dtype)
 
 
 def ring_attention_sharded(mesh: Mesh, q: jax.Array, k: jax.Array,
