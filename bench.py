@@ -95,10 +95,16 @@ def main():
     # (and, through a remoted TPU, a ~100ms roundtrip) is paid once per K
     # steps, not per step — the TPU-idiomatic training loop shape.
     def one_step(state, rng):
+        # Fresh synthetic tokens each step (device-side randint, negligible
+        # cost): training on one fixed batch memorizes it within a few
+        # dozen steps and the reported loss degenerates to ~0.
+        step_tokens = jax.random.randint(rng, (batch, seq), 0,
+                                         cfg.vocab_size)
+
         def loss(p):
             with nn.logical_axis_rules(list(DEFAULT_RULES)):
                 return causal_lm_loss(
-                    model.apply({"params": p}, tokens), tokens)
+                    model.apply({"params": p}, step_tokens), step_tokens)
         l, grads = jax.value_and_grad(loss)(state.params)
         return state.apply_gradients(grads), l
 

@@ -162,13 +162,14 @@ def test_ring_attention_gqa_native(causal):
     np.testing.assert_allclose(out, ref, atol=2e-5, rtol=2e-5)
 
 
-def test_ulysses_gqa_through_the_swap():
+@pytest.mark.parametrize("causal", [True, False])
+def test_ulysses_gqa_through_the_swap(causal):
     """GQA survives the all-to-all head/seq swap: kv heads split across
     the sp axis like q heads, and the local flash call grouping stays
     consistent with the repeated-head oracle."""
     mesh = build_mesh(MeshSpec(dp=2, sp=4))
     q, k, v = _qkv(b=2, s=64, h=8, d=16, hk=4)
-    out = ulysses_attention_sharded(mesh, q, k, v, causal=True)
+    out = ulysses_attention_sharded(mesh, q, k, v, causal=causal)
     kr, vr = jnp.repeat(k, 2, axis=2), jnp.repeat(v, 2, axis=2)
-    ref = reference_attention(q, kr, vr, causal=True)
+    ref = reference_attention(q, kr, vr, causal=causal)
     np.testing.assert_allclose(out, ref, atol=2e-5, rtol=2e-5)
