@@ -1,0 +1,39 @@
+"""Gang "head" service — the Ray-head stand-in.
+
+Binds the rendezvous port the executor reserved for this task
+(``TASK_PORT``) and serves a one-line key-value protocol
+(``PUT k v`` / ``GET k``) until killed. The head jobtype is *untracked*
+(like the reference's parameter servers, ``TonyConfigurationKeys.java:252``):
+it runs for the life of the job and the coordinator kills it once every
+tracked worker has finished — exactly the ray-on-tony lifecycle
+(``tony-examples/ray-on-tony/README.md``).
+"""
+import os
+import socketserver
+
+store = {}
+
+
+class Handler(socketserver.StreamRequestHandler):
+    def handle(self):
+        for raw in self.rfile:
+            parts = raw.decode().strip().split(" ", 2)
+            if parts[0] == "PUT" and len(parts) == 3:
+                store[parts[1]] = parts[2]
+                self.wfile.write(b"OK\n")
+            elif parts[0] == "GET" and len(parts) == 2:
+                v = store.get(parts[1])
+                self.wfile.write(
+                    (f"VAL {v}\n" if v is not None else "NONE\n").encode())
+            else:
+                self.wfile.write(b"ERR\n")
+            self.wfile.flush()
+
+
+class Server(socketserver.ThreadingTCPServer):
+    allow_reuse_address = True
+
+
+port = int(os.environ["TASK_PORT"])
+print(f"head serving on :{port}", flush=True)
+Server(("", port), Handler).serve_forever()

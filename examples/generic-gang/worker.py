@@ -1,0 +1,53 @@
+"""Gang worker: discover the head from CLUSTER_SPEC and talk to it.
+
+The generic runtime exports only ``CLUSTER_SPEC`` (a JSON
+``{jobtype: ["host:port", ...]}`` map) plus the task identity — the same
+contract ray-on-tony's ``discovery.py:30-36`` parses out of TF_CONFIG.
+Each worker writes its own key to the head's store, then reads back every
+worker's key to prove the gang shares one service.
+
+Connections retry: between the gang barrier and the head process binding
+its port there is a window where the head's *reserved* port accepts the
+TCP handshake (the executor's reservation socket holds it) and then
+resets on release-before-exec — any real client of a gang service
+(Ray workers included) reconnects through that window.
+"""
+import json
+import os
+import socket
+import time
+
+spec = json.loads(os.environ["CLUSTER_SPEC"])
+host, _, port = spec["head"][0].rpartition(":")
+me = f'{os.environ["JOB_NAME"]}:{os.environ["TASK_INDEX"]}'
+n_workers = len(spec["worker"])
+DEADLINE = time.time() + 90
+
+
+def rpc(line):
+    """One connect-send-recv round trip, retried until the head is up."""
+    while True:
+        try:
+            with socket.create_connection((host, int(port)),
+                                          timeout=10) as s:
+                s.sendall((line + "\n").encode())
+                reply = s.makefile("rb").readline().decode().strip()
+                if reply:
+                    return reply
+        except OSError:
+            pass
+        if time.time() > DEADLINE:
+            raise SystemExit(f"head at {host}:{port} never answered {line!r}")
+        time.sleep(0.2)
+
+
+assert rpc(f"PUT {me} hello-from-{me}") == "OK"
+# Barrier-by-polling: wait until every worker's key is present.
+while True:
+    got = [rpc(f"GET worker:{i}") for i in range(n_workers)]
+    if all(g.startswith("VAL ") for g in got):
+        break
+    if time.time() > DEADLINE:
+        raise SystemExit(f"peers never appeared: {got}")
+    time.sleep(0.2)
+print(f"{me} saw {got}", flush=True)

@@ -70,6 +70,24 @@ def test_llama3_flagship_config_parses(tmp_path):
     assert jobs["worker"].instances == 4
 
 
+def test_generic_gang_example_submits_e2e(tmp_path):
+    """The ray-on-tony analogue: an untracked `head` service + 2 tracked
+    workers that discover it from CLUSTER_SPEC, rendezvous through its
+    key-value store, and exit 0 (reference
+    tony-examples/ray-on-tony/discovery.py:30-36)."""
+    r = subprocess.run(
+        [sys.executable, "-m", "tony_tpu.cli", "submit",
+         "--conf-file", "gang.json",
+         "--conf", f"tony.history.location={tmp_path / 'history'}",
+         "--conf", f"tony.head.command={sys.executable} head.py",
+         "--conf", f"tony.worker.command={sys.executable} worker.py",
+         "--workdir", str(tmp_path / "work")],
+        cwd=os.path.join(EXAMPLES, "generic-gang"), env=_env(tmp_path),
+        capture_output=True, text=True, timeout=300)
+    assert r.returncode == 0, f"stdout:{r.stdout}\nstderr:{r.stderr}"
+    assert "application finished: SUCCEEDED" in r.stdout
+
+
 def test_llama3_flagship_script_runs_tiny(tmp_path):
     """The flagship training script executes end-to-end at CI geometry
     (LLAMA_TINY): fsdp x tp mesh, selective remat, checkpoint manager —
