@@ -210,6 +210,52 @@ def test_ssh_provisioner_lease_bookkeeping(tmp_path):
     assert len(prov.acquire(2).hosts) == 2
 
 
+def test_e2e_gang_over_stub_ssh_hosts(tmp_path, monkeypatch):
+    """SshHostChannel end-to-end: a PATH-stubbed `ssh` executes each
+    "remote" command locally in its own session, so the real production
+    plumbing — StaticSshProvisioner leases, the remote command line
+    (mkdir/cd/pidfile/exports/exec/log redirection), exit-code mapping,
+    and per-host workdir layout — runs without TPU VMs. The stub stands in
+    for sshd only; everything above it is the code a real slice uses."""
+    bin_dir = tmp_path / "bin"
+    bin_dir.mkdir()
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    stub = bin_dir / "ssh"
+    stub.write_text(
+        "#!/bin/bash\n"
+        "# stub sshd: skip options, drop the target, run the remote\n"
+        "# command locally as a session leader (like a real ssh login).\n"
+        "args=()\n"
+        "while (($#)); do case $1 in\n"
+        "  -o) shift; shift || exit 97;;\n"   # value-taking option
+        "  -*) shift;;\n"
+        "  *) args+=(\"$1\"); shift;;\n"
+        "esac; done\n"
+        f"export PYTHONPATH={repo}\n"   # the VM has tony-tpu installed
+        'exec setsid bash -c "${args[@]:1}"\n')
+    os.chmod(str(stub), 0o755)
+    monkeypatch.setenv(
+        "PATH", str(bin_dir) + os.pathsep + os.environ["PATH"])
+
+    conf = make_conf(tmp_path, "check_env.py", workers=3)
+    conf.set(K.APPLICATION_BACKEND, "tpu-slice")
+    conf.set(K.SLICE_PROVISIONER, "ssh")
+    conf.set(K.SLICE_NUM_HOSTS, 2)
+    conf.set(K.SLICE_HOSTS, "tpu-vm-a,tpu-vm-b")
+    client, rec, code = submit(conf, tmp_path)
+    assert code == 0, _dump_task_logs(client)
+    assert rec.finished[0] == "SUCCEEDED"
+    # round-robin placement really went through both "VMs"
+    workroot = tmp_path / "work" / "jobs" / rec.app_id / "tasks"
+    hostdirs = sorted(d for d in os.listdir(str(workroot))
+                      if d.startswith("tpu-vm-"))
+    assert hostdirs == ["tpu-vm-a", "tpu-vm-b"]
+    # the pidfile the kill path relies on was written by EVERY task's
+    # remote command line
+    assert all((workroot / h / t / "task.pid").exists()
+               for h in hostdirs for t in os.listdir(str(workroot / h)))
+
+
 @pytest.mark.slow
 def test_e2e_distributed_training_over_slice_backend(tmp_path):
     """The full multi-host story in one flow: a gang placed over two fake

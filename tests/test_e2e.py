@@ -138,12 +138,14 @@ def _dump_task_logs(client):
     out = []
     tasks_dir = os.path.join(client.job_dir, "tasks")
     if os.path.isdir(tasks_dir):
-        for d in sorted(os.listdir(tasks_dir)):
+        # local backend: tasks/<task>/std{out,err}.log; slice backends add
+        # a host level: tasks/<host>/<task>/std{out,err}.log
+        for root, _dirs, files in sorted(os.walk(tasks_dir)):
             for f in ("stdout.log", "stderr.log"):
-                p = os.path.join(tasks_dir, d, f)
-                if os.path.exists(p):
-                    with open(p) as fh:
-                        out.append(f"--- {d}/{f} ---\n{fh.read()}")
+                if f in files:
+                    rel = os.path.relpath(os.path.join(root, f), tasks_dir)
+                    with open(os.path.join(root, f)) as fh:
+                        out.append(f"--- {rel} ---\n{fh.read()}")
     coord = os.path.join(client.job_dir, "coordinator.log")
     if os.path.exists(coord):
         with open(coord) as fh:
