@@ -72,6 +72,55 @@ def test_layering_and_overrides(tmp_path):
     assert jobs["worker"].command == "python train.py"
 
 
+def test_file_relative_paths_resolve_against_conf_file(tmp_path):
+    """src-dir/venv in a job config resolve against the config FILE's dir
+    (so `submit --conf-file examples/x/job.json` works from anywhere);
+    paths that don't exist there are left for CWD resolution, and CLI
+    overrides are never touched."""
+    jobdir = tmp_path / "myjob"
+    (jobdir / "src").mkdir(parents=True)
+    cfg_file = jobdir / "job.json"
+    cfg_file.write_text(json.dumps({
+        "tony.application.src-dir": "src",
+        "tony.application.python-venv": "venv-not-there.zip",
+    }))
+    conf = TonyTpuConfig.from_layers(config_file=str(cfg_file))
+    assert conf.get("tony.application.src-dir") == str(jobdir / "src")
+    # not present next to the file → untouched (CWD semantics preserved)
+    assert conf.get("tony.application.python-venv") == "venv-not-there.zip"
+    # an override (CLI-typed) keeps its literal value even if resolvable
+    conf2 = TonyTpuConfig.from_layers(
+        config_file=str(cfg_file),
+        overrides=["tony.application.src-dir=src"])
+    assert conf2.get("tony.application.src-dir") == "src"
+    # a FILE named like the src-dir must not hijack resolution (kind check)
+    (jobdir / "srcfile").write_text("not a dir")
+    cfg_file.write_text(json.dumps(
+        {"tony.application.src-dir": "srcfile"}))
+    conf3 = TonyTpuConfig.from_layers(config_file=str(cfg_file))
+    assert conf3.get("tony.application.src-dir") == "srcfile"
+
+
+def test_file_relative_resources_resolve_with_annotations(tmp_path):
+    """Resource specs in a job config resolve their SOURCE against the
+    config file's dir while keeping ::NAME and #archive annotations."""
+    jobdir = tmp_path / "job"
+    jobdir.mkdir()
+    (jobdir / "data.csv").write_text("1,2\n")
+    (jobdir / "extra.zip").write_text("zz")
+    cfg_file = jobdir / "job.json"
+    cfg_file.write_text(json.dumps({
+        "tony.application.resources":
+            "data.csv::renamed.csv,extra.zip#archive,missing.bin",
+    }))
+    conf = TonyTpuConfig.from_layers(config_file=str(cfg_file))
+    assert conf.get_list("tony.application.resources") == [
+        f"{jobdir / 'data.csv'}::renamed.csv",
+        f"{jobdir / 'extra.zip'}#archive",
+        "missing.bin",                    # untouched: not under the file
+    ]
+
+
 def test_site_file_is_last_layer(tmp_path, monkeypatch):
     site = tmp_path / "site"
     site.mkdir()

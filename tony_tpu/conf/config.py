@@ -101,6 +101,8 @@ class TonyTpuConfig:
         conf = cls()
         if config_file:
             conf.merge(_load_file(config_file))
+            conf._resolve_file_relative_paths(os.path.dirname(
+                os.path.abspath(config_file)))
         for kv in overrides:
             if "=" not in kv:
                 raise ConfigError(f"override must be key=value, got {kv!r}")
@@ -118,6 +120,48 @@ class TonyTpuConfig:
     def merge(self, other: Mapping[str, Any]) -> None:
         for k, v in other.items():
             self.set(k, v)
+
+    def _resolve_file_relative_paths(self, base_dir: str) -> None:
+        """Relative paths in a job config resolve against the config
+        file's directory, not the caller's CWD — so
+        ``submit --conf-file examples/mnist-jax/mnist.json`` works from
+        anywhere (the examples all say ``src-dir: "."``). Only applied to
+        values that exist under the file's dir with the right kind
+        (src-dir: directory, venv: file); anything else is left for CWD
+        resolution (the CLI-flag behavior)."""
+        def resolve(v: str, want) -> str:
+            if not v or os.path.isabs(v):
+                return v
+            cand = os.path.normpath(os.path.join(base_dir, v))
+            return cand if want(cand) else v
+
+        for key, want in ((K.SRC_DIR, os.path.isdir),
+                          (K.PYTHON_VENV, os.path.isfile)):
+            v = str(self.get(key, "") or "")
+            resolved = resolve(v, want)
+            if resolved != v:
+                self.set(key, resolved)
+        # Container resources share the same file-relative intent; their
+        # SRC[::NAME][#archive] annotations must survive the rewrite.
+        specs = self.get_list(K.CONTAINER_RESOURCES)
+        if specs:
+            from tony_tpu.utils.localize import LocalizableResource
+
+            import dataclasses as _dc
+
+            out = []
+            for spec in specs:
+                try:
+                    r = LocalizableResource.parse(spec)
+                except ValueError:
+                    out.append(spec)     # staging reports the bad spec
+                    continue
+                r = _dc.replace(r, source=resolve(r.source, os.path.exists))
+                out.append(r.unparse())
+            if out != specs:
+                self.unset(K.CONTAINER_RESOURCES)
+                for spec in out:
+                    self.set(K.CONTAINER_RESOURCES, spec)
 
     # -- access -----------------------------------------------------------
     def set(self, name: str, value: Any) -> None:

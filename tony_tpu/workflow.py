@@ -58,7 +58,14 @@ def build_job(props: Dict[str, str], workdir: str,
     }
     for prop, key in mapped.items():
         if props.get(prop):
-            conf.set(key, props[prop])
+            v = props[prop]
+            if prop in (PROP_SRC_DIR, PROP_PYTHON_VENV) and \
+                    not os.path.isabs(v) and os.path.exists(v):
+                # Path props mean "relative to the scheduler's CWD" — pin
+                # them before the conf file (written to workdir) would
+                # re-anchor them to workdir at submit time.
+                v = os.path.abspath(v)
+            conf.set(key, v)
     if not conf.get(K.APPLICATION_NAME) or \
             conf.get(K.APPLICATION_NAME) == "tony-tpu":
         conf.set(K.APPLICATION_NAME, job_name)
