@@ -29,10 +29,23 @@ Design:
   backward pass without writing one. Per-layer ``jax.checkpoint`` keeps
   residency at O(activations · microbatch), not O(· full batch).
 
-1F1B would shave peak activation memory a further ~2× at equal bubble; GPipe
-was chosen because its loop body is a single uniform SPMD program (same code
-on every stage every tick — no per-stage control flow, which XLA can't
-diverge on anyway).
+Why GPipe and not 1F1B — quantified, because the tradeoff is different on
+TPU than in the papers:
+
+- **The memory argument mostly disappears under remat.** 1F1B's benefit is
+  capping in-flight microbatch stashes at S (stages) instead of M. With
+  per-layer ``jax.checkpoint`` the stash per microbatch is only the stage
+  boundary activation (``mb·seq·dim``), so the delta is
+  ``(M−S)·mb·seq·dim·2 B`` — for the 8B flagship shape (mb=1, seq 8192,
+  dim 4096, M=8, S=4) that is ~256 MB of 95 GB v5p HBM (<0.3%).
+- **True 1F1B breaks SPMD uniformity where it counts.** Backward for
+  microbatch t must start while t+1 is still in forward, which needs the
+  last stage's lm_head+loss *inside* the tick loop. In a uniform SPMD
+  program every stage would execute the head every tick (≈ +S× the head's
+  ~10% FLOP share — +30% total at S=4); per-stage divergent programs are
+  not expressible under one jit. GPipe's loop body is the same code on
+  every stage every tick, and autodiff derives the mirror-image backward
+  schedule from the ``ppermute`` transpose for free.
 """
 
 from __future__ import annotations
