@@ -82,6 +82,72 @@ def test_flash_kv_head_mismatch_error():
 
 
 @pytest.mark.parametrize("causal", [True, False])
+def test_flash_lse_matches_logsumexp_oracle(causal):
+    """flash_attention_with_lse: lse equals the row logsumexp of the
+    scaled masked scores, and the (o, lse) pair merges two disjoint key
+    sets back to full attention — the ring-hop contract."""
+    from tony_tpu.ops.attention import flash_attention_with_lse
+
+    q, k, v = _qkv(b=2, s=64, h=2, d=16)
+    o, lse = flash_attention_with_lse(q, k, v, causal=causal,
+                                      block_q=32, block_k=32)
+    scale = q.shape[-1] ** -0.5
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k,
+                   precision=jax.lax.Precision.HIGHEST) * scale
+    if causal:
+        mask = jnp.tril(jnp.ones((64, 64), bool))
+        s = jnp.where(mask, s, -1e30)
+    lse_ref = jax.nn.logsumexp(s, axis=-1).transpose(0, 2, 1)  # [B,S,H]
+    np.testing.assert_allclose(lse, lse_ref, atol=2e-5, rtol=2e-5)
+    np.testing.assert_allclose(o, reference_attention(q, k, v, causal=causal),
+                               atol=2e-5, rtol=2e-5)
+    if not causal:
+        # Split keys in half, attend separately, merge by the documented
+        # logsumexp rule — must reproduce full attention exactly.
+        o1, l1 = flash_attention_with_lse(q, k[:, :32], v[:, :32],
+                                          causal=False, block_q=32,
+                                          block_k=32)
+        o2, l2 = flash_attention_with_lse(q, k[:, 32:], v[:, 32:],
+                                          causal=False, block_q=32,
+                                          block_k=32)
+        lm = jnp.logaddexp(l1, l2)
+        om = (o1 * jnp.exp(l1 - lm)[..., None]
+              + o2 * jnp.exp(l2 - lm)[..., None])
+        np.testing.assert_allclose(om, o, atol=2e-5, rtol=2e-5)
+
+
+def test_flash_lse_gradient_flows_through_lse():
+    """The lse output is differentiable: a loss that consumes BOTH o and
+    lse (like the ring merge does) matches autodiff of the XLA oracle."""
+    from tony_tpu.ops.attention import flash_attention_with_lse
+
+    q, k, v = _qkv(b=1, s=32, h=2, d=16)
+    scale = q.shape[-1] ** -0.5
+
+    def loss_flash(q, k, v):
+        o, lse = flash_attention_with_lse(q, k, v, causal=True,
+                                          block_q=16, block_k=16)
+        return jnp.sum(o ** 2) + jnp.sum(jnp.sin(lse))
+
+    def loss_ref(q, k, v):
+        s = jnp.einsum("bqhd,bkhd->bhqk", q, k,
+                       precision=jax.lax.Precision.HIGHEST) * scale
+        mask = jnp.tril(jnp.ones((32, 32), bool))
+        s = jnp.where(mask, s, -1e30)
+        p = jax.nn.softmax(s, axis=-1)
+        o = jnp.einsum("bhqk,bkhd->bqhd", p, v,
+                       precision=jax.lax.Precision.HIGHEST)
+        lse = jax.nn.logsumexp(s, axis=-1).transpose(0, 2, 1)
+        return jnp.sum(o ** 2) + jnp.sum(jnp.sin(lse))
+
+    g = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    gr = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b_, name in zip(g, gr, "qkv"):
+        np.testing.assert_allclose(a, b_, atol=1e-4, rtol=1e-4,
+                                   err_msg=f"d{name}")
+
+
+@pytest.mark.parametrize("causal", [True, False])
 def test_ring_attention_matches_reference(causal):
     mesh = build_mesh(MeshSpec(dp=2, sp=4))
     q, k, v = _qkv(b=4, s=64, h=2, d=16)

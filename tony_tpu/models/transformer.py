@@ -156,7 +156,9 @@ class Attention(nn.Module):
                                     jnp.repeat(v, g, axis=2), causal=True)
         elif cfg.attn_impl == "ring":
             # GQA-native: K/V ride the ring at kv-head width (no repeat).
-            o = ring_attention(q, k, v, axis_name="sp", causal=True)
+            o = ring_attention(q, k, v, axis_name="sp", causal=True,
+                               block_q=cfg.attn_block_q,
+                               block_k=cfg.attn_block_k)
         elif cfg.attn_impl == "ulysses":
             o = ulysses_attention(q, k, v, axis_name="sp", causal=True)
         else:

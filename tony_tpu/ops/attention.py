@@ -417,7 +417,8 @@ def _fwd_impl(q, k, v, scale, causal, block_q, block_k):
     return o, lse
 
 
-def _bwd_impl(q, k, v, o, lse, do, scale, causal, block_q, block_k):
+def _bwd_impl(q, k, v, o, lse, do, scale, causal, block_q, block_k,
+              dlse=None):
     b, h, sq, d = q.shape
     hk = k.shape[1]
     g = h // hk
@@ -427,9 +428,15 @@ def _bwd_impl(q, k, v, o, lse, do, scale, causal, block_q, block_k):
     nq = pl.cdiv(sq, block_q)
     nk = pl.cdiv(sk, block_k)
     from jax.experimental.pallas import tpu as pltpu
+    delta_rows = jnp.sum(o.astype(jnp.float32) * do.astype(jnp.float32),
+                         axis=-1)                    # [B, H, S]
+    if dlse is not None:
+        # lse cotangent (flash_attention_with_lse): ∂lse_i/∂s_ij = p_ij, so
+        # the extra term folds into the existing ds = p·(dp − delta) as
+        # ds = p·(dp − (delta − dlse)) — one subtract, zero kernel changes.
+        delta_rows = delta_rows - dlse.astype(jnp.float32)
     delta = jnp.broadcast_to(
-        jnp.sum(o.astype(jnp.float32) * do.astype(jnp.float32),
-                axis=-1)[:, :, None, :],
+        delta_rows[:, :, None, :],
         (b, h, STAT_SUB, sq))                        # sublane-bcast like lse
 
     def kv_j(i, j):
@@ -535,6 +542,70 @@ def _flash_bwd(scale, causal, block_q, block_k, res, do):
 _flash.defvjp(_flash_fwd, _flash_bwd)
 
 
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def _flash_lse(q, k, v, scale, causal, block_q, block_k):
+    o, lse = _fwd_impl(q, k, v, scale, causal, block_q, block_k)
+    return o, lse[:, :, 0, :]
+
+
+def _flash_lse_fwd(q, k, v, scale, causal, block_q, block_k):
+    o, lse = _fwd_impl(q, k, v, scale, causal, block_q, block_k)
+    return (o, lse[:, :, 0, :]), (q, k, v, o, lse)
+
+
+def _flash_lse_bwd(scale, causal, block_q, block_k, res, cts):
+    do, dlse = cts
+    q, k, v, o, lse = res
+    return _bwd_impl(q, k, v, o, lse, do, scale, causal, block_q, block_k,
+                     dlse=dlse)
+
+
+_flash_lse.defvjp(_flash_lse_fwd, _flash_lse_bwd)
+
+
+def _check_and_transpose(q, k, v, causal, scale):
+    """Shared wrapper plumbing for the public entry points: validate the
+    [B,S,H,D] shapes, default the scale, hand back [B,H,S,D] kernel
+    views."""
+    sq, h = q.shape[1], q.shape[2]
+    hk = k.shape[2]
+    if causal and sq != k.shape[1]:
+        raise ValueError(
+            f"causal flash attention requires seq_q == seq_k, got {sq} vs "
+            f"{k.shape[1]} (the kernel's mask is top-left aligned; for "
+            f"decode-style offsets use ring attention or causal=False with "
+            f"an explicit mask)")
+    if k.shape[2] != v.shape[2]:
+        raise ValueError(f"k heads ({k.shape[2]}) != v heads "
+                         f"({v.shape[2]})")
+    if h % hk:
+        raise ValueError(f"q heads {h} not a multiple of kv heads {hk}")
+    scale = scale if scale is not None else q.shape[-1] ** -0.5
+    return (q.transpose(0, 2, 1, 3), k.transpose(0, 2, 1, 3),
+            v.transpose(0, 2, 1, 3), scale)
+
+
+def flash_attention_with_lse(q: jax.Array, k: jax.Array, v: jax.Array,
+                             causal: bool = True,
+                             scale: Optional[float] = None,
+                             block_q: int = 1024, block_k: int = 1024):
+    """Flash attention returning ``(o [B,S,H,D], lse [B,S,H] f32)``.
+
+    ``lse`` is the per-row logsumexp of the (scaled, masked) scores — the
+    online-softmax merge statistic. Two partial results over disjoint key
+    sets combine exactly as::
+
+        lse = logaddexp(lse_a, lse_b)
+        o   = o_a·exp(lse_a − lse) + o_b·exp(lse_b − lse)
+
+    which is what ring attention does across ``sp`` hops (``ops/ring.py``).
+    Both outputs are differentiable (the lse cotangent rides the existing
+    backward's delta statistic)."""
+    qh, kh, vh, scale = _check_and_transpose(q, k, v, causal, scale)
+    oh, lse = _flash_lse(qh, kh, vh, scale, causal, block_q, block_k)
+    return oh.transpose(0, 2, 1, 3), lse.transpose(0, 2, 1)
+
+
 def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
                     causal: bool = True, scale: Optional[float] = None,
                     block_q: int = 1024, block_k: int = 1024) -> jax.Array:
@@ -553,23 +624,6 @@ def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     Blocks clamp to the actual (rounded-up) sequence, so short-seq/test
     calls are unaffected.
     """
-    b, sq, h, d = q.shape
-    hk = k.shape[2]
-    if causal and sq != k.shape[1]:
-        raise ValueError(
-            f"causal flash attention requires seq_q == seq_k, got {sq} vs "
-            f"{k.shape[1]} (the kernel's mask is top-left aligned; for "
-            f"decode-style offsets use ring attention or causal=False with "
-            f"an explicit mask)")
-    if k.shape[2] != v.shape[2]:
-        raise ValueError(f"k heads ({k.shape[2]}) != v heads "
-                         f"({v.shape[2]})")
-    if h % hk:
-        raise ValueError(f"q heads {h} not a multiple of kv heads {hk}")
-    scale = scale if scale is not None else d ** -0.5
-    # [B,S,H,D] → [B, H, S, D] views for the kernels
-    qh = q.transpose(0, 2, 1, 3)
-    kh = k.transpose(0, 2, 1, 3)
-    vh = v.transpose(0, 2, 1, 3)
+    qh, kh, vh, scale = _check_and_transpose(q, k, v, causal, scale)
     oh = _flash(qh, kh, vh, scale, causal, block_q, block_k)
     return oh.transpose(0, 2, 1, 3)

@@ -6,7 +6,27 @@ of Q/K/V. K/V chunks rotate around the ``sp`` ring via ``ppermute`` (nearest-
 neighbour ICI traffic only) while each device accumulates its Q shard's
 online-softmax state — after n steps every Q block has seen every K/V block
 and the K/V shards are back home. Compute at step i overlaps the transfer for
-step i+1 (XLA schedules the ppermute DMA asynchronously with the einsums).
+step i+1 (XLA schedules the ppermute DMA asynchronously with the compute).
+
+TPU-first structure (the RingAttention-paper blockwise design, built on our
+own kernel):
+
+- **Each hop runs the Pallas flash kernel** on (local Q, visiting K/V chunk)
+  and yields a normalized partial ``(o, lse)``; hops merge by the exact
+  logsumexp rule (``flash_attention_with_lse``). Per-hop memory is
+  O(S_local·D) — no [S_local, S_local] score chunk ever exists in HBM, so
+  per-device context is bounded by flash's streaming VMEM footprint, not by
+  a materialized score matrix.
+- **Causally dead hops are skipped, not masked.** Under causal attention the
+  visiting chunk is strictly-future for half the hops on average; a
+  ``lax.switch`` dispatches diagonal hops to causal flash, past chunks to
+  non-causal flash, and future chunks to a free zero/−inf partial (XLA
+  conditionals execute one branch — unlike inside a Pallas kernel). The old
+  einsum formulation computed every dead chunk and masked it to −inf.
+- **GQA is native end-to-end**: K/V rotate at their H_kv width (the per-hop
+  ppermute payload — ring attention's bandwidth bottleneck at long context —
+  is H/H_kv× smaller than with repeated heads), and the flash BlockSpecs
+  index kv-heads directly, so repeated heads never materialize anywhere.
 
 `ring_attention` is the *per-shard* function, for use inside `shard_map`
 (this is how model code composes it with other sharded ops);
@@ -22,6 +42,8 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 from jax import shard_map
+
+from tony_tpu.ops.attention import flash_attention_with_lse
 
 NEG_INF = -1e30
 
@@ -70,14 +92,11 @@ def bound_axis_size(axis_name: str):
 
 def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array,
                    axis_name: str = "sp", causal: bool = True,
-                   scale: Optional[float] = None) -> jax.Array:
+                   scale: Optional[float] = None,
+                   block_q: int = 1024, block_k: int = 1024) -> jax.Array:
     """Per-shard ring attention ([B, S_local, H, D] in/out; GQA: K/V may
     carry H_kv heads with H_kv | H). Call inside shard_map with the
-    sequence dim sharded over ``axis_name``.
-
-    GQA is native: K/V rotate around the ring at their H_kv width, so the
-    per-hop ppermute payload — ring attention's bandwidth bottleneck at
-    long context — is H/H_kv× smaller than with repeated heads."""
+    sequence dim sharded over ``axis_name``."""
     b, s_loc, h, d = q.shape
     hk = k.shape[2]
     if k.shape[2] != v.shape[2]:
@@ -98,54 +117,58 @@ def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     my = jax.lax.axis_index(axis_name)
     scale = scale if scale is not None else d ** -0.5
     perm = [(j, (j + 1) % n) for j in range(n)]
+    flash = functools.partial(flash_attention_with_lse, scale=scale,
+                              block_q=block_q, block_k=block_k)
 
-    # [B,S,H,D] → [B,Hk,G,Sq,D]: group axis next to its kv head so the
-    # dots batch over (B, Hk) and broadcast over G.
-    q_f = q.astype(jnp.float32).transpose(0, 2, 1, 3).reshape(
-        b, hk, g, s_loc, d)
+    def hop_full(args):
+        k_c, v_c = args
+        o_c, lse_c = flash(q, k_c, v_c, causal=False)
+        return o_c.astype(jnp.float32), lse_c
+
+    def hop_diag(args):
+        k_c, v_c = args
+        o_c, lse_c = flash(q, k_c, v_c, causal=True)
+        return o_c.astype(jnp.float32), lse_c
+
+    def hop_skip(args):
+        return (jnp.zeros((b, s_loc, h, d), jnp.float32),
+                jnp.full((b, s_loc, h), NEG_INF, jnp.float32))
 
     def step(carry, i):
-        k_c, v_c, m, l, acc = carry
+        k_c, v_c, lse_acc, o_acc = carry
         # After i forward rotations we hold the chunk originally on (my - i).
         kv_idx = (my - i) % n
-        s = jax.lax.dot_general(
-            q_f, k_c.astype(jnp.float32).transpose(0, 2, 1, 3),
-            (((4,), (3,)), ((0, 1), (0, 1))),
-            preferred_element_type=jnp.float32) * scale  # [B,Hk,G,Sq,Sk]
         if causal:
-            rows = my * s_loc + jax.lax.broadcasted_iota(
-                jnp.int32, s.shape, 3)
-            cols = kv_idx * s_loc + jax.lax.broadcasted_iota(
-                jnp.int32, s.shape, 4)
-            s = jnp.where(rows >= cols, s, NEG_INF)
-        m_new = jnp.maximum(m, jnp.max(s, axis=-1))
-        alpha = jnp.exp(m - m_new)
-        p = jnp.exp(s - m_new[..., None])
-        l_new = l * alpha + jnp.sum(p, axis=-1)
-        acc_new = acc * alpha[..., None] + jax.lax.dot_general(
-            p, v_c.astype(jnp.float32).transpose(0, 2, 1, 3),
-            (((4,), (2,)), ((0, 1), (0, 1))),
-            preferred_element_type=jnp.float32)          # [B,Hk,G,Sq,D]
+            case = jnp.where(kv_idx == my, 2,
+                             jnp.where(kv_idx < my, 1, 0))
+            o_c, lse_c = jax.lax.switch(
+                case, [hop_skip, hop_full, hop_diag], (k_c, v_c))
+        else:
+            o_c, lse_c = hop_full((k_c, v_c))
+        lse_new = jnp.logaddexp(lse_acc, lse_c)
+        o_acc = (o_acc * jnp.exp(lse_acc - lse_new)[..., None]
+                 + o_c * jnp.exp(lse_c - lse_new)[..., None])
         k_c, v_c = jax.lax.ppermute((k_c, v_c), axis_name, perm)
-        return (k_c, v_c, m_new, l_new, acc_new), None
+        return (k_c, v_c, lse_new, o_acc), None
 
-    m0 = jnp.full((b, hk, g, s_loc), NEG_INF, jnp.float32)
-    l0 = jnp.zeros((b, hk, g, s_loc), jnp.float32)
-    acc0 = jnp.zeros((b, hk, g, s_loc, d), jnp.float32)
-    (_, _, _, l, acc), _ = jax.lax.scan(
-        step, (k, v, m0, l0, acc0), jnp.arange(n))
-    out = acc / jnp.maximum(l, 1e-30)[..., None]
-    return out.reshape(b, h, s_loc, d).transpose(0, 2, 1, 3).astype(q.dtype)
+    lse0 = jnp.full((b, s_loc, h), NEG_INF, jnp.float32)
+    o0 = jnp.zeros((b, s_loc, h, d), jnp.float32)
+    (_, _, _, o_acc), _ = jax.lax.scan(
+        step, (k, v, lse0, o0), jnp.arange(n))
+    return o_acc.astype(q.dtype)
 
 
 def ring_attention_sharded(mesh: Mesh, q: jax.Array, k: jax.Array,
                            v: jax.Array, causal: bool = True,
                            scale: Optional[float] = None,
-                           axis_name: str = "sp") -> jax.Array:
+                           axis_name: str = "sp",
+                           block_q: int = 1024,
+                           block_k: int = 1024) -> jax.Array:
     """Global-array wrapper: [B, S, H, D] with S sharded over ``axis_name``,
     batch over (dp, fsdp), heads replicated along sp."""
     spec = P(("dcn_dp", "dp", "fsdp"), axis_name, None, None)
     fn = functools.partial(ring_attention, axis_name=axis_name,
-                           causal=causal, scale=scale)
+                           causal=causal, scale=scale,
+                           block_q=block_q, block_k=block_k)
     return shard_map(fn, mesh=mesh, in_specs=(spec, spec, spec),
                      out_specs=spec, check_vma=False)(q, k, v)
