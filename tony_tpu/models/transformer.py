@@ -160,7 +160,9 @@ class Attention(nn.Module):
                                block_q=cfg.attn_block_q,
                                block_k=cfg.attn_block_k)
         elif cfg.attn_impl == "ulysses":
-            o = ulysses_attention(q, k, v, axis_name="sp", causal=True)
+            o = ulysses_attention(q, k, v, axis_name="sp", causal=True,
+                                  block_q=cfg.attn_block_q,
+                                  block_k=cfg.attn_block_k)
         else:
             raise ValueError(f"unknown attn_impl {cfg.attn_impl!r}")
         o = nn.with_logical_constraint(o, ("batch", "seq", "heads", "kv"))

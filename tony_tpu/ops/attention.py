@@ -45,6 +45,10 @@ NEG_INF = -1e30
 # Stats (lse/delta) sublane broadcast factor: min f32 tile is (8, 128), so
 # a per-row float is stored as 8 identical sublanes over lanes=seq.
 STAT_SUB = 8
+# Default flash tile size, from the v5e sweeps documented on
+# flash_attention: shared by every public attention entry point (flash,
+# flash_with_lse, ring, ulysses) so a re-sweep updates one constant.
+DEFAULT_BLOCK = 1024
 
 
 def _prec(x):
@@ -588,7 +592,8 @@ def _check_and_transpose(q, k, v, causal, scale):
 def flash_attention_with_lse(q: jax.Array, k: jax.Array, v: jax.Array,
                              causal: bool = True,
                              scale: Optional[float] = None,
-                             block_q: int = 1024, block_k: int = 1024):
+                             block_q: int = DEFAULT_BLOCK,
+                             block_k: int = DEFAULT_BLOCK):
     """Flash attention returning ``(o [B,S,H,D], lse [B,S,H] f32)``.
 
     ``lse`` is the per-row logsumexp of the (scaled, masked) scores — the
@@ -608,7 +613,8 @@ def flash_attention_with_lse(q: jax.Array, k: jax.Array, v: jax.Array,
 
 def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
                     causal: bool = True, scale: Optional[float] = None,
-                    block_q: int = 1024, block_k: int = 1024) -> jax.Array:
+                    block_q: int = DEFAULT_BLOCK,
+                    block_k: int = DEFAULT_BLOCK) -> jax.Array:
     """Flash attention, layout ``[B, S, H, D]`` (GQA: H_kv may divide H).
 
     Differentiable (custom flash backward); accumulation in f32 regardless

@@ -43,7 +43,7 @@ import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 from jax import shard_map
 
-from tony_tpu.ops.attention import flash_attention_with_lse
+from tony_tpu.ops.attention import DEFAULT_BLOCK, flash_attention_with_lse
 
 NEG_INF = -1e30
 
@@ -93,7 +93,8 @@ def bound_axis_size(axis_name: str):
 def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array,
                    axis_name: str = "sp", causal: bool = True,
                    scale: Optional[float] = None,
-                   block_q: int = 1024, block_k: int = 1024) -> jax.Array:
+                   block_q: int = DEFAULT_BLOCK,
+                   block_k: int = DEFAULT_BLOCK) -> jax.Array:
     """Per-shard ring attention ([B, S_local, H, D] in/out; GQA: K/V may
     carry H_kv heads with H_kv | H). Call inside shard_map with the
     sequence dim sharded over ``axis_name``."""
@@ -162,8 +163,8 @@ def ring_attention_sharded(mesh: Mesh, q: jax.Array, k: jax.Array,
                            v: jax.Array, causal: bool = True,
                            scale: Optional[float] = None,
                            axis_name: str = "sp",
-                           block_q: int = 1024,
-                           block_k: int = 1024) -> jax.Array:
+                           block_q: int = DEFAULT_BLOCK,
+                           block_k: int = DEFAULT_BLOCK) -> jax.Array:
     """Global-array wrapper: [B, S, H, D] with S sharded over ``axis_name``,
     batch over (dp, fsdp), heads replicated along sp."""
     spec = P(("dcn_dp", "dp", "fsdp"), axis_name, None, None)

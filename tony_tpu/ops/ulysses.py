@@ -18,13 +18,14 @@ import jax
 from jax.sharding import Mesh, PartitionSpec as P
 from jax import shard_map
 
-from tony_tpu.ops.attention import flash_attention
+from tony_tpu.ops.attention import DEFAULT_BLOCK, flash_attention
 
 
 def ulysses_attention(q: jax.Array, k: jax.Array, v: jax.Array,
                       axis_name: str = "sp", causal: bool = True,
                       scale: Optional[float] = None,
-                      block_q: int = 128, block_k: int = 128) -> jax.Array:
+                      block_q: int = DEFAULT_BLOCK,
+                      block_k: int = DEFAULT_BLOCK) -> jax.Array:
     """Per-shard Ulysses attention ([B, S_local, H, D] in/out), for use
     inside shard_map. Requires both q and k/v head counts divisible by the
     axis size."""
@@ -54,7 +55,9 @@ def ulysses_attention(q: jax.Array, k: jax.Array, v: jax.Array,
 def ulysses_attention_sharded(mesh: Mesh, q: jax.Array, k: jax.Array,
                               v: jax.Array, causal: bool = True,
                               scale: Optional[float] = None,
-                              axis_name: str = "sp") -> jax.Array:
+                              axis_name: str = "sp",
+                              block_q: int = DEFAULT_BLOCK,
+                              block_k: int = DEFAULT_BLOCK) -> jax.Array:
     """Global-array wrapper: [B, S, H, D] with S sharded over ``axis_name``."""
     n = mesh.shape[axis_name]
     if q.shape[2] % n or k.shape[2] % n:
@@ -64,6 +67,7 @@ def ulysses_attention_sharded(mesh: Mesh, q: jax.Array, k: jax.Array,
                          f"attention instead")
     spec = P(("dcn_dp", "dp", "fsdp"), axis_name, None, None)
     fn = functools.partial(ulysses_attention, axis_name=axis_name,
-                           causal=causal, scale=scale)
+                           causal=causal, scale=scale,
+                           block_q=block_q, block_k=block_k)
     return shard_map(fn, mesh=mesh, in_specs=(spec, spec, spec),
                      out_specs=spec, check_vma=False)(q, k, v)
