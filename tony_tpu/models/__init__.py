@@ -9,7 +9,7 @@ can shard them onto any mesh, bf16 compute, flash/ring attention from
 """
 
 from tony_tpu.models.transformer import (  # noqa: F401
-    Transformer, TransformerConfig,
+    Transformer, TransformerConfig, causal_lm_loss, chunked_causal_lm_loss,
 )
 from tony_tpu.models.mlp import MnistMLP  # noqa: F401
 from tony_tpu.models.resnet import ResNet, ResNetConfig  # noqa: F401

@@ -202,7 +202,12 @@ class Transformer(nn.Module):
     cfg: TransformerConfig
 
     @nn.compact
-    def __call__(self, tokens, positions=None):
+    def __call__(self, tokens, positions=None, return_hidden=False):
+        """``return_hidden=True`` skips the LM head and returns the
+        final-norm hidden states [B, S, D] — pair with
+        ``chunked_causal_lm_loss`` for long context, where the full
+        [B, S, vocab] logits tensor (4 GB f32 at 32k×32000) is the
+        memory wall, not the attention."""
         cfg = self.cfg
         global_seq = tokens.shape[1]
         if cfg.attn_impl in ("ring", "ulysses"):
@@ -247,6 +252,8 @@ class Transformer(nn.Module):
         for i in range(cfg.n_layers):
             x = block(cfg, name=f"layer_{i}")(x, positions)
         x = RMSNorm(cfg.norm_eps, cfg.param_dtype, name="final_norm")(x)
+        if return_hidden:
+            return x
         head_dtype = cfg.lm_head_dtype or cfg.dtype
         if cfg.tie_embeddings:
             logits = jnp.einsum("bsd,vd->bsv", x.astype(head_dtype),
@@ -279,3 +286,79 @@ def causal_lm_loss(logits: jax.Array, tokens: jax.Array,
         m = mask[:, 1:].astype(jnp.float32)
         return jnp.sum(nll * m) / jnp.maximum(jnp.sum(m), 1.0)
     return jnp.mean(nll)
+
+
+def chunked_causal_lm_loss(hidden: jax.Array, head_kernel: jax.Array,
+                           tokens: jax.Array, chunk_size: int = 4096,
+                           mask: Optional[jax.Array] = None,
+                           head_dtype: Optional[jnp.dtype] = None
+                           ) -> jax.Array:
+    """Next-token cross entropy without ever materializing [B, S, vocab].
+
+    The long-context memory wall is not attention (flash streams it) but
+    the logits: at 32k×32000 vocab the f32 logits plus their cotangent are
+    ~8 GB — more than the whole remat'd model. This computes the loss a
+    sequence chunk at a time: ``hidden`` [B, S, D] (from
+    ``Transformer(..., return_hidden=True)``) is scanned in [B, C, D]
+    chunks, each projected through ``head_kernel`` [D, V], reduced to
+    (Σnll, count), and rematerialized in backward (``jax.checkpoint``), so
+    peak residency is O(B·C·V) — chunk_size trades HBM for recompute.
+
+    Exactly equals ``causal_lm_loss(model(tokens), tokens)`` for the
+    untied head (same logsumexp−picked formulation; the matmul runs in
+    ``head_dtype`` — pass ``cfg.lm_head_dtype`` if you set it; default =
+    the activation dtype, matching ``nn.Dense(dtype=...)``). For
+    ``tie_embeddings=True`` pass ``emb.T`` as the kernel; note the tied
+    full path additionally accumulates in f32
+    (``preferred_element_type``), so equality there is to bf16-matmul
+    tolerance, not bitwise.
+
+    Not sequence-parallel: under an ``sp`` shard_map the per-shard
+    sequence shift would misalign targets at shard boundaries, so this
+    raises — compute hidden states inside the shard_map, gather, and take
+    the loss outside (or keep the loss on the full-logits path).
+    """
+    from tony_tpu.ops.ring import bound_axis_size
+
+    if bound_axis_size("sp") is not None:
+        raise ValueError(
+            "chunked_causal_lm_loss inside an sp shard_map would shift "
+            "targets per-shard (wrong at every shard boundary) and skip "
+            "the cross-shard mean; compute it outside the shard_map")
+    x = hidden[:, :-1]
+    t = tokens[:, 1:]
+    b, s, d = x.shape
+    if s == 0:
+        return jnp.float32(0.0)     # degenerate S=1: no next-token pairs
+    valid = jnp.ones((b, s), jnp.float32) if mask is None \
+        else mask[:, 1:].astype(jnp.float32)
+    chunk_size = min(chunk_size, s)
+    pad = (-s) % chunk_size
+    if pad:
+        x = jnp.pad(x, ((0, 0), (0, pad), (0, 0)))
+        t = jnp.pad(t, ((0, 0), (0, pad)))
+        valid = jnp.pad(valid, ((0, 0), (0, pad)))
+    nc = x.shape[1] // chunk_size
+    xs = x.reshape(b, nc, chunk_size, d).transpose(1, 0, 2, 3)
+    ts = t.reshape(b, nc, chunk_size).transpose(1, 0, 2)
+    ms = valid.reshape(b, nc, chunk_size).transpose(1, 0, 2)
+
+    hd = head_dtype or hidden.dtype
+
+    @jax.checkpoint
+    def chunk_stats(xc, tc, mc):
+        logits = (xc.astype(hd)
+                  @ head_kernel.astype(hd)).astype(jnp.float32)
+        lse = jax.scipy.special.logsumexp(logits, axis=-1)
+        picked = jnp.take_along_axis(
+            logits, tc[..., None], axis=-1)[..., 0]
+        return jnp.sum((lse - picked) * mc), jnp.sum(mc)
+
+    def body(carry, args):
+        tot, cnt = carry
+        dn, dc = chunk_stats(*args)
+        return (tot + dn, cnt + dc), None
+
+    (tot, cnt), _ = jax.lax.scan(
+        body, (jnp.float32(0.0), jnp.float32(0.0)), (xs, ts, ms))
+    return tot / jnp.maximum(cnt, 1.0)
