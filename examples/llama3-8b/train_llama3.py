@@ -26,7 +26,8 @@ import optax
 
 from tony_tpu.checkpoint import CheckpointManager
 from tony_tpu.models import Transformer, TransformerConfig
-from tony_tpu.models.transformer import causal_lm_loss
+from tony_tpu.models.transformer import (causal_lm_loss,
+                                         chunked_causal_lm_loss)
 from tony_tpu.parallel import MeshSpec, build_mesh, init_sharded_state
 from tony_tpu.parallel.sharding import DEFAULT_RULES
 
@@ -43,7 +44,11 @@ if os.environ.get("LLAMA_TINY"):
         remat_policy="dots_with_no_batch_dims_saveable")
 else:
     cfg = TransformerConfig.llama3_8b(
-        remat=True, remat_policy="dots_with_no_batch_dims_saveable")
+        remat=True, remat_policy="dots_with_no_batch_dims_saveable",
+        # RoPE guard bound: follow the requested context (llama3's native
+        # window is 8192; longer runs are context extension on synthetic
+        # data here).
+        max_seq_len=max(SEQ, 8192))
 mesh = build_mesh(MeshSpec(dp=1, fsdp=-1, tp=TP))
 model = Transformer(cfg)
 tokens = jax.random.randint(jax.random.key(0), (BATCH, SEQ), 0,
@@ -53,8 +58,21 @@ state, state_sh = init_sharded_state(
     model, tokens, optax.adamw(3e-4, weight_decay=0.1), mesh)
 
 
+# Past ~8k context the [B, S, 128k-vocab] logits (not attention) are the
+# memory wall: the chunked loss never materializes them. Short sequences
+# keep the one-matmul full path. LLAMA_CHUNKED_LOSS=1 forces the chunked
+# branch (CI exercises it at toy geometry).
+LOSS_CHUNK = int(os.environ.get("LLAMA_LOSS_CHUNK", "2048"))
+CHUNKED = SEQ >= 8192 or os.environ.get("LLAMA_CHUNKED_LOSS") == "1"
+
+
 def loss(params):
     with nn.logical_axis_rules(list(DEFAULT_RULES)):
+        if CHUNKED:
+            h = model.apply({"params": params}, tokens, return_hidden=True)
+            return chunked_causal_lm_loss(
+                h, params["lm_head"]["kernel"], tokens,
+                chunk_size=LOSS_CHUNK, head_dtype=cfg.lm_head_dtype)
         return causal_lm_loss(model.apply({"params": params}, tokens),
                               tokens)
 

@@ -103,3 +103,19 @@ def test_llama3_flagship_script_runs_tiny(tmp_path):
     assert r.returncode == 0, f"stdout:{r.stdout}\nstderr:{r.stderr}"
     assert "final loss" in r.stdout
     assert os.path.isdir(str(tmp_path / "ckpt"))  # manager initialized
+
+
+def test_llama3_flagship_script_chunked_loss_path(tmp_path):
+    """The long-context branch (chunked cross-entropy over hidden states)
+    runs at CI geometry when forced — the code path an 8k+ production
+    config takes."""
+    env = _env(tmp_path)
+    env.update({"LLAMA_TINY": "1", "LLAMA_BATCH": "4", "LLAMA_SEQ": "64",
+                "LLAMA_STEPS": "2", "LLAMA_TP": "2",
+                "LLAMA_CHUNKED_LOSS": "1", "LLAMA_LOSS_CHUNK": "16"})
+    r = subprocess.run(
+        [sys.executable, "train_llama3.py"],
+        cwd=os.path.join(EXAMPLES, "llama3-8b"), env=env,
+        capture_output=True, text=True, timeout=300)
+    assert r.returncode == 0, f"stdout:{r.stdout}\nstderr:{r.stderr}"
+    assert "final loss" in r.stdout
