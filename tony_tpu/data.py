@@ -108,3 +108,71 @@ def synthetic_lm_batches(mesh: Mesh, global_batch: int, seq: int,
     return ShardedBatchIterator(mesh=mesh, global_batch=global_batch,
                                 load_local=load_local,
                                 start_step=start_step)
+
+
+class TokenFileDataset:
+    """Memory-mapped flat token corpus (the nanoGPT/MaxText ``.bin``
+    shape: one contiguous array of token ids, uint16 or uint32).
+
+    Each (step, row) of the global batch reads a ``seq``-token window at
+    a position that is a pure function of (seed, step, row) — so every
+    process computes ONLY its rows (mmap pages the bytes it touches, no
+    host ever loads the corpus), any process layout sees the same global
+    batch, and a restart at ``start_step`` resumes the identical stream
+    (the checkpoint/resume contract of ``ShardedBatchIterator``). Random
+    windows are the standard LM pretraining sampling; pair with
+    ``write_token_file`` for building corpora in tests/tools."""
+
+    def __init__(self, path: str, seq: int, dtype=np.uint16,
+                 seed: int = 0):
+        # NB: the seed must be explicit, never derived from hash(path) —
+        # Python string hashing is salted per process, which would hand
+        # every host a different "global" batch.
+        self.path = path
+        self.seq = seq
+        self.seed = seed
+        self.tokens = np.memmap(path, dtype=dtype, mode="r")
+        # A window of exactly ``seq`` tokens is one complete sample — the
+        # loss shifts inside the batch (causal_lm_loss: tokens[:, 1:]).
+        if len(self.tokens) < seq:
+            raise ValueError(
+                f"{path}: corpus has {len(self.tokens)} tokens, need at "
+                f"least seq = {seq}")
+
+    def load_local(self, step: int, rows: slice) -> Dict[str, Any]:
+        n = rows.stop - rows.start
+        out = np.empty((n, self.seq), np.int32)
+        span = len(self.tokens) - self.seq + 1   # every window, incl. last
+        for j, r in enumerate(range(rows.start, rows.stop)):
+            rng = np.random.default_rng(
+                np.random.SeedSequence([self.seed, step, r]))
+            off = int(rng.integers(0, span))
+            out[j] = self.tokens[off:off + self.seq].astype(np.int32)
+        return {"tokens": out}
+
+
+def token_file_batches(mesh: Mesh, path: str, global_batch: int, seq: int,
+                       dtype=np.uint16, seed: int = 0,
+                       start_step: int = 0) -> ShardedBatchIterator:
+    """Globally-sharded LM batches from a memory-mapped token file."""
+    ds = TokenFileDataset(path, seq, dtype=dtype, seed=seed)
+    return ShardedBatchIterator(mesh=mesh, global_batch=global_batch,
+                                load_local=ds.load_local,
+                                start_step=start_step)
+
+
+def write_token_file(path: str, tokens: "np.ndarray",
+                     dtype=np.uint16) -> str:
+    """Write a flat token array as a ``.bin`` corpus (tooling/tests).
+    Ids that overflow ``dtype`` fail loudly — uint16 wraps 128k-vocab ids
+    silently otherwise."""
+    arr = np.asarray(tokens)
+    if arr.ndim != 1:
+        raise ValueError(f"corpus must be flat, got shape {arr.shape}")
+    info = np.iinfo(dtype)
+    if arr.size and (arr.min() < info.min or arr.max() > info.max):
+        raise ValueError(
+            f"token ids [{arr.min()}, {arr.max()}] overflow {np.dtype(dtype)}"
+            f" [{info.min}, {info.max}] — use dtype=np.uint32")
+    arr.astype(dtype).tofile(path)
+    return path
