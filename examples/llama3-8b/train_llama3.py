@@ -87,17 +87,33 @@ ckpt_dir = os.environ.get("TONY_CHECKPOINT_DIR", "")
 mgr = CheckpointManager(ckpt_dir, save_interval_steps=50) if ckpt_dir \
     else None
 start = 0
+
+
+def _ckpt_tree(s):
+    # FULL state: params alone would resume with re-warming Adam moments
+    # and a reset step counter — a loss spike after every restart.
+    return {"step": s.step, "params": s.params, "opt_state": s.opt_state}
+
+
 if mgr is not None and mgr.latest_step() is not None:
-    tree = {"step": state.step, "params": state.params}
-    state = state.replace(**{k: v for k, v in
-                             mgr.restore(mgr.latest_step(), tree).items()
-                             if k != "step"})
-    start = int(mgr.latest_step())
+    try:
+        state = state.replace(**mgr.restore(mgr.latest_step(),
+                                            _ckpt_tree(state)))
+    except Exception:  # noqa: BLE001 — pre-full-state checkpoint layout
+        print("warning: checkpoint has no opt_state (older layout); "
+              "resuming with params only — optimizer moments re-warm",
+              file=sys.stderr)
+        partial = {"step": state.step, "params": state.params}
+        state = state.replace(**mgr.restore(mgr.latest_step(), partial))
+    # Checkpoint i is saved AFTER loop iteration i (post-step state), so
+    # the next iteration to run is i+1 — resuming at i would duplicate
+    # one optimizer update per restart.
+    start = int(mgr.latest_step()) + 1
 
 for i in range(start, STEPS):
     state, l = step(state)
     if mgr is not None:
-        mgr.save(i, {"step": state.step, "params": state.params})
+        mgr.save(i, _ckpt_tree(state))
 if mgr is not None:
     mgr.wait()
 print(f"process {jax.process_index()}: final loss {float(l):.4f}")

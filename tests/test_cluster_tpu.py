@@ -242,6 +242,9 @@ def test_e2e_gang_over_stub_ssh_hosts(tmp_path, monkeypatch):
     conf.set(K.SLICE_PROVISIONER, "ssh")
     conf.set(K.SLICE_NUM_HOSTS, 2)
     conf.set(K.SLICE_HOSTS, "tpu-vm-a,tpu-vm-b")
+    # The "VMs" are this machine: its interpreter stands in for the TPU
+    # VM's python3 (the key executors are actually launched with).
+    conf.set(K.SLICE_REMOTE_PYTHON, sys.executable)
     client, rec, code = submit(conf, tmp_path)
     assert code == 0, _dump_task_logs(client)
     assert rec.finished[0] == "SUCCEEDED"

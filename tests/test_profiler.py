@@ -30,6 +30,28 @@ def test_trace_window_captures(tmp_path, monkeypatch):
     assert n > 0
 
 
+def test_e2e_trace_rides_the_remote_store_home(tmp_path, monkeypatch):
+    """Remote-store jobs: the chief may run on a host without the
+    coordinator's job dir — traces go to the task workdir, the executor
+    uploads them to the store, and the coordinator pulls them into the
+    job dir at stop, so the portal's view works unchanged."""
+    monkeypatch.setenv("TONY_FAKE_GCS_ROOT", str(tmp_path / "gcs"))
+    conf = make_conf(tmp_path, "train_with_profile.py", workers=2,
+                     extra={K.APPLICATION_PROFILER_ENABLED: True,
+                            K.REMOTE_STORE: "gs://jobs/staging"})
+    client, rec, code = submit(conf, tmp_path)
+    assert code == 0, _dump_task_logs(client)
+    # the store holds the uploaded trace ...
+    from tony_tpu.storage import get_store
+
+    prefix = f"gs://jobs/staging/{rec.app_id}/profile"
+    assert get_store(prefix).isdir(prefix)
+    # ... and it was localized into the job dir for the portal
+    job_dir = history.list_job_dirs(str(tmp_path / "history"))[rec.app_id]
+    trace_root = os.path.join(job_dir, "profile", "step0")
+    assert sum(len(fs) for _, _, fs in os.walk(trace_root)) > 0
+
+
 def test_e2e_chief_trace_in_job_dir_and_portal(tmp_path):
     conf = make_conf(tmp_path, "train_with_profile.py", workers=2,
                      extra={K.APPLICATION_PROFILER_ENABLED: True})

@@ -141,6 +141,18 @@ def test_e2e_token_passthrough_to_executors(tmp_path, monkeypatch):
     assert "tok-xyz" not in open(frozen).read()
 
 
+def test_token_scrubbed_even_without_remote_store(tmp_path, monkeypatch):
+    """A credential set for e.g. gs:// checkpoint access must not freeze
+    into the world-readable config just because staging itself is local."""
+    monkeypatch.delenv(STORAGE_TOKEN_ENV, raising=False)
+    conf = make_conf(tmp_path, "exit_0.py", workers=1)
+    conf.set(K.STORAGE_TOKEN, "tok-local-leak")
+    client, rec, code = submit(conf, tmp_path)
+    assert code == 0, _dump_task_logs(client)
+    frozen = os.path.join(client.job_dir, "tony-final.json")
+    assert "tok-local-leak" not in open(frozen).read()
+
+
 def test_e2e_missing_token_fails_at_submit(tmp_path, monkeypatch):
     monkeypatch.setenv("TONY_FAKE_GCS_ROOT", str(tmp_path / "gcs"))
     monkeypatch.delenv(STORAGE_TOKEN_ENV, raising=False)

@@ -138,20 +138,24 @@ class TonyTpuClient:
         job dir itself is the staging area (single-host path)."""
         remote = str(self.conf.get(K.REMOTE_STORE, "") or "")
         store = prefix = None
+        from tony_tpu.storage.store import STORAGE_TOKEN_ENV
+
+        token = self._storage_token()
+        if token:
+            # The credential travels by ENV, never in the config: the
+            # frozen config is world-readable (portal config view,
+            # events, the store itself). The coordinator inherits this
+            # env and re-exports it to executors — the separate-token-
+            # file discipline of the reference (TokenCache.java:44-51).
+            # Scrubbed UNCONDITIONALLY: a token set for e.g. gs://
+            # checkpoint access must not freeze just because staging
+            # itself is local.
+            os.environ[STORAGE_TOKEN_ENV] = token
+            self.conf.unset(K.STORAGE_TOKEN)
         if remote:
             from tony_tpu.storage import get_store
-            from tony_tpu.storage.store import STORAGE_TOKEN_ENV
             from tony_tpu.storage.store import join as ujoin
 
-            token = self._storage_token()
-            if token:
-                # The credential travels by ENV, never in the config: the
-                # frozen config is world-readable (portal config view,
-                # events, the store itself). The coordinator inherits this
-                # env and re-exports it to executors — the separate-token-
-                # file discipline of the reference (TokenCache.java:44-51).
-                os.environ[STORAGE_TOKEN_ENV] = token
-                self.conf.unset(K.STORAGE_TOKEN)
             store = get_store(remote, credential=token or None)
             prefix = ujoin(remote, self.app_id)
         src = str(self.conf.get(K.SRC_DIR, "") or "")

@@ -69,10 +69,12 @@ def build_executor_argv(python: str, spec: TaskLaunchSpec,
             "-v", f"{workdir}:{workdir}", "-w", workdir]
     mounts = set()
     conf_path = spec.env.get("TONY_EXECUTOR_CONF", "")
-    if conf_path and "://" not in conf_path:
+    from tony_tpu.storage.store import is_url
+
+    if conf_path and not is_url(conf_path):
         mounts.add(os.path.dirname(os.path.abspath(conf_path)))
     ckpt = spec.env.get("TONY_CHECKPOINT_DIR", "")
-    if ckpt and "://" not in ckpt:
+    if ckpt and not is_url(ckpt):
         mounts.add(os.path.abspath(ckpt))
     for m in sorted(mounts):
         argv += ["-v", f"{m}:{m}"]
@@ -82,14 +84,19 @@ def build_executor_argv(python: str, spec: TaskLaunchSpec,
     return argv
 
 
-def docker_kill(name: str) -> None:
-    """Best-effort ``docker kill`` of a named task container (teardown
-    companion of build_executor_argv; see container_name)."""
+def docker_kill(name: str, grace_s: float = 0.0) -> None:
+    """Best-effort teardown of a named task container (companion of
+    build_executor_argv; see container_name). ``docker stop -t`` delivers
+    TERM first and escalates to KILL after the grace window, preserving
+    kill_task's TERM→grace→KILL contract for in-container checkpoint/
+    cleanup handlers (bare ``docker kill`` is SIGKILL with no warning)."""
     import subprocess
 
     try:
-        subprocess.run(["docker", "kill", name], timeout=15,
-                       stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
+        subprocess.run(
+            ["docker", "stop", "-t", str(max(0, int(grace_s))), name],
+            timeout=15 + grace_s,
+            stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
     except Exception:  # noqa: BLE001 — teardown is best-effort
         pass
 

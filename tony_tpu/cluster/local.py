@@ -83,7 +83,7 @@ class LocalProcessBackend(Backend):
         if proc.container:
             # The containerized executor is containerd's child, not ours:
             # signal the container by name, then the docker-run client.
-            docker_kill(proc.container)
+            docker_kill(proc.container, grace_s=grace_s)
         try:
             # Kill the whole process group (executor + user child).
             os.killpg(proc.popen.pid, signal.SIGTERM)

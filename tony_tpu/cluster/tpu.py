@@ -123,12 +123,15 @@ class LocalSimHostChannel(HostChannel):
                 pass
 
     def poll(self, handle) -> Optional[int]:
+        # A task that FINISHED before the host died keeps its real exit
+        # code (a real channel has the buffered status too) — only
+        # still-running tasks are converted to host-lost.
+        rc = handle["popen"].poll()
+        if rc is not None:
+            return 128 - rc if rc < 0 else rc
         if not self._alive:
             return HOST_LOST_EXIT
-        rc = handle["popen"].poll()
-        if rc is None:
-            return None
-        return 128 - rc if rc < 0 else rc
+        return None
 
     def alive(self) -> bool:
         return self._alive
@@ -195,14 +198,17 @@ class SshHostChannel(HostChannel):
     def kill(self, handle, grace_s: float = 0.0) -> None:
         wd = shlex.quote(handle["workdir"])
         if handle.get("container"):
-            # Kill the container by name first: signalling the docker-run
+            # Stop the container by name first: signalling the docker-run
             # client's process group does not reach containerd's child.
-            k = self._ssh(f"docker kill {shlex.quote(handle['container'])} "
+            # `docker stop -t` = TERM, grace, then KILL (kill_task's
+            # escalation contract; bare `docker kill` is instant SIGKILL).
+            k = self._ssh(f"docker stop -t {max(0, int(grace_s))} "
+                          f"{shlex.quote(handle['container'])} "
                           f">/dev/null 2>&1 || true",
                           stdout=subprocess.DEVNULL,
                           stderr=subprocess.DEVNULL)
             try:
-                k.wait(timeout=15)
+                k.wait(timeout=15 + grace_s)
             except subprocess.TimeoutExpired:
                 k.kill()
         sig = "TERM"
@@ -228,8 +234,14 @@ class SshHostChannel(HostChannel):
         rc = handle["popen"].poll()
         if rc is None:
             return None
-        if rc == 255:           # ssh transport failure → host unreachable
-            return HOST_LOST_EXIT
+        if rc == 255:
+            # ssh reports ITS OWN failures as 255, but a remote command
+            # exiting 255 looks identical. Disambiguate with a FRESH
+            # liveness probe (the cache may be seconds old — exactly the
+            # window in which a preempted host died): reachable host →
+            # the user code really exited 255.
+            self._alive_cache = None
+            return 255 if self.alive() else HOST_LOST_EXIT
         return 128 - rc if rc < 0 else rc
 
     def alive(self) -> bool:
@@ -447,8 +459,13 @@ class TpuSliceBackend(Backend):
         spec.env = env          # the spec records what actually ran
         workdir = os.path.join(self.workdir, host.host_id,
                                spec.task_id.replace(":", "_"))
+        # A channel that knows its host's interpreter (ssh: the remote
+        # VM's python, tony.slice.remote-python) wins over the
+        # coordinator-local default — sys.executable is a path on THIS
+        # machine and means nothing on a TPU VM.
+        python = getattr(host, "python", None) or self.python
         handle = host.exec_task(
-            spec.task_id, build_executor_argv(self.python, spec, workdir),
+            spec.task_id, build_executor_argv(python, spec, workdir),
             env, workdir)
         st = _SliceTask(spec, host, handle)
         with self._lock:

@@ -70,6 +70,11 @@ SLICE_NUM_HOSTS = _key(
 SLICE_HOSTS = _key(
     "tony.slice.hosts", "", str,
     "tpu-slice+ssh only: comma-separated ssh targets (TPU VM inventory).")
+SLICE_REMOTE_PYTHON = _key(
+    "tony.slice.remote-python", "python3", str,
+    "tpu-slice+ssh only: the interpreter that runs executors ON the TPU "
+    "VMs (the coordinator's sys.executable is a path on the wrong "
+    "machine).")
 SLICE_FAKE_INVENTORY = _key(
     "tony.slice.fake-inventory", 0, int,
     "tpu-slice+fake only: total fake hosts in the provisioner inventory; "

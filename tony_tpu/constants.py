@@ -95,6 +95,10 @@ HISTORY_FINISHED = "finished"
 
 # Chief-only XLA trace destination (tony_tpu/profiler.py contract).
 PROFILE_DIR = "TONY_PROFILE_DIR"
+# Store URL the executor uploads captured traces to post-run (set when a
+# remote store is configured — the chief's host can't write the
+# coordinator's job dir directly; the coordinator pulls them back at stop).
+PROFILE_UPLOAD = "TONY_PROFILE_UPLOAD"
 
 # ---------------------------------------------------------------------------
 # Fault-injection test hooks, honoured by production code exactly like the

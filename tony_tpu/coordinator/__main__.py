@@ -41,7 +41,9 @@ def _make_backend(conf, workdir):
             targets = [t.strip()
                        for t in str(conf.get(K.SLICE_HOSTS, "")).split(",")
                        if t.strip()]
-            prov = StaticSshProvisioner(targets)
+            prov = StaticSshProvisioner(
+                targets,
+                python=str(conf.get(K.SLICE_REMOTE_PYTHON, "python3")))
         elif prov_kind == "fake":
             inv = int(conf.get(K.SLICE_FAKE_INVENTORY, 0)) or n_hosts
             prov = FakeSliceProvisioner(inv, os.path.join(workdir, "hosts"))

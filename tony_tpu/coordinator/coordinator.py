@@ -173,13 +173,22 @@ class Coordinator:
         ckpt_dir = str(self.conf.get(K.APPLICATION_CHECKPOINT_DIR, "") or "")
         if ckpt_dir:
             env[constants.CHECKPOINT_DIR] = ckpt_dir
+        conf_url = str(self.conf.get(K.INTERNAL_CONF_URL, "") or "")
         if self.conf.get_bool(K.APPLICATION_PROFILER_ENABLED) and \
                 self.session.is_chief(task.job_name, task.index):
             # Chief-only trace capture into the job history dir, where the
-            # portal finds it (tony_tpu/profiler.py contract).
-            env[constants.PROFILE_DIR] = os.path.join(self.job_dir,
-                                                      "profile")
-        conf_url = str(self.conf.get(K.INTERNAL_CONF_URL, "") or "")
+            # portal finds it (tony_tpu/profiler.py contract). With a
+            # remote store the chief may be on another host where the job
+            # dir doesn't exist: traces go to the task's own workdir and
+            # ride the store home (executor uploads post-run, _stop pulls
+            # them into the job dir).
+            if conf_url:
+                env[constants.PROFILE_DIR] = "profile"
+                env[constants.PROFILE_UPLOAD] = self._profile_store_url(
+                    conf_url)
+            else:
+                env[constants.PROFILE_DIR] = os.path.join(self.job_dir,
+                                                          "profile")
         if conf_url:
             # Remote store configured: executors fetch the frozen config
             # from the store (they may be on another host); the credential
@@ -201,6 +210,12 @@ class Coordinator:
                 env[k] = v
         env.update(job.env)
         return env
+
+    @staticmethod
+    def _profile_store_url(conf_url: str) -> str:
+        """Store prefix for chief traces, next to the frozen config
+        (<prefix>/tony-final.json → <prefix>/profile)."""
+        return conf_url.rsplit("/", 1)[0] + "/profile"
 
     def _launch_job(self, job_name: str) -> None:
         job = self.session.jobs[job_name]
@@ -568,6 +583,20 @@ class Coordinator:
         if self.conf.get_bool(K.APPLICATION_NUM_CLIENTS_TO_WAIT, True):
             self.client_signalled_finish.wait(
                 timeout=self.conf.get_int(K.COORDINATOR_STOP_GRACE_S, 15))
+        conf_url = str(self.conf.get(K.INTERNAL_CONF_URL, "") or "")
+        if conf_url and self.conf.get_bool(K.APPLICATION_PROFILER_ENABLED):
+            # Pull store-staged chief traces into the job dir so the
+            # portal's /profiles view works for remote-host jobs too.
+            try:
+                from tony_tpu.storage import get_store
+
+                url = self._profile_store_url(conf_url)
+                store = get_store(url)
+                if store.isdir(url):
+                    store.get_tree(url, os.path.join(self.job_dir,
+                                                     "profile"))
+            except Exception as e:  # noqa: BLE001 — teardown best-effort
+                log.warning("profile trace localization failed: %s", e)
         self.events.emit(Event(EventType.APPLICATION_FINISHED, {
             "app_id": self.app_id, "status": self.final_status.value,
             "failure_reason": self.session.failure_reason or "",

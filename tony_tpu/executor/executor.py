@@ -75,7 +75,8 @@ class TaskExecutor:
         self.coordinator_port = int(e[constants.COORDINATOR_PORT])
         self.command = e.get(constants.TASK_COMMAND, "")
         conf_path = e.get(constants.EXECUTOR_CONF, "")
-        if conf_path and "://" in conf_path:
+        from tony_tpu.storage.store import is_url
+        if conf_path and is_url(conf_path):
             # Frozen config lives in the remote store (multi-host path);
             # fetch it with the env credential before reading any key.
             from tony_tpu.storage import get_store
@@ -262,6 +263,7 @@ class TaskExecutor:
                 self.rendezvous_port.release()
             self._teardown_tensorboard(tb_proc)
         log.info("user process for %s exited with %d", self.task_id, exit_code)
+        self._maybe_upload_profile()
 
         try:
             self.client.call("register_execution_result",
@@ -271,6 +273,27 @@ class TaskExecutor:
         hb.stop()
         self._maybe_skew_sleep()
         return exit_code
+
+    def _maybe_upload_profile(self) -> None:
+        """Remote-store jobs: ship the chief's captured traces home (the
+        coordinator pulls them into the job dir at stop — see
+        Coordinator._profile_store_url). Best-effort: a failed upload must
+        not turn a finished task into a failure."""
+        url = os.environ.get(constants.PROFILE_UPLOAD, "")
+        local = os.environ.get(constants.PROFILE_DIR, "")
+        if not url or not local:
+            return
+        local = os.path.join(os.getcwd(), local) \
+            if not os.path.isabs(local) else local
+        if not os.path.isdir(local):
+            return
+        try:
+            from tony_tpu.storage import get_store
+
+            get_store(url).put_tree(local, url)
+            log.info("uploaded profiler traces to %s", url)
+        except Exception as e:  # noqa: BLE001
+            log.warning("profile upload failed: %s", e)
 
     def _maybe_launch_tensorboard(self, env: Dict[str, str]):
         """Chief-only: spawn the configured TensorBoard command on the

@@ -15,6 +15,7 @@ from __future__ import annotations
 import dataclasses
 import os
 import shutil
+from tony_tpu.storage.store import is_url
 from typing import List
 
 ARCHIVE_SUFFIX = "#archive"
@@ -61,7 +62,7 @@ def stage_resources(specs: List[str], stage_dir: str, store=None,
     out: List[str] = []
     for i, spec in enumerate(specs):
         r = LocalizableResource.parse(spec)
-        if _is_url(r.source):
+        if is_url(r.source):
             out.append(spec.strip())
             continue
         if not os.path.exists(r.source):
@@ -100,7 +101,7 @@ def localize_resources(specs: List[str], workdir: str) -> List[str]:
     for i, spec in enumerate(specs):
         r = LocalizableResource.parse(spec)
         source = r.source
-        if _is_url(source) and not source.startswith("file://"):
+        if is_url(source) and not source.startswith("file://"):
             from tony_tpu.storage import get_store
 
             store = get_store(source)
@@ -126,7 +127,3 @@ def localize_resources(specs: List[str], workdir: str) -> List[str]:
             shutil.copy2(source, target)
         placed.append(target)
     return placed
-
-
-def _is_url(s: str) -> bool:
-    return "://" in (s or "")

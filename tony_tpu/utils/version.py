@@ -32,8 +32,17 @@ def version_info() -> Dict[str, str]:
         except Exception:  # noqa: BLE001 — no git / not a checkout
             return "unknown"
 
+    # Only stamp git metadata when the checkout is actually OURS: an
+    # installed package under someone else's repo (site-packages inside a
+    # project checkout) would otherwise record the USER's revision as the
+    # framework build — wrong provenance is worse than "unknown".
+    toplevel = _git("rev-parse", "--show-toplevel")
+    ours = toplevel != "unknown" and \
+        os.path.realpath(toplevel) == os.path.realpath(root)
     return {
         "version": __version__,
-        "revision": _git("rev-parse", "--short", "HEAD"),
-        "branch": _git("rev-parse", "--abbrev-ref", "HEAD"),
+        "revision": _git("rev-parse", "--short", "HEAD") if ours
+        else "unknown",
+        "branch": _git("rev-parse", "--abbrev-ref", "HEAD") if ours
+        else "unknown",
     }
