@@ -210,6 +210,28 @@ def test_ssh_provisioner_lease_bookkeeping(tmp_path):
     assert len(prov.acquire(2).hosts) == 2
 
 
+def test_e2e_heterogeneous_gang_coordinator_pool(tmp_path):
+    """SURVEY.md §7 hard part (d): a CPU ps-style jobtype rides the
+    coordinator's machine (node-pool=coordinator) while workers gang over
+    the TPU slice hosts — one DAG, one rendezvous, no TPU VM wasted on a
+    parameter server. The ps is untracked (reference semantics) and must
+    still appear in every worker's cluster spec."""
+    conf = slice_conf(tmp_path, "check_env.py", workers=2, n_hosts=2)
+    conf.set("tony.ps.instances", 1)
+    conf.set("tony.ps.command", f"{sys.executable} "
+             f"{os.path.join(SCRIPTS, 'sleep_5.py')}")
+    conf.set("tony.ps.node-pool", "coordinator")
+    client, rec, code = submit(conf, tmp_path)
+    assert code == 0, _dump_task_logs(client)
+    assert rec.finished[0] == "SUCCEEDED"
+    workroot = tmp_path / "work" / "jobs" / rec.app_id / "tasks"
+    dirs = sorted(os.listdir(str(workroot)))
+    # ps on the coordinator host; workers spread over the slice
+    assert "coordinator-host" in dirs
+    assert os.listdir(str(workroot / "coordinator-host")) == ["ps_0"]
+    assert {"fakehost-0", "fakehost-1"} <= set(dirs)
+
+
 def test_e2e_gang_over_stub_ssh_hosts(tmp_path, monkeypatch):
     """SshHostChannel end-to-end: a PATH-stubbed `ssh` executes each
     "remote" command locally in its own session, so the real production

@@ -424,7 +424,11 @@ class TpuSliceBackend(Backend):
                                                   self.node_pool)
             with self._lock:
                 self._next_host = 0
-                self._host_tasks = {}
+                # Reset per-slice-host ordinals only: the coordinator-host
+                # counter tracks tasks that outlive slice re-leases.
+                self._host_tasks = {
+                    k: v for k, v in self._host_tasks.items()
+                    if k == "coordinator-host"}
             log.info("leased %s: hosts=%s", self.lease.slice_id,
                      [h.host_id for h in self.lease.hosts])
         return self.lease
@@ -446,24 +450,59 @@ class TpuSliceBackend(Backend):
                 return
 
     # -- Backend -------------------------------------------------------
+    def _coordinator_host(self) -> HostChannel:
+        """Lazy local channel for ``tony.<job>.node-pool=coordinator``
+        jobtypes: ps/db-style CPU tasks run on the coordinator's machine
+        instead of occupying a TPU VM — SURVEY.md §7 hard part (d),
+        heterogeneous gangs on infrastructure that wants homogeneous
+        slices. They share the rendezvous/heartbeat plane with the slice
+        tasks unchanged (the cluster spec doesn't care where a host is)."""
+        with self._lock:
+            if not hasattr(self, "_coord_channel"):
+                self._coord_channel = LocalSimHostChannel(
+                    "coordinator-host", os.path.join(self.workdir,
+                                                     "coordinator-host"))
+            return self._coord_channel
+
     def launch_task(self, spec: TaskLaunchSpec) -> object:
+        if spec.node_pool and spec.node_pool != "coordinator":
+            # Per-job pools other than the reserved "coordinator" are not
+            # routed by this backend (slice selection is a lease-level
+            # concern) — say so instead of silently parking a CPU task on
+            # a TPU VM after a typo like "Coordinator".
+            log.warning(
+                "tony.%s.node-pool=%r has no effect on the tpu-slice "
+                "backend (only 'coordinator' is special); %s will run on "
+                "a slice host", spec.job_name, spec.node_pool,
+                spec.task_id)
+        if spec.node_pool == "coordinator":
+            host = self._coordinator_host()
+            with self._lock:
+                local_ordinal = self._host_tasks.get(host.host_id, 0)
+                self._host_tasks[host.host_id] = local_ordinal + 1
+            return self._exec_on(host, spec, local_ordinal,
+                                 python=self.python)
         lease = self._ensure_lease()
         with self._lock:
             host = lease.hosts[self._next_host % len(lease.hosts)]
             self._next_host += 1
             local_ordinal = self._host_tasks.get(host.host_id, 0)
             self._host_tasks[host.host_id] = local_ordinal + 1
+        # A channel that knows its host's interpreter (ssh: the remote
+        # VM's python, tony.slice.remote-python) wins over the
+        # coordinator-local default — sys.executable is a path on THIS
+        # machine and means nothing on a TPU VM.
+        python = getattr(host, "python", None) or self.python
+        return self._exec_on(host, spec, local_ordinal, python=python)
+
+    def _exec_on(self, host: HostChannel, spec: TaskLaunchSpec,
+                 local_ordinal: int, python: str) -> "_SliceTask":
         env = dict(spec.env)
         env["TONY_HOST_ID"] = host.host_id
         env["TONY_HOST_LOCAL_ORDINAL"] = str(local_ordinal)
         spec.env = env          # the spec records what actually ran
         workdir = os.path.join(self.workdir, host.host_id,
                                spec.task_id.replace(":", "_"))
-        # A channel that knows its host's interpreter (ssh: the remote
-        # VM's python, tony.slice.remote-python) wins over the
-        # coordinator-local default — sys.executable is a path on THIS
-        # machine and means nothing on a TPU VM.
-        python = getattr(host, "python", None) or self.python
         handle = host.exec_task(
             spec.task_id, build_executor_argv(python, spec, workdir),
             env, workdir)

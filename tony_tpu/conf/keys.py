@@ -321,7 +321,10 @@ MEMORY_FORMAT = "tony.{job}.memory"
 MAX_INSTANCES_FORMAT = "tony.{job}.max-instances"
 DEPENDS_ON_FORMAT = "tony.{job}.depends-on"
 ENV_FORMAT = "tony.{job}.env"
-NODE_POOL_FORMAT = "tony.{job}.node-pool"  # replaces tony.X.node-label
+# Replaces tony.X.node-label. On the tpu-slice backend the reserved pool
+# "coordinator" places the jobtype on the coordinator's machine (CPU
+# ps/db-style tasks in a TPU gang — heterogeneous DAGs, SURVEY.md §7(d)).
+NODE_POOL_FORMAT = "tony.{job}.node-pool"
 # Container image for the jobtype's executors (reference per-job docker
 # support, TonyConfigurationKeys.java:178-239 + Utils docker env :729-776).
 # The backend wraps the executor launch in `docker run` (host networking;
