@@ -281,6 +281,40 @@ def test_e2e_gang_over_stub_ssh_hosts(tmp_path, monkeypatch):
                for h in hostdirs for t in os.listdir(str(workroot / h)))
 
 
+def test_e2e_preemption_resumes_from_checkpoint_on_fresh_lease(
+        tmp_path, monkeypatch):
+    """The whole reliable-training-on-preemptible-TPUs story in one flow:
+    a slice host dies mid-training (preemption), the broken lease is
+    released, a fresh lease is granted from spare inventory, and the
+    retried epoch RESUMES from the last checkpoint instead of restarting
+    — slice atomicity (SURVEY §7(a)) + retry epochs
+    (ApplicationMaster.java:356-371) + the checkpoint manager composed."""
+    monkeypatch.setenv(constants.TEST_SLICE_FAIL_HOST, "fakehost-0")
+    result = tmp_path / "result.txt"
+    conf = slice_conf(
+        tmp_path, "train_with_resume.py", workers=1, n_hosts=1,
+        inventory=2,
+        extra={K.APPLICATION_RETRY_COUNT: 2,
+               K.APPLICATION_CHECKPOINT_DIR: str(tmp_path / "ckpt"),
+               K.TASK_REGISTRATION_TIMEOUT_S: 60})
+    # No self-crash: the HOST dies (hook fires ~0.7 s after launch, while
+    # the script is sleeping between steps; step 1's save lands well
+    # before that).
+    conf.set(K.EXECUTION_ENV, f"TONY_TEST_RESULT={result}")
+    conf.set(K.EXECUTION_ENV, "TONY_TEST_SELF_CRASH=0")
+    conf.set(K.EXECUTION_ENV, "TONY_TEST_STEPS=6")
+    conf.set(K.EXECUTION_ENV, "TONY_TEST_STEP_SLEEP=0.4")
+    client, rec, code = submit(conf, tmp_path)
+    assert code == 0, _dump_task_logs(client)
+    assert rec.finished[0] == "SUCCEEDED"
+    assert int(rec.finished[1].get("attempt", 0)) >= 1   # retried
+    start, end, w1 = result.read_text().split()
+    assert int(start) >= 1, \
+        f"retried epoch should RESUME (start >= 1), got {start}"
+    assert int(end) == 6
+    assert float(w1) == 2.0 ** 6        # w[1]=1 doubled once per step
+
+
 @pytest.mark.slow
 def test_e2e_distributed_training_over_slice_backend(tmp_path):
     """The full multi-host story in one flow: a gang placed over two fake
