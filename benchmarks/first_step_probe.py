@@ -1,0 +1,35 @@
+"""User script for the submit-to-first-step latency bench point.
+
+Runs as the single worker of a real 1-host job submitted through the full
+orchestration path (client staging → coordinator → tpu-slice backend →
+executor → gang barrier → this script). Reports seconds from the client's
+submit timestamp (TONY_BENCH_T0) to the completion of the first jitted
+device step — the analogue of the reference client's 1 s status-poll
+observable (``TonyClient.java:838-892``), but measured to the first real
+training step instead of to RUNNING.
+"""
+import json
+import os
+import time
+
+import jax
+import jax.numpy as jnp
+
+t0 = float(os.environ["TONY_BENCH_T0"])
+
+
+@jax.jit
+def step(x, w):
+    return ((x @ w) ** 2).mean()
+
+
+x = jnp.ones((256, 256), jnp.bfloat16)
+w = jnp.ones((256, 256), jnp.bfloat16)
+step(x, w).block_until_ready()
+dt = time.time() - t0
+
+with open(os.environ["TONY_BENCH_RESULT"], "w") as f:
+    json.dump({"submit_to_first_step_s": round(dt, 2),
+               "backend": jax.default_backend(),
+               "device_kind": jax.devices()[0].device_kind}, f)
+print(f"first step complete {dt:.2f}s after submit")

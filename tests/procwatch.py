@@ -1,0 +1,54 @@
+"""Zero-orphan assertion helper: scan /proc for processes whose environment
+carries a job-scoped marker (TONY_APP_ID=..., TONY_TPU_WORKDIR=...).
+
+The kill-chain contract (constants.USER_PGID_FILE + backend group ladders)
+says job teardown must reach the USER process tree, not just the executors —
+what YARN's NodeManager container reaping gave the reference for free. These
+helpers let e2e tests prove it: after a job ends, NO process execed with that
+job's environment may survive.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from typing import List, Tuple
+
+
+def live_pids_with_env(needle: str) -> List[Tuple[int, str]]:
+    """(pid, cmdline) of all live processes whose /proc environ contains
+    ``needle`` (e.g. ``TONY_APP_ID=app-123``). Skips this process and
+    unreadable (foreign-user / exited) entries."""
+    needle_b = needle.encode()
+    me = os.getpid()
+    out: List[Tuple[int, str]] = []
+    for entry in os.listdir("/proc"):
+        if not entry.isdigit() or int(entry) == me:
+            continue
+        try:
+            with open(f"/proc/{entry}/environ", "rb") as f:
+                env = f.read()
+            if needle_b not in env:
+                continue
+            with open(f"/proc/{entry}/cmdline", "rb") as f:
+                cmd = f.read().replace(b"\0", b" ").decode(
+                    "utf-8", "replace").strip()
+        except OSError:
+            continue
+        out.append((int(entry), cmd))
+    return out
+
+
+def assert_no_orphans(needle: str, timeout_s: float = 8.0) -> None:
+    """Poll until no process with ``needle`` in its environment survives;
+    fail listing the survivors. The poll window absorbs normal teardown
+    latency (grace ladders, docker stop) — what it must NEVER absorb is a
+    run-forever orphan."""
+    deadline = time.monotonic() + timeout_s
+    survivors = live_pids_with_env(needle)
+    while survivors and time.monotonic() < deadline:
+        time.sleep(0.2)
+        survivors = live_pids_with_env(needle)
+    assert not survivors, (
+        f"orphaned processes survived job teardown (env marker {needle!r}): "
+        + "; ".join(f"pid {p}: {c}" for p, c in survivors))

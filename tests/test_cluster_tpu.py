@@ -289,7 +289,12 @@ def test_e2e_preemption_resumes_from_checkpoint_on_fresh_lease(
     retried epoch RESUMES from the last checkpoint instead of restarting
     — slice atomicity (SURVEY §7(a)) + retry epochs
     (ApplicationMaster.java:356-371) + the checkpoint manager composed."""
-    monkeypatch.setenv(constants.TEST_SLICE_FAIL_HOST, "fakehost-0")
+    # Condition-triggered preemption: the host dies only once step 1's
+    # checkpoint is DURABLE (the committed orbax step dir exists) — never a
+    # race against JAX import/startup time, so "resumed" is distinguishable
+    # from "restarted" on every run.
+    monkeypatch.setenv(constants.TEST_SLICE_FAIL_HOST,
+                       f"fakehost-0#{tmp_path / 'ckpt' / '1'}")
     result = tmp_path / "result.txt"
     conf = slice_conf(
         tmp_path, "train_with_resume.py", workers=1, n_hosts=1,
@@ -297,9 +302,7 @@ def test_e2e_preemption_resumes_from_checkpoint_on_fresh_lease(
         extra={K.APPLICATION_RETRY_COUNT: 2,
                K.APPLICATION_CHECKPOINT_DIR: str(tmp_path / "ckpt"),
                K.TASK_REGISTRATION_TIMEOUT_S: 60})
-    # No self-crash: the HOST dies (hook fires ~0.7 s after launch, while
-    # the script is sleeping between steps; step 1's save lands well
-    # before that).
+    # No self-crash: the HOST dies under the script mid-run.
     conf.set(K.EXECUTION_ENV, f"TONY_TEST_RESULT={result}")
     conf.set(K.EXECUTION_ENV, "TONY_TEST_SELF_CRASH=0")
     conf.set(K.EXECUTION_ENV, "TONY_TEST_STEPS=6")
@@ -313,6 +316,10 @@ def test_e2e_preemption_resumes_from_checkpoint_on_fresh_lease(
         f"retried epoch should RESUME (start >= 1), got {start}"
     assert int(end) == 6
     assert float(w1) == 2.0 ** 6        # w[1]=1 doubled once per step
+    # Host-loss retry must not strand anything: the SIGKILLed first-epoch
+    # task tree AND the successful retry's tree are both fully reaped.
+    from procwatch import assert_no_orphans
+    assert_no_orphans(f"TONY_APP_ID={rec.app_id}")
 
 
 @pytest.mark.slow

@@ -86,6 +86,11 @@ def test_generic_gang_example_submits_e2e(tmp_path):
         capture_output=True, text=True, timeout=300)
     assert r.returncode == 0, f"stdout:{r.stdout}\nstderr:{r.stderr}"
     assert "application finished: SUCCEEDED" in r.stdout
+    # The run-forever untracked head service must NOT outlive the job —
+    # the zero-orphan contract (TONY_TPU_WORKDIR is unique to this run and
+    # inherited by every process the submission spawned).
+    from procwatch import assert_no_orphans
+    assert_no_orphans(f"TONY_TPU_WORKDIR={tmp_path}")
 
 
 def test_llama3_flagship_script_runs_tiny(tmp_path):

@@ -88,3 +88,8 @@ def test_e2e_notebook_reachable_through_proxy(tmp_path):
     # killed by us after successful tunneling — any terminal outcome is
     # fine; what matters is the bytes made the round trip
     assert not t.is_alive()
+    # force_kill must reach the notebook server itself (the
+    # _do_local_job stop-watcher + user-pgid ladder), not just the
+    # coordinator — the leak class the round-3 review caught live.
+    from procwatch import assert_no_orphans
+    assert_no_orphans(f"TONY_APP_ID={client.app_id}")

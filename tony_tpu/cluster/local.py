@@ -78,26 +78,31 @@ class LocalProcessBackend(Backend):
 
     def kill_task(self, handle: object, grace_s: float = 0.0) -> None:
         proc = handle
-        if not isinstance(proc, _Proc) or proc.popen.poll() is not None:
+        if not isinstance(proc, _Proc):
             return
-        if proc.container:
+        if proc.container and proc.popen.poll() is None:
             # The containerized executor is containerd's child, not ours:
             # signal the container by name, then the docker-run client.
             docker_kill(proc.container, grace_s=grace_s)
-        try:
-            # Kill the whole process group (executor + user child).
-            os.killpg(proc.popen.pid, signal.SIGTERM)
-        except (ProcessLookupError, PermissionError):
-            return
-        deadline = time.time() + grace_s
-        while time.time() < deadline:
-            if proc.popen.poll() is not None:
-                return
-            time.sleep(0.05)
-        try:
-            os.killpg(proc.popen.pid, signal.SIGKILL)
-        except (ProcessLookupError, PermissionError):
-            pass
+        # The user command lives in its OWN session (utils/proc.execute_shell)
+        # — signalling the executor's group alone never reaches it. Deliver
+        # the TERM→grace→KILL ladder to both groups; the pgid file is how we
+        # reach the user tree even when the executor is already dead
+        # (constants.USER_PGID_FILE contract).
+        from tony_tpu import constants
+        from tony_tpu.utils.proc import kill_process_groups, read_pgid_file
+
+        groups = [proc.popen.pid] if proc.popen.poll() is None else []
+        if not proc.container:
+            # Containerized tasks: user.pgid holds a pid from the
+            # container's OWN pid namespace — meaningless (and dangerous to
+            # signal) on the host; docker_kill above reaps the in-container
+            # tree instead.
+            user_pgid = read_pgid_file(
+                os.path.join(proc.workdir, constants.USER_PGID_FILE))
+            if user_pgid:
+                groups.append(user_pgid)
+        kill_process_groups(groups, grace_s=grace_s)
 
     def poll_completions(self) -> List[Tuple[str, int]]:
         done: List[Tuple[str, int]] = []

@@ -84,7 +84,7 @@ class LocalSimHostChannel(HostChannel):
         self.host_id = host_id
         self.workroot = workroot
         self._alive = True
-        self._procs: List[subprocess.Popen] = []
+        self._handles: List[dict] = []
         self._lock = threading.Lock()
 
     def exec_task(self, task_id, argv, env, workdir):
@@ -101,26 +101,32 @@ class LocalSimHostChannel(HostChannel):
         popen = subprocess.Popen(
             list(argv), cwd=workdir, env=full_env, stdout=stdout,
             stderr=stderr, start_new_session=True)
+        handle = {"popen": popen, "workdir": workdir}
         with self._lock:
-            self._procs.append(popen)
-        return {"popen": popen, "workdir": workdir}
+            self._handles.append(handle)
+        return handle
+
+    @staticmethod
+    def _task_groups(handle) -> List[int]:
+        """Process groups of one task: the executor's (while alive), plus
+        the user command's own session read from the pgid file the executor
+        wrote (constants.USER_PGID_FILE) — the only route to the user tree
+        once the executor is gone."""
+        from tony_tpu import constants
+        from tony_tpu.utils.proc import read_pgid_file
+
+        popen = handle["popen"]
+        groups = [popen.pid] if popen.poll() is None else []
+        user_pgid = read_pgid_file(
+            os.path.join(handle["workdir"], constants.USER_PGID_FILE))
+        if user_pgid:
+            groups.append(user_pgid)
+        return groups
 
     def kill(self, handle, grace_s: float = 0.0) -> None:
-        popen = handle["popen"]
-        if popen.poll() is not None:
-            return
-        try:
-            os.killpg(popen.pid, signal.SIGTERM)
-        except (ProcessLookupError, PermissionError):
-            return
-        deadline = time.time() + grace_s
-        while time.time() < deadline and popen.poll() is None:
-            time.sleep(0.05)
-        if popen.poll() is None:
-            try:
-                os.killpg(popen.pid, signal.SIGKILL)
-            except (ProcessLookupError, PermissionError):
-                pass
+        from tony_tpu.utils.proc import kill_process_groups
+
+        kill_process_groups(self._task_groups(handle), grace_s=grace_s)
 
     def poll(self, handle) -> Optional[int]:
         # A task that FINISHED before the host died keeps its real exit
@@ -142,15 +148,15 @@ class LocalSimHostChannel(HostChannel):
                 os.path.join(wd, "stderr.log"))
 
     def simulate_loss(self) -> None:
-        """The host 'disappears': every process on it dies instantly and
-        the channel reports dead."""
+        """The host 'disappears': every process on it — executor AND its
+        user session — dies instantly and the channel reports dead."""
         self._alive = False
         with self._lock:
-            procs = list(self._procs)
-        for p in procs:
-            if p.poll() is None:
+            handles = list(self._handles)
+        for h in handles:
+            for pg in self._task_groups(h):
                 try:
-                    os.killpg(p.pid, signal.SIGKILL)
+                    os.killpg(pg, signal.SIGKILL)
                 except (ProcessLookupError, PermissionError):
                     pass
 
@@ -211,24 +217,33 @@ class SshHostChannel(HostChannel):
                 k.wait(timeout=15 + grace_s)
             except subprocess.TimeoutExpired:
                 k.kill()
-        sig = "TERM"
-        for attempt in range(2):
+        # Two groups per task: the remote executor's (task.pid, written by
+        # the launch wrapper) and — for non-containerized tasks — the user
+        # command's own session (user.pgid, written by the executor; the
+        # only route to the user tree if the executor already died). A
+        # container's user.pgid is a pid in the container's namespace and
+        # must NOT be signalled on the host; docker stop above reaps it.
+        files = "task.pid" if handle.get("container") else "task.pid user.pgid"
+        for sig in ("TERM", "KILL"):
             k = self._ssh(
-                f"test -f {wd}/task.pid && kill -{sig} -$(cat {wd}/task.pid)"
-                " 2>/dev/null || true",
+                f"for f in {files}; do "
+                f"test -f {wd}/$f && kill -{sig} -$(cat {wd}/$f); "
+                f"done 2>/dev/null; true",
                 stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
             try:
                 k.wait(timeout=15)
             except subprocess.TimeoutExpired:
                 k.kill()
-            if attempt == 0:
+            if sig == "TERM":
+                # Grace window; the local ssh client exiting early just
+                # shortens the wait. The KILL rung always runs: the
+                # executor's ssh client being gone says nothing about the
+                # USER group (the dead-executor case is exactly when the
+                # pgid file matters), and KILL on dead groups is a no-op.
                 deadline = time.time() + grace_s
                 while (time.time() < deadline
                        and handle["popen"].poll() is None):
                     time.sleep(0.1)
-                if handle["popen"].poll() is not None:
-                    return
-                sig = "KILL"
 
     def poll(self, handle) -> Optional[int]:
         rc = handle["popen"].poll()
@@ -434,13 +449,25 @@ class TpuSliceBackend(Backend):
         return self.lease
 
     def _maybe_test_fail_host(self) -> None:
-        """TEST_SLICE_FAIL_HOST hook (see constants.py): once per job, after
-        the gang has had a moment to start, kill the named fake host."""
+        """TEST_SLICE_FAIL_HOST hook (see constants.py): once per job, kill
+        the named fake host. Bare ``host`` form: a short post-launch delay.
+        ``host#<glob>`` form: only once the glob matches an existing path —
+        condition-triggered, so "preempt AFTER the first checkpoint is
+        durable" is deterministic instead of a race against the victim's
+        startup (a 0.7 s timer loses to a JAX import every time)."""
+        import glob as globmod
+
         from tony_tpu import constants
         target = os.environ.get(constants.TEST_SLICE_FAIL_HOST, "")
         if not target or self._test_fail_done or self.lease is None:
             return
-        if not self._tasks or time.monotonic() - self._last_launch < 0.7:
+        if not self._tasks:
+            return
+        target, _, condition = target.partition("#")
+        if condition:
+            if not globmod.glob(condition):
+                return
+        elif time.monotonic() - self._last_launch < 0.7:
             return
         for h in self.lease.hosts:
             if h.host_id == target and hasattr(h, "simulate_loss"):

@@ -121,14 +121,32 @@ TEST_COMPLETION_DELAY = "TONY_TEST_COMPLETION_DELAY"
 # the coordinator-side registration timeout is exercisable E2E; reference
 # registration timeout, ApplicationMaster.java:791-888).
 TEST_SKIP_REGISTRATION = "TONY_TEST_SKIP_REGISTRATION"
-# "<host_id>" — the TpuSliceBackend simulates sudden loss of that host
-# (preemption/hardware death) shortly after the gang launches, once per job
-# (fake provisioner only; exercises slice-lease invalidation → retry).
+# "<host_id>" or "<host_id>#<path-glob>" — the TpuSliceBackend simulates
+# sudden loss of that host (preemption/hardware death), once per job (fake
+# provisioner only; exercises slice-lease invalidation → retry). The bare
+# form fires on a short post-launch delay; the "#<glob>" form fires only
+# once the glob matches an existing path — e.g. a durably committed
+# checkpoint step — making preemption-AFTER-checkpoint deterministic
+# (reference uses deterministic env-hook faults, Constants.java:116-121).
 TEST_SLICE_FAIL_HOST = "TONY_TEST_SLICE_FAIL_HOST"
 
 # Untracked jobtypes: run-forever tasks (parameter servers) whose exit does not
 # gate job completion (reference TonyConfigurationKeys.java:252-253).
 DEFAULT_UNTRACKED_JOBTYPES = (PS_JOB_NAME,)
+
+# ---------------------------------------------------------------------------
+# Kill-chain contract. YARN reaps the whole container process tree for free;
+# without a NodeManager the supervisors here must reach the user tree
+# themselves (reference stop-with-grace: ApplicationMaster.java:694-711).
+# ---------------------------------------------------------------------------
+# File (relative to a task's working dir) holding the process-group id of
+# the USER command. The executor writes it the moment the user process
+# starts, so backends can deliver the TERM→grace→KILL ladder to the user
+# tree directly — an executor that was SIGKILLed can forward nothing.
+USER_PGID_FILE = "user.pgid"
+# Seconds the executor waits after forwarding SIGTERM to the user process
+# group before escalating to SIGKILL (env override; default 5).
+TASK_KILL_GRACE_ENV = "TONY_TASK_KILL_GRACE_S"
 
 # Exit codes (reference common/TaskStatus semantics, TonySession.java:480-497).
 EXIT_SUCCESS = 0

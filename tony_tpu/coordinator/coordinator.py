@@ -494,9 +494,38 @@ class Coordinator:
                 env[k] = v
         self.events.emit(Event(EventType.TASK_STARTED, {
             "task": "coordinator:0", "session_id": 0}))
-        code = procutil.execute_shell(
-            cmd, timeout_s=self.conf.get_int(
-                K.TASK_EXECUTOR_EXECUTION_TIMEOUT_S, 0), env=env)
+        # The command blocks this thread, but force_kill arrives on the RPC
+        # thread as _stop_requested — a watcher delivers the TERM→grace→KILL
+        # ladder to the child's process group so a killed notebook/preprocess
+        # job cannot orphan its server (reference stops preprocessing with
+        # the AM teardown, ApplicationMaster.java:714-766 + :694-711).
+        child: List[object] = []
+        done = threading.Event()
+
+        def _stop_watcher() -> None:
+            while not done.wait(0.2):
+                if self._stop_requested.is_set():
+                    if not child:
+                        # Stop arrived before on_start registered the
+                        # child — keep polling; returning here would leave
+                        # the about-to-spawn process unkillable.
+                        continue
+                    procutil.kill_process_groups(
+                        [child[0].pid],
+                        grace_s=self.conf.get_int(
+                            K.COORDINATOR_STOP_GRACE_S, 15))
+                    return
+
+        watcher = threading.Thread(target=_stop_watcher,
+                                   name="local-job-stop-watcher", daemon=True)
+        watcher.start()
+        try:
+            code = procutil.execute_shell(
+                cmd, timeout_s=self.conf.get_int(
+                    K.TASK_EXECUTOR_EXECUTION_TIMEOUT_S, 0), env=env,
+                on_start=lambda p: child.append(p))
+        finally:
+            done.set()
         self.events.emit(Event(EventType.TASK_FINISHED, {
             "task": "coordinator:0", "exit_code": code,
             "status": "SUCCEEDED" if code == 0 else "FAILED",

@@ -15,6 +15,7 @@ from __future__ import annotations
 
 import logging
 import os
+import signal
 import socket
 import sys
 import threading
@@ -31,6 +32,40 @@ from tony_tpu.runtimes.base import TaskIdentity, get_runtime
 from tony_tpu.utils import proc as procutil
 
 log = logging.getLogger(__name__)
+
+# The running user command's Popen, for the signal forwarder (the user
+# process lives in its own session — see utils/proc.execute_shell — so a
+# TERM aimed at the executor's group does not reach it on its own).
+_user_proc: list = []
+
+
+def _forward_signal(signum, frame) -> None:
+    """Deliver the executor's TERM/INT to the user process group, with a
+    KILL escalation timer, then let run() finish its teardown (monitor
+    stop, result report) while the user command dies. The TERM-grace-KILL
+    contract is what lets in-process checkpoint-on-preemption handlers run
+    (reference grace: ApplicationMaster.java:694-711)."""
+    p = _user_proc[0] if _user_proc else None
+    if p is None or p.poll() is not None:
+        # No user process to protect — die like a default handler would.
+        raise SystemExit(128 + signum)
+    log.warning("executor got signal %d; forwarding to user pgid %d",
+                signum, p.pid)
+    try:
+        os.killpg(p.pid, signal.SIGTERM)
+    except (ProcessLookupError, PermissionError):
+        return
+    grace = float(os.environ.get(constants.TASK_KILL_GRACE_ENV, "5") or 5)
+
+    def _escalate():
+        if p.poll() is None:
+            try:
+                os.killpg(p.pid, signal.SIGKILL)
+            except (ProcessLookupError, PermissionError):
+                pass
+    t = threading.Timer(grace, _escalate)
+    t.daemon = True
+    t.start()
 
 
 class Heartbeater(threading.Thread):
@@ -251,13 +286,28 @@ class TaskExecutor:
         # rely on).
         monitor._pid_fn = os.getpid
         monitor.start()
+
+        def _on_user_start(p) -> None:
+            # Publish the user pgid: in-process for the signal forwarder,
+            # on disk for backends that must reap the user tree even after
+            # this executor is SIGKILLed (constants.USER_PGID_FILE).
+            _user_proc[:] = [p]
+            try:
+                with open(os.path.join(os.getcwd(),
+                                       constants.USER_PGID_FILE), "w") as f:
+                    f.write(str(p.pid))
+            except OSError as e:
+                log.warning("could not write %s: %s",
+                            constants.USER_PGID_FILE, e)
+
         try:
             exit_code = procutil.execute_shell(
                 self.command,
                 timeout_s=self.conf.get_int(
                     K.TASK_EXECUTOR_EXECUTION_TIMEOUT_S, 0),
-                env=env)
+                env=env, on_start=_on_user_start)
         finally:
+            _user_proc[:] = []
             monitor.stop()
             if self.rendezvous_port.reuse:
                 self.rendezvous_port.release()
@@ -355,6 +405,8 @@ def main() -> int:
     logging.basicConfig(
         level=logging.INFO,
         format="%(asctime)s %(levelname)s %(name)s: %(message)s")
+    signal.signal(signal.SIGTERM, _forward_signal)
+    signal.signal(signal.SIGINT, _forward_signal)
     executor = TaskExecutor()
     code = executor.run()
     return code

@@ -12,14 +12,63 @@ from typing import Callable, Dict, Optional
 log = logging.getLogger(__name__)
 
 
+def kill_process_groups(pgids, grace_s: float = 0.0) -> None:
+    """TERM → grace → KILL for one or more process groups. The building
+    block of the teardown contract (reference stops containers with grace,
+    ``ApplicationMaster.java:694-711``, and YARN's NM then reaps the whole
+    container tree — with no NM, supervisors here must do the reaping).
+
+    Safe on already-dead groups (ProcessLookupError = nothing left) and on
+    pgids we cannot signal (PermissionError = not ours, e.g. after a
+    pid-reuse race — skip rather than kill a stranger)."""
+    alive = set()
+    for pg in pgids:
+        if not pg or pg <= 0:
+            continue
+        try:
+            os.killpg(pg, signal.SIGTERM)
+            alive.add(pg)
+        except (ProcessLookupError, PermissionError):
+            pass
+    deadline = time.monotonic() + grace_s
+    while alive and time.monotonic() < deadline:
+        for pg in list(alive):
+            try:
+                os.killpg(pg, 0)
+            except (ProcessLookupError, PermissionError):
+                alive.discard(pg)
+        if alive:
+            time.sleep(0.05)
+    for pg in alive:
+        try:
+            os.killpg(pg, signal.SIGKILL)
+        except (ProcessLookupError, PermissionError):
+            pass
+
+
+def read_pgid_file(path: str) -> int:
+    """Process-group id from a pidfile (``user.pgid`` contract —
+    constants.USER_PGID_FILE); 0 when absent/corrupt."""
+    try:
+        with open(path) as f:
+            return int(f.read().strip() or 0)
+    except (OSError, ValueError):
+        return 0
+
+
 def execute_shell(command: str, timeout_s: float = 0,
                   env: Optional[Dict[str, str]] = None,
                   cwd: Optional[str] = None,
                   on_start: Optional[Callable[[subprocess.Popen], None]] = None,
                   ) -> int:
     """Run a shell command, inheriting stdout/stderr (container logs pattern,
-    ``ApplicationMaster.java:1145-1147``). Returns the exit code; a timeout
-    kills the whole process group and returns 137.
+    ``ApplicationMaster.java:1145-1147``). Returns the exit code (128+N for
+    death by signal N); a timeout kills the whole process group and returns
+    137. The command runs in its OWN session/process group so a supervisor
+    can signal the user tree without shooting itself — the group id (=child
+    pid) is observable via ``on_start`` and must be reaped by the caller's
+    teardown (see ``kill_process_groups``); any stragglers the command
+    leaves in its group are reaped here after it exits.
 
     The reference unsets MALLOC_ARENA_MAX before exec (``Utils.java:312``) —
     a YARN-ism we do not need; we instead leave JAX/XLA env untouched so
@@ -35,18 +84,20 @@ def execute_shell(command: str, timeout_s: float = 0,
     if on_start:
         on_start(proc)
     try:
-        return proc.wait(timeout=timeout_s or None)
+        rc = proc.wait(timeout=timeout_s or None)
+        return 128 - rc if rc < 0 else rc
     except subprocess.TimeoutExpired:
         log.error("command timed out after %ss; killing process group",
                   timeout_s)
-        try:
-            os.killpg(proc.pid, signal.SIGTERM)
-            time.sleep(1)
-            os.killpg(proc.pid, signal.SIGKILL)
-        except ProcessLookupError:
-            pass
+        kill_process_groups([proc.pid], grace_s=1.0)
         proc.wait()
         return 137
+    finally:
+        # The shell may have backgrounded children that survive its exit
+        # (sh -c "serve.py &"); they share its group — reap them so no
+        # user process outlives its supervisor. Free when the group is
+        # already empty (first killpg raises ProcessLookupError).
+        kill_process_groups([proc.pid], grace_s=0.5)
 
 
 def poll_till_non_null(fn: Callable[[], Optional[object]],
