@@ -1,0 +1,197 @@
+"""In-process GCS JSON-API server: the wire-level test double for the REAL
+``GcsStore`` client (``TONY_GCS_ENDPOINT`` points at it).
+
+Implements the slice of the API the client speaks — media download
+(``alt=media``), media + resumable uploads (308/Range protocol), paginated
+object listing with ``prefix``/``delimiter``/``pageToken`` — plus knobs that
+force the failure modes the client must survive: small page sizes (exercise
+pagination), injected 503s (exercise retry), tiny resumable chunk acks
+(exercise watermark resume), and bearer-token enforcement (exercise
+StoreAuthError mapping). Unlike ``FakeGcsStore`` (which swaps in behind the
+Store interface), this double tests the client's REQUESTS."""
+
+from __future__ import annotations
+
+import json
+import re
+import threading
+import uuid
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Dict, Optional
+from urllib.parse import parse_qs, unquote, urlparse
+
+
+class GcsFakeServer:
+    def __init__(self, require_token: str = "", page_size: int = 1000,
+                 fail_first_n: int = 0, resumable_ack_bytes: int = 0,
+                 resumable_no_range_once: bool = False):
+        self.objects: Dict[str, Dict[str, bytes]] = {}   # bucket -> key -> b
+        self.require_token = require_token
+        self.page_size = page_size          # server-side cap on maxResults
+        self.fail_first_n = fail_first_n    # 503 the first N requests
+        self.resumable_ack_bytes = resumable_ack_bytes  # partial-ack size
+        # once: 308 with NO Range header and nothing persisted (the
+        # protocol's "zero bytes received" case — client must resend)
+        self.resumable_no_range_once = resumable_no_range_once
+        self.sessions: Dict[str, dict] = {}
+        self.request_count = 0
+        self.lock = threading.Lock()
+        server = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a):
+                pass
+
+            # -- helpers ------------------------------------------------
+            def _send(self, code: int, body: bytes = b"",
+                      headers: Optional[Dict[str, str]] = None):
+                self.send_response(code)
+                for k, v in (headers or {}).items():
+                    self.send_header(k, v)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def _jsend(self, code: int, obj: dict):
+                self._send(code, json.dumps(obj).encode(),
+                           {"Content-Type": "application/json"})
+
+            def _gate(self) -> bool:
+                with server.lock:
+                    server.request_count += 1
+                    if server.fail_first_n > 0:
+                        server.fail_first_n -= 1
+                        self._send(503, b"flaky")
+                        return False
+                if server.require_token:
+                    auth = self.headers.get("Authorization", "")
+                    if auth != f"Bearer {server.require_token}":
+                        self._send(401 if not auth else 403, b"denied")
+                        return False
+                return True
+
+            def _read_body(self) -> bytes:
+                n = int(self.headers.get("Content-Length", "0") or 0)
+                return self.rfile.read(n) if n else b""
+
+            # -- GET: download / metadata / list ------------------------
+            def do_GET(self):
+                if not self._gate():
+                    return
+                u = urlparse(self.path)
+                q = {k: v[0] for k, v in parse_qs(u.query).items()}
+                m = re.match(r"^/storage/v1/b/([^/]+)/o/(.+)$", u.path)
+                if m:
+                    bucket, key = unquote(m.group(1)), unquote(m.group(2))
+                    data = server.objects.get(bucket, {}).get(key)
+                    if data is None:
+                        return self._jsend(404, {"error": "notFound"})
+                    if q.get("alt") == "media":
+                        return self._send(200, data)
+                    return self._jsend(200, {"name": key,
+                                             "size": str(len(data))})
+                m = re.match(r"^/storage/v1/b/([^/]+)/o$", u.path)
+                if m:
+                    return self._list(unquote(m.group(1)), q)
+                self._send(404)
+
+            def _list(self, bucket: str, q: dict):
+                prefix = q.get("prefix", "")
+                delim = q.get("delimiter", "")
+                page = min(int(q.get("maxResults", "1000")),
+                           server.page_size)
+                keys = sorted(k for k in server.objects.get(bucket, {})
+                              if k.startswith(prefix))
+                items, prefixes, seen = [], [], set()
+                for k in keys:
+                    rest = k[len(prefix):]
+                    if delim and delim in rest:
+                        p = prefix + rest.split(delim, 1)[0] + delim
+                        if p not in seen:
+                            seen.add(p)
+                            prefixes.append(p)
+                    else:
+                        items.append(k)
+                entries = [("i", n) for n in items] + \
+                          [("p", p) for p in prefixes]
+                start = int(q.get("pageToken", "0") or 0)
+                out = entries[start:start + page]
+                resp = {
+                    "items": [{"name": n} for t, n in out if t == "i"],
+                    "prefixes": [p for t, p in out if t == "p"],
+                }
+                if start + page < len(entries):
+                    resp["nextPageToken"] = str(start + page)
+                self._jsend(200, resp)
+
+            # -- POST: uploads -----------------------------------------
+            def do_POST(self):
+                if not self._gate():
+                    return
+                u = urlparse(self.path)
+                q = {k: v[0] for k, v in parse_qs(u.query).items()}
+                m = re.match(r"^/upload/storage/v1/b/([^/]+)/o$", u.path)
+                if not m:
+                    return self._send(404)
+                bucket, key = unquote(m.group(1)), unquote(q.get("name", ""))
+                body = self._read_body()
+                if q.get("uploadType") == "media":
+                    server.objects.setdefault(bucket, {})[key] = body
+                    return self._jsend(200, {"name": key})
+                if q.get("uploadType") == "resumable":
+                    sid = uuid.uuid4().hex
+                    server.sessions[sid] = {"bucket": bucket, "key": key,
+                                            "data": b""}
+                    return self._send(200, b"", {
+                        "Location": f"http://{self.headers['Host']}"
+                                    f"/upload/session/{sid}"})
+                self._send(400)
+
+            def do_PUT(self):
+                if not self._gate():
+                    return
+                u = urlparse(self.path)
+                m = re.match(r"^/upload/session/([0-9a-f]+)$", u.path)
+                if not m or m.group(1) not in server.sessions:
+                    return self._send(404)
+                sess = server.sessions[m.group(1)]
+                body = self._read_body()
+                if server.resumable_no_range_once:
+                    server.resumable_no_range_once = False
+                    return self._send(308)   # nothing persisted, no Range
+                cr = self.headers.get("Content-Range", "")
+                m2 = re.match(r"bytes (\d+)-(\d+)/(\d+)", cr)
+                if not m2:
+                    return self._send(400)
+                start, end, total = (int(m2.group(i)) for i in (1, 2, 3))
+                committed = len(sess["data"])
+                if start > committed:
+                    # client skipped ahead of the watermark — protocol error
+                    return self._send(400)
+                take = body[committed - start:]
+                if server.resumable_ack_bytes and \
+                        len(take) > server.resumable_ack_bytes:
+                    # Partial ack: pretend the connection dropped mid-chunk;
+                    # commit only a prefix and report the watermark via 308.
+                    take = take[:server.resumable_ack_bytes]
+                sess["data"] += take
+                committed = len(sess["data"])
+                if committed >= total:
+                    server.objects.setdefault(
+                        sess["bucket"], {})[sess["key"]] = sess["data"]
+                    return self._jsend(200, {"name": sess["key"]})
+                self._send(308, b"", {"Range": f"bytes=0-{committed - 1}"})
+
+        self._httpd = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+        self.port = self._httpd.server_port
+        self.endpoint = f"http://127.0.0.1:{self.port}"
+        self._thread = threading.Thread(target=self._httpd.serve_forever,
+                                        daemon=True)
+
+    def start(self) -> "GcsFakeServer":
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._httpd.shutdown()
+        self._httpd.server_close()

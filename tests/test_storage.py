@@ -54,10 +54,16 @@ def test_fake_gcs_roundtrip_and_trees(tmp_path, monkeypatch):
         s.get_file("gs://bucket/missing", str(tmp_path / "nope"))
 
 
-def test_fake_gcs_requires_root(monkeypatch):
+def test_gs_without_fake_root_is_the_real_client(monkeypatch):
+    """Selection rule: gs:// = real GcsStore in production; the FakeGcsStore
+    CI double only when TONY_FAKE_GCS_ROOT opts in (and constructing the
+    fake directly without a root still fails loudly)."""
+    from tony_tpu.storage import GcsStore
+
     monkeypatch.delenv("TONY_FAKE_GCS_ROOT", raising=False)
+    assert isinstance(get_store("gs://bucket/x"), GcsStore)
     with pytest.raises(ValueError, match="TONY_FAKE_GCS_ROOT"):
-        get_store("gs://bucket/x")
+        FakeGcsStore()
 
 
 def test_unknown_scheme_rejected():

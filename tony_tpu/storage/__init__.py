@@ -1,2 +1,3 @@
 from tony_tpu.storage.store import (  # noqa: F401
-    FakeGcsStore, LocalFsStore, Store, StoreAuthError, get_store, is_url)
+    FakeGcsStore, GcsStore, LocalFsStore, Store, StoreAuthError, get_store,
+    is_url)
