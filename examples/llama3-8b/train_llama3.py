@@ -110,8 +110,17 @@ if mgr is not None and mgr.latest_step() is not None:
     # one optimizer update per restart.
     start = int(mgr.latest_step()) + 1
 
+# Per-step utilization (steps/s, duty cycle, MFU) flows to TASK_FINISHED
+# metrics and the portal's /metrics view via the telemetry reporter — the
+# TPU analogue of per-container GPU util (TaskMonitor.java:116-170).
+from tony_tpu import telemetry
+
+n_params = sum(x.size for x in jax.tree.leaves(state.params))
+flops_per_step = 6 * n_params * BATCH * SEQ
 for i in range(start, STEPS):
-    state, l = step(state)
+    with telemetry.step(flops=flops_per_step, tokens=BATCH * SEQ):
+        state, l = step(state)
+        jax.block_until_ready(l)
     if mgr is not None:
         mgr.save(i, _ckpt_tree(state))
 if mgr is not None:

@@ -6,13 +6,31 @@ against host-local virtual devices so CI needs no hardware (SURVEY.md §4.1).
 """
 
 import os
+import signal
 import sys
 
+import pytest
+
 os.environ["JAX_PLATFORMS"] = "cpu"
+# The image's sitecustomize pre-imports jax + the TPU-tunnel PJRT plugin
+# into EVERY python process when this var is set (~2.9 s/process measured
+# — a 16-task gang e2e spent 80+ s on it alone). Tests are CPU-only by
+# design, so strip it from the env subprocesses inherit: executors, the
+# coordinator, CLI, and non-JAX user scripts start ~instantly, and JAX
+# user scripts get a plain CPU jax honouring JAX_PLATFORMS.
+os.environ.pop("PALLAS_AXON_POOL_IPS", None)
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8").strip()
+
+# Persistent XLA compile cache shared across test processes and runs: the
+# compute-heavy files (models/ops/parallel/pipeline) are compile-dominated
+# on this 1-core box; warm-cache reruns measured ~20% faster. Safe to
+# share: keys include HLO + jax/XLA version.
+os.environ.setdefault("JAX_COMPILATION_CACHE_DIR",
+                      "/tmp/tony-tpu-test-jaxcache")
+os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "0.5")
 
 # Some images pre-import jax via sitecustomize and pin jax_platforms to the
 # real accelerator; the env var above is then too late. Override at the
@@ -21,6 +39,79 @@ if "xla_force_host_platform_device_count" not in flags:
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
+# Same sitecustomize-pre-import caveat as jax_platforms: the cache env
+# vars land too late for THIS process (subprocesses inherit them early
+# enough) — apply at the config level too.
+jax.config.update("jax_compilation_cache_dir",
+                  os.environ["JAX_COMPILATION_CACHE_DIR"])
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
 
 # Make `import tony_tpu` work no matter where pytest is invoked from.
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+# ---------------------------------------------------------------------------
+# Per-test watchdog (VERDICT r3 #7: the suite must be un-hangable).
+# No pytest-timeout plugin in this image, so a SIGALRM-based guard: a test
+# that exceeds its budget fails with a TimeoutError instead of wedging the
+# whole run (a round-3 full-suite run survived `timeout`'s SIGTERM for 6+
+# minutes inside a hung teardown). Override per test with
+# @pytest.mark.timeout_s(N). SIGALRM only fires in the main thread, which
+# is exactly where the blocking waits (subprocess.wait, Event.wait) live.
+# ---------------------------------------------------------------------------
+DEFAULT_TEST_TIMEOUT_S = 180
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers", "timeout_s(n): per-test watchdog budget in seconds")
+
+
+def _watchdog(item, phase):
+    marker = item.get_closest_marker("timeout_s")
+    budget = int(marker.args[0]) if marker else DEFAULT_TEST_TIMEOUT_S
+
+    def _alarm(signum, frame):
+        raise TimeoutError(
+            f"{item.nodeid} {phase} exceeded its {budget}s watchdog "
+            f"(conftest.py; raise with @pytest.mark.timeout_s)")
+
+    old = signal.signal(signal.SIGALRM, _alarm)
+    signal.alarm(budget)
+    return old
+
+
+def _disarm(old):
+    signal.alarm(0)
+    signal.signal(signal.SIGALRM, old)
+
+
+# Guard all three phases: the round-3 wedge was a HUNG TEARDOWN, so the
+# call phase alone would re-admit exactly the motivating failure. (Module-
+# scoped fixture setup shared by several tests gets the single budget of
+# the first test that triggers it — generous enough in practice.)
+@pytest.hookimpl(hookwrapper=True)
+def pytest_runtest_setup(item):
+    old = _watchdog(item, "setup")
+    try:
+        yield
+    finally:
+        _disarm(old)
+
+
+@pytest.hookimpl(hookwrapper=True)
+def pytest_runtest_call(item):
+    old = _watchdog(item, "call")
+    try:
+        yield
+    finally:
+        _disarm(old)
+
+
+@pytest.hookimpl(hookwrapper=True)
+def pytest_runtest_teardown(item):
+    old = _watchdog(item, "teardown")
+    try:
+        yield
+    finally:
+        _disarm(old)

@@ -19,6 +19,11 @@ jax.distributed.initialize(
     coordinator_address=os.environ["JAX_COORDINATOR_ADDRESS"],
     num_processes=int(os.environ["JAX_NUM_PROCESSES"]),
     process_id=int(os.environ["JAX_PROCESS_ID"]),
+    # Generous heartbeat budget: on a loaded 1-core CI box the peer
+    # process can be starved for tens of seconds; the default 100 s
+    # budget SIGABRTed the faster process once under a full serial
+    # suite run (exit 134).
+    heartbeat_timeout_seconds=300,
 )
 
 import jax.numpy as jnp

@@ -5,14 +5,26 @@ stats."""
 import os
 
 import jax
+
+# Honour the test substrate's CPU request: sitecustomize pre-imports jax
+# pinned to the real accelerator (axon), so the env var alone is too late
+# — without this update the script silently runs over the TPU tunnel
+# (10-30 s flaky init, e2e contention with real benchmark runs).
+if os.environ.get("JAX_PLATFORMS", "").strip().lower() == "cpu":
+    jax.config.update("jax_platforms", "cpu")
+
 import jax.numpy as jnp
 
 import tony_tpu  # noqa: F401  (starts the reporter: TONY_METRICS_FILE is set)
 from tony_tpu import telemetry
 
 x = jnp.ones((64, 64))
-y = (x @ x).sum()
-y.block_until_ready()
+# Step-timed compute: the utilization signal (steps/s, duty cycle, model
+# FLOP/s) that TASK_FINISHED metrics must carry end-to-end.
+for _ in range(3):
+    with telemetry.step(flops=2 * 64 ** 3, tokens=64):
+        y = (x @ x).sum()
+        y.block_until_ready()
 
 # Deterministic final snapshot (the 3 s reporter cadence may not have fired
 # for a task this short).

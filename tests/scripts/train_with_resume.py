@@ -15,6 +15,15 @@ import os
 import sys
 import time
 
+import jax
+
+# Honour the test substrate's CPU request: sitecustomize pre-imports jax
+# pinned to the real accelerator (axon), so the env var alone is too late
+# — without this update the script silently runs over the TPU tunnel
+# (10-30 s flaky init, e2e contention with real benchmark runs).
+if os.environ.get("JAX_PLATFORMS", "").strip().lower() == "cpu":
+    jax.config.update("jax_platforms", "cpu")
+
 import jax.numpy as jnp
 
 from tony_tpu.checkpoint import CheckpointManager

@@ -75,6 +75,34 @@ def test_round_robin_placement_and_host_env(tmp_path):
     assert ordinals == ["0", "0", "1", "1"]
     assert all(h.spec.env["TONY_HOST_ID"] == h.host.host_id
                for h in handles)
+    # libtpu multi-host topology env, derived from the lease: worker index
+    # within the slice + the full reachable host list (TaskExecutor.java
+    # :161-207 analogue — the framework env the slice itself determines).
+    assert [h.spec.env["TPU_WORKER_ID"] for h in handles] == \
+        ["0", "1", "0", "1"]
+    assert all(h.spec.env["TPU_WORKER_HOSTNAMES"]
+               == "fakehost-0,fakehost-1" for h in handles)
+
+
+def test_coordinator_pool_task_gets_no_tpu_topology_env(tmp_path):
+    """node-pool=coordinator tasks run OFF the slice (CPU jobtypes): they
+    must not inherit the slice's libtpu topology, and a job that set its
+    own TPU_WORKER_ID on a slice task wins over the backend."""
+    prov = FakeSliceProvisioner(2, str(tmp_path / "hosts"))
+    backend = TpuSliceBackend(prov, 2, str(tmp_path / "work"),
+                              python=sys.executable)
+    try:
+        off = _spec("ps:0")
+        off.node_pool = "coordinator"
+        h_off = backend.launch_task(off)
+        custom = _spec("worker:0")
+        custom.env["TPU_WORKER_ID"] = "7"
+        h_on = backend.launch_task(custom)
+    finally:
+        backend.stop()
+    assert "TPU_WORKER_ID" not in h_off.spec.env
+    assert "TPU_WORKER_HOSTNAMES" not in h_off.spec.env
+    assert h_on.spec.env["TPU_WORKER_ID"] == "7"   # user env wins
 
 
 def test_host_loss_reports_all_its_tasks(tmp_path):

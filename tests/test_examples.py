@@ -54,6 +54,23 @@ def test_example_scripts_run_on_virtual_mesh(tmp_path, example, script,
     assert "->" in r.stdout  # printed the loss trajectory
 
 
+def test_mnist_pytorch_ddp_example_submits_e2e(tmp_path):
+    """Reference tony-examples/mnist-pytorch parity: a real torch DDP gang
+    (gloo) rendezvousing purely from the PyTorchRuntime env — loss falls
+    and ranks end bit-identical (asserted inside the script)."""
+    r = subprocess.run(
+        [sys.executable, "-m", "tony_tpu.cli", "submit",
+         "--conf-file", "mnist.json",
+         "--conf", f"tony.history.location={tmp_path / 'history'}",
+         "--conf", "tony.worker.command="
+                   f"{sys.executable} mnist_ddp.py",
+         "--workdir", str(tmp_path / "work")],
+        cwd=os.path.join(EXAMPLES, "mnist-pytorch"), env=_env(tmp_path),
+        capture_output=True, text=True, timeout=300)
+    assert r.returncode == 0, f"stdout:{r.stdout}\nstderr:{r.stderr}"
+    assert "application finished: SUCCEEDED" in r.stdout
+
+
 def test_llama3_flagship_config_parses(tmp_path):
     from tony_tpu.conf.config import TonyTpuConfig
     from tony_tpu.conf import keys as K

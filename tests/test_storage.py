@@ -54,14 +54,11 @@ def test_fake_gcs_roundtrip_and_trees(tmp_path, monkeypatch):
         s.get_file("gs://bucket/missing", str(tmp_path / "nope"))
 
 
-def test_gs_without_fake_root_is_the_real_client(monkeypatch):
-    """Selection rule: gs:// = real GcsStore in production; the FakeGcsStore
-    CI double only when TONY_FAKE_GCS_ROOT opts in (and constructing the
-    fake directly without a root still fails loudly)."""
-    from tony_tpu.storage import GcsStore
-
+def test_fake_gcs_without_root_fails_loudly(monkeypatch):
+    """Constructing the CI fake without its backing root is an error (the
+    gs:// SELECTION rule — real client unless TONY_FAKE_GCS_ROOT — is
+    covered by the contract suite, test_storage_contract.py)."""
     monkeypatch.delenv("TONY_FAKE_GCS_ROOT", raising=False)
-    assert isinstance(get_store("gs://bucket/x"), GcsStore)
     with pytest.raises(ValueError, match="TONY_FAKE_GCS_ROOT"):
         FakeGcsStore()
 

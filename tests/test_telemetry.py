@@ -10,7 +10,9 @@ import pytest
 from tony_tpu import telemetry
 from tony_tpu.events import history
 from tony_tpu.executor.monitor import (AVG_MEMORY_BYTES, MAX_MEMORY_BYTES,
-                                       USER_DEVICE_COUNT, TaskMonitor)
+                                       MODEL_FLOPS_PER_SEC, STEP_DUTY_CYCLE,
+                                       STEPS_PER_SEC, USER_DEVICE_COUNT,
+                                       TaskMonitor)
 
 from test_e2e import _dump_task_logs, make_conf, submit
 
@@ -63,3 +65,28 @@ def test_e2e_task_finished_metrics_nonzero(tmp_path):
     assert metrics[MAX_MEMORY_BYTES] > 0, metrics
     assert metrics[AVG_MEMORY_BYTES] > 0, metrics
     assert metrics[USER_DEVICE_COUNT] >= 1, metrics
+    # Utilization derived from the user loop's telemetry.step() wrappers
+    # (VERDICT r3 #8): nonzero end-to-end through reporter → monitor →
+    # TASK_FINISHED.
+    assert metrics[STEPS_PER_SEC] > 0, metrics
+    assert 0 < metrics[STEP_DUTY_CYCLE] <= 1, metrics
+    assert metrics[MODEL_FLOPS_PER_SEC] > 0, metrics
+
+
+def test_step_stats_derivation():
+    """steps/s, duty cycle, and FLOP rate derive from step() windows."""
+    import time as _t
+
+    telemetry._steps.update(count=0, busy_s=0.0, flops=0.0, tokens=0.0,
+                            first_start=0.0, last_end=0.0)
+    for _ in range(3):
+        with telemetry.step(flops=1e6, tokens=10):
+            _t.sleep(0.02)
+        _t.sleep(0.01)   # idle between steps → duty < 1
+    s = telemetry.step_stats()
+    assert s["steps_completed"] == 3
+    assert s["steps_per_sec"] > 0
+    assert 0.3 < s["step_duty_cycle"] < 1.0
+    assert s["model_flops_per_sec"] > 0
+    assert s["tokens_per_sec"] > 0
+    assert s["mean_step_s"] >= 0.02

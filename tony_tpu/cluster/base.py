@@ -125,5 +125,13 @@ class Backend(abc.ABC):
         ``models/JobLog.java:69-80``)."""
         return None
 
+    def gang_active(self) -> bool:
+        """Any launched task still running? Backends with gang-scoped
+        resources (slice leases) override this so the coordinator's
+        epoch reset can wait for the old gang to be FULLY down before
+        relaunching — re-leasing under a live gang would split it across
+        slices (cluster/tpu.py lease invariant)."""
+        return False
+
     def stop(self) -> None:
         """Release backend resources."""

@@ -104,6 +104,14 @@ class LocalProcessBackend(Backend):
                 groups.append(user_pgid)
         kill_process_groups(groups, grace_s=grace_s)
 
+    def gang_active(self) -> bool:
+        """Any launched executor still alive? The coordinator's epoch
+        reset waits on this before relaunching (Backend.gang_active) so a
+        killed-but-unreaped task can't leak its exit into the new epoch."""
+        with self._lock:
+            return any(not p.reported and p.popen.poll() is None
+                       for p in self._procs.values())
+
     def poll_completions(self) -> List[Tuple[str, int]]:
         done: List[Tuple[str, int]] = []
         with self._lock:

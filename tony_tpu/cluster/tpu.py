@@ -24,9 +24,26 @@ Three layers:
   ``FakeSliceProvisioner`` simulates an inventory, including host **loss**
   mid-job (``fail_host``) and capacity denial, for the fault e2e matrix.
 - ``TpuSliceBackend`` — the ``Backend`` implementation: leases on first
-  launch, places tasks round-robin over the slice's hosts, exports
-  ``TONY_HOST_ID`` / per-host ``TPU_PROCESS_*`` ordinals, surfaces host
+  launch, places tasks round-robin over the slice's hosts, surfaces host
   loss as synthetic exit codes for every task on the lost host.
+
+Env contract exported per slice task (the analogue of the reference wiring
+each framework's rendezvous env, ``TaskExecutor.java:161-207``):
+
+- ``TONY_HOST_ID`` / ``TONY_HOST_LOCAL_ORDINAL`` — which slice host this
+  task landed on, and its per-host ordinal.
+- ``TPU_WORKER_ID`` / ``TPU_WORKER_HOSTNAMES`` — libtpu's multi-host
+  topology contract (worker index within the slice + the full host list),
+  derived from the lease. On real Cloud TPU VMs libtpu can also discover
+  these from the metadata server; exporting them makes the slice
+  self-describing where the MDS is absent (custom pools, tunnels). User
+  env wins: both are set only if the job didn't set them itself.
+
+JAX *process* rendezvous (``JAX_COORDINATOR_ADDRESS`` /
+``JAX_NUM_PROCESSES`` / ``JAX_PROCESS_ID``) is NOT a backend concern: it
+rides the coordinator's gang barrier and is exported by the JaxRuntime
+after registration (``runtimes/frameworks.py``), exactly because the
+rendezvous ports don't exist yet at launch time.
 """
 
 from __future__ import annotations
@@ -56,6 +73,12 @@ class HostChannel:
     """Exec/kill/poll on one host of a slice."""
 
     host_id: str
+
+    @property
+    def address(self) -> str:
+        """Hostname/IP peers on the slice can reach this host at (feeds
+        TPU_WORKER_HOSTNAMES). Default: the host id."""
+        return self.host_id
 
     def exec_task(self, task_id: str, argv: Sequence[str],
                   env: Dict[str, str], workdir: str) -> object:
@@ -181,6 +204,11 @@ class SshHostChannel(HostChannel):
                               "-o", "StrictHostKeyChecking=accept-new"])
         self.python = python
         self._alive_cache: Optional[Tuple[float, bool]] = None
+
+    @property
+    def address(self) -> str:
+        # ssh targets may carry a login user; peers need the bare host.
+        return self.ssh_target.rsplit("@", 1)[-1]
 
     def _ssh(self, remote_cmd: str, **popen_kw) -> subprocess.Popen:
         return subprocess.Popen(
@@ -408,13 +436,15 @@ class TpuSliceBackend(Backend):
         self._last_launch = 0.0
 
     # -- lease ---------------------------------------------------------
-    def _gang_active(self) -> bool:
+    def gang_active(self) -> bool:
         """Any launched task still running on a live host of the current
         lease? (Terminal = already reported, or poll() returns a code.)"""
         with self._lock:
             tasks = list(self._tasks.values())
         return any(not st.reported and st.host.poll(st.handle) is None
                    for st in tasks)
+
+    _gang_active = gang_active   # internal alias (used by _ensure_lease)
 
     def _ensure_lease(self) -> SliceLease:
         if self.lease is not None and self.lease.lost_hosts():
@@ -520,13 +550,22 @@ class TpuSliceBackend(Backend):
         # coordinator-local default — sys.executable is a path on THIS
         # machine and means nothing on a TPU VM.
         python = getattr(host, "python", None) or self.python
-        return self._exec_on(host, spec, local_ordinal, python=python)
+        return self._exec_on(host, spec, local_ordinal, python=python,
+                             lease=lease)
 
     def _exec_on(self, host: HostChannel, spec: TaskLaunchSpec,
-                 local_ordinal: int, python: str) -> "_SliceTask":
+                 local_ordinal: int, python: str,
+                 lease: Optional[SliceLease] = None) -> "_SliceTask":
         env = dict(spec.env)
         env["TONY_HOST_ID"] = host.host_id
         env["TONY_HOST_LOCAL_ORDINAL"] = str(local_ordinal)
+        if lease is not None:
+            # libtpu multi-host topology (see module docstring); job env
+            # wins when the user wired it explicitly.
+            env.setdefault("TPU_WORKER_ID",
+                           str(lease.hosts.index(host)))
+            env.setdefault("TPU_WORKER_HOSTNAMES",
+                           ",".join(h.address for h in lease.hosts))
         spec.env = env          # the spec records what actually ran
         workdir = os.path.join(self.workdir, host.host_id,
                                spec.task_id.replace(":", "_"))

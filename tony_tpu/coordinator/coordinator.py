@@ -591,12 +591,22 @@ class Coordinator:
         for t in self.session.all_tasks():
             if t.handle is not None and not t.status.terminal:
                 self.backend.kill_task(t.handle, grace_s=min(grace, 1))
-        # Drain exits so the new epoch's poll doesn't see stale completions.
-        deadline = time.time() + 5
+        # Wait for the old gang to be FULLY down, draining exits as they
+        # land. Breaking on the first empty poll is not enough: a killed
+        # task that hasn't exited yet polls as nothing-to-report, and
+        # relaunching while it lives trips the slice backend's
+        # one-gang-per-lease invariant ("lost hosts while its gang is
+        # still running") — a race observed under CI load.
+        deadline = time.time() + 10
         while time.time() < deadline:
-            if not self.backend.poll_completions():
+            self.backend.poll_completions()
+            if not self.backend.gang_active():
                 break
             time.sleep(0.1)
+        else:
+            log.warning("old gang still has live tasks after reset grace; "
+                        "relaunch may be refused by the backend")
+        self.backend.poll_completions()   # clear final stale completions
 
     def _stop(self) -> None:
         """Reference ``stop()`` :670-711 — stop running tasks with grace,

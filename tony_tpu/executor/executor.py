@@ -167,7 +167,10 @@ class TaskExecutor:
             # (reference kills stuck allocations after the timeout,
             # ``ApplicationMaster.java:791-888``).
             log.warning("TEST hook: skipping registration; sleeping")
-            time.sleep(timeout_s * 4)
+            # Outlive the coordinator's registration timeout but stay
+            # bounded: an unbounded multiple of a production-sized timeout
+            # left zombie sleepers wedging suite teardown (VERDICT r3 #7).
+            time.sleep(min(timeout_s * 4, 120))
             return None
 
         def attempt() -> Optional[dict]:

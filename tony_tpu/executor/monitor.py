@@ -24,6 +24,19 @@ AVG_MEMORY_BYTES = "AVG_MEMORY_BYTES"
 MAX_TPU_HBM_BYTES = "MAX_TPU_HBM_BYTES"
 AVG_TPU_HBM_BYTES = "AVG_TPU_HBM_BYTES"
 USER_DEVICE_COUNT = "USER_DEVICE_COUNT"
+# Utilization, derived in the user process by telemetry.step() wrappers
+# (the TPU stand-in for the reference's nvidia-smi duty-cycle sampling,
+# TaskMonitor.java:116-170): latest-value passthrough, not max/avg.
+STEPS_PER_SEC = "STEPS_PER_SEC"
+STEP_DUTY_CYCLE = "STEP_DUTY_CYCLE"
+MODEL_FLOPS_PER_SEC = "MODEL_FLOPS_PER_SEC"
+MFU = "MFU"
+_UTIL_PASSTHROUGH = {
+    STEPS_PER_SEC: "steps_per_sec",
+    STEP_DUTY_CYCLE: "step_duty_cycle",
+    MODEL_FLOPS_PER_SEC: "model_flops_per_sec",
+    MFU: "mfu_vs_peak_bf16",
+}
 
 
 def _proc_tree_rss_bytes(root_pid: int) -> int:
@@ -117,6 +130,9 @@ class TaskMonitor:
             self._metrics[USER_DEVICE_COUNT] = max(
                 self._metrics[USER_DEVICE_COUNT],
                 float(stats.get("device_count", 0) or 0))
+            for key, src in _UTIL_PASSTHROUGH.items():
+                if src in stats:
+                    self._metrics[key] = float(stats[src])
         if not hbm:
             hbm = tpu_hbm_in_use_bytes()
         self._samples += 1

@@ -291,8 +291,8 @@ def causal_lm_loss(logits: jax.Array, tokens: jax.Array,
 def chunked_causal_lm_loss(hidden: jax.Array, head_kernel: jax.Array,
                            tokens: jax.Array, chunk_size: int = 4096,
                            mask: Optional[jax.Array] = None,
-                           head_dtype: Optional[jnp.dtype] = None
-                           ) -> jax.Array:
+                           head_dtype: Optional[jnp.dtype] = None,
+                           seq_axis_name: str = "sp") -> jax.Array:
     """Next-token cross entropy without ever materializing [B, S, vocab].
 
     The long-context memory wall is not attention (flash streams it) but
@@ -313,18 +313,32 @@ def chunked_causal_lm_loss(hidden: jax.Array, head_kernel: jax.Array,
     (``preferred_element_type``), so equality there is to bf16-matmul
     tolerance, not bitwise.
 
-    Not sequence-parallel: under an ``sp`` shard_map the per-shard
+    Not sequence-parallel: under a sequence shard_map the per-shard
     sequence shift would misalign targets at shard boundaries, so this
     raises — compute hidden states inside the shard_map, gather, and take
-    the loss outside (or keep the loss on the full-logits path).
+    the loss outside (or keep the loss on the full-logits path). The guard
+    probes ``seq_axis_name`` (default ``"sp"``) — meshes with a custom
+    sequence axis name must pass it through, or the probe (which also
+    checks the other standard mesh axes — ``bound_axis_size`` raises on a
+    misnamed axis) cannot see the sharding.
     """
     from tony_tpu.ops.ring import bound_axis_size
 
-    if bound_axis_size("sp") is not None:
+    if bound_axis_size(seq_axis_name) is not None:
         raise ValueError(
-            "chunked_causal_lm_loss inside an sp shard_map would shift "
-            "targets per-shard (wrong at every shard boundary) and skip "
-            "the cross-shard mean; compute it outside the shard_map")
+            f"chunked_causal_lm_loss inside a {seq_axis_name!r} shard_map "
+            "would shift targets per-shard (wrong at every shard boundary) "
+            "and skip the cross-shard mean; compute it outside the "
+            "shard_map")
+    if hidden.shape[1] != tokens.shape[1]:
+        # A sequence mismatch is the signature of per-shard hidden states
+        # meeting full tokens (or vice versa) — the exact wrong-loss bug
+        # the shard_map guard exists to stop, caught even when the axis
+        # name didn't match the probe.
+        raise ValueError(
+            f"hidden seq {hidden.shape[1]} != tokens seq {tokens.shape[1]} "
+            "— per-shard hidden states with full-sequence tokens? Gather "
+            "hidden states before the loss (or pass seq_axis_name)")
     x = hidden[:, :-1]
     t = tokens[:, 1:]
     b, s, d = x.shape

@@ -97,7 +97,16 @@ def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array,
                    block_k: int = DEFAULT_BLOCK) -> jax.Array:
     """Per-shard ring attention ([B, S_local, H, D] in/out; GQA: K/V may
     carry H_kv heads with H_kv | H). Call inside shard_map with the
-    sequence dim sharded over ``axis_name``."""
+    sequence dim sharded over ``axis_name``.
+
+    Precision note (blockwise design tradeoff): each hop's partial output
+    leaves the flash kernel in the INPUT dtype (bf16 in production) and is
+    upcast to f32 only for the logsumexp merge — per-hop results are
+    rounded to bf16 before accumulation, so error grows ~linearly with the
+    number of hops (sp degree) at long context, unlike a formulation that
+    threads one f32 accumulator through every hop. Correctness tests pass
+    at f32; if bf16 ring error at high sp degree ever matters, have the
+    internal flash path return its f32 accumulator for this caller."""
     b, s_loc, h, d = q.shape
     hk = k.shape[2]
     if k.shape[2] != v.shape[2]:

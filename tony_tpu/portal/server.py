@@ -64,7 +64,14 @@ class PortalServer:
     def __init__(self, history_root: str, port: int = 0,
                  host: str = "127.0.0.1", mover_interval_s: float = 300.0,
                  purger_interval_s: float = 3600.0,
-                 retention_days: int = 30):
+                 retention_days: int = 30, token: str = ""):
+        # Optional bearer auth: with a token set, every request must carry
+        # "Authorization: Bearer <token>" or gets 401. The reference portal
+        # ran behind keytab-login Play infra (hadoop/Requirements.java:
+        # 24-70); a shared token is the TPU-native minimum for a portal
+        # that binds beyond localhost. TONY_PORTAL_TOKEN in `tony-tpu
+        # portal` / module main.
+        self.token = token
         self.history_root = history_root
         self.cache = _Cache()
         self._mover = history.HistoryFileMover(history_root)
@@ -120,6 +127,19 @@ class PortalServer:
 
     # -- routing ---------------------------------------------------------
     def _route(self, req: BaseHTTPRequestHandler) -> None:
+        if self.token:
+            import hmac as hmaclib
+
+            # Compare as bytes: compare_digest on str raises TypeError for
+            # non-ASCII (headers arrive latin-1-decoded), which would kill
+            # the request instead of 401ing; constant-time so the token
+            # can't be recovered from 401 latencies.
+            auth = req.headers.get("Authorization", "").encode(
+                "latin-1", "replace")
+            want = f"Bearer {self.token}".encode("latin-1", "replace")
+            if not hmaclib.compare_digest(auth, want):
+                return self._send(req, 401, "text/plain",
+                                  b"unauthorized (bearer token required)")
         parsed = urlparse(req.path)
         parts = [p for p in parsed.path.split("/") if p]
         as_json = parse_qs(parsed.query).get("format", [""])[0] == "json"
@@ -128,7 +148,7 @@ class PortalServer:
                 return self._jobs_index(req, as_json)
             view, *rest = parts
             if view in ("config", "jobs", "logs", "logfile",
-                        "profiles") and rest:
+                        "profiles", "metrics") and rest:
                 job_id = rest[0]
                 if view == "config":
                     return self._config_view(req, job_id, as_json)
@@ -140,6 +160,8 @@ class PortalServer:
                     return self._logfile_view(req, job_id, int(rest[1]))
                 if view == "profiles":
                     return self._profiles_view(req, job_id, as_json)
+                if view == "metrics":
+                    return self._metrics_view(req, job_id, as_json)
             self._send(req, 404, "text/plain", b"not found")
         except Exception as e:  # noqa: BLE001
             log.exception("portal error for %s", req.path)
@@ -219,6 +241,40 @@ class PortalServer:
             req, f"<h1>events — {html.escape(job_id)}</h1>"
                  f"<table border=1 cellpadding=4><tr><th>ts</th><th>type"
                  f"</th><th>payload</th></tr>{rows}</table>")
+
+    def _metrics_view(self, req, job_id: str, as_json: bool) -> None:
+        """Per-task final metrics from TASK_FINISHED events: memory/HBM
+        aggregates + the utilization signal (steps/s, duty cycle, MFU)
+        derived by telemetry.step() — the operator's one-stop 'is this job
+        actually using its chips' view (reference surfaced per-task GPU
+        util via TaskMonitor, TaskMonitor.java:116-170)."""
+        evs = self._events(job_id)
+        if evs is None:
+            return self._send(req, 404, "text/plain", b"unknown job")
+        tasks = [(e.payload.get("task", "?"), e.payload.get("metrics", {}))
+                 for e in evs if e.type == "TASK_FINISHED"]
+        if as_json:
+            return self._send_json(
+                req, [dict(task=t, metrics=m) for t, m in tasks])
+        cols = sorted({k for _, m in tasks for k in m})
+        head = "".join(f"<th>{html.escape(c)}</th>" for c in cols)
+        rows = "".join(
+            "<tr><td>" + html.escape(t) + "</td>" + "".join(
+                f"<td>{html.escape(self._fmt_metric(m.get(c)))}</td>"
+                for c in cols) + "</tr>"
+            for t, m in tasks)
+        self._send_html(
+            req, f"<h1>metrics — {html.escape(job_id)}</h1>"
+                 f"<table border=1 cellpadding=4><tr><th>task</th>{head}"
+                 f"</tr>{rows}</table>")
+
+    @staticmethod
+    def _fmt_metric(v) -> str:
+        if v is None:
+            return ""
+        if isinstance(v, float):
+            return f"{v:,.4g}"
+        return str(v)
 
     def _log_paths(self, job_id: str) -> List[Tuple[str, str]]:
         """(task, path) pairs from the job's own TASK_FINISHED events — the
@@ -314,6 +370,11 @@ def main(argv=None) -> int:
     p.add_argument("--history-root", required=True)
     p.add_argument("--port", type=int, default=None)
     p.add_argument("--host", default="0.0.0.0")
+    p.add_argument("--token", default=os.environ.get(
+        "TONY_PORTAL_TOKEN", ""),
+        help="require 'Authorization: Bearer <token>' on every request "
+             "(default: $TONY_PORTAL_TOKEN; empty = open — keep the bind "
+             "host local then)")
     args = p.parse_args(argv)
     conf = TonyTpuConfig()
     port = args.port if args.port is not None \
@@ -322,7 +383,8 @@ def main(argv=None) -> int:
         args.history_root, port=port, host=args.host,
         mover_interval_s=conf.get_int(K.HISTORY_MOVER_INTERVAL_S, 300),
         purger_interval_s=conf.get_int(K.HISTORY_PURGER_INTERVAL_S, 3600),
-        retention_days=conf.get_int(K.HISTORY_RETENTION_DAYS, 30))
+        retention_days=conf.get_int(K.HISTORY_RETENTION_DAYS, 30),
+        token=args.token)
     srv.start()
     log.info("portal serving %s at %s", args.history_root, srv.url)
     try:

@@ -13,16 +13,31 @@ channel ``rpc/MetricsRpc.java``). Differences, on purpose:
   moves kilobytes, not tensors — the data plane is XLA collectives over
   ICI/DCN, never this channel (SURVEY.md §2.4).
 - Optional shared-secret auth replaces the ClientToAMToken secret manager
-  (``ApplicationMaster.java:433-452``).
+  (``ApplicationMaster.java:433-452``) — but the secret itself NEVER
+  crosses the wire: with a token configured, every frame carries an
+  HMAC-SHA256 over (per-connection server nonce ‖ direction ‖ payload),
+  keyed by the token. That gives peer authentication, frame integrity,
+  and replay protection (the nonce binds frames to this connection; the
+  server additionally requires strictly increasing request ids), without
+  the cert-distribution burden of TLS on ephemeral TPU-VM gangs. What it
+  does NOT give is confidentiality — the control plane carries cluster
+  specs/metrics/exit codes, no secrets (the storage credential rides env,
+  never RPC; see storage/store.py).
 
-Frame format: 4-byte big-endian length, then a msgpack map.
-Request:  {"id": int, "method": str, "args": {...}, "token": str?}
-Response: {"id": int, "ok": bool, "result": any} or {"id", "ok": False, "error": str}
+Wire format: 4-byte big-endian length, then a msgpack map per frame.
+- hello (server → client, once per connection):
+    {"tony-rpc": 2, "nonce": bytes, "auth": bool}
+- signed frame: {"p": <inner msgpack bytes>, "m": <hmac>}; unsigned: {"p"}
+- inner request:  {"id": int, "method": str, "args": {...}}
+- inner response: {"id": int, "ok": bool, "result"| "error"}
 """
 
 from __future__ import annotations
 
+import hmac
+import hashlib
 import logging
+import os
 import socket
 import socketserver
 import struct
@@ -35,6 +50,8 @@ import msgpack
 log = logging.getLogger(__name__)
 
 _MAX_FRAME = 64 * 1024 * 1024
+_TO_SERVER = b"C"
+_TO_CLIENT = b"S"
 
 
 class RpcError(RuntimeError):
@@ -67,6 +84,34 @@ def _recv_frame(sock: socket.socket) -> Any:
     return msgpack.unpackb(_recv_exact(sock, length), raw=False)
 
 
+def _mac(token: str, nonce: bytes, direction: bytes, payload: bytes) -> bytes:
+    return hmac.new(token.encode(), nonce + direction + payload,
+                    hashlib.sha256).digest()
+
+
+def _send_signed(sock: socket.socket, obj: Any, token: Optional[str],
+                 nonce: bytes, direction: bytes) -> None:
+    inner = msgpack.packb(obj, use_bin_type=True)
+    frame: Dict[str, Any] = {"p": inner}
+    if token:
+        frame["m"] = _mac(token, nonce, direction, inner)
+    _send_frame(sock, frame)
+
+
+def _recv_signed(sock: socket.socket, token: Optional[str],
+                 nonce: bytes, direction: bytes) -> Any:
+    frame = _recv_frame(sock)
+    if not isinstance(frame, dict) or "p" not in frame:
+        raise RpcError("malformed frame (no payload)")
+    inner = frame["p"]
+    if token:
+        mac = frame.get("m")
+        if not isinstance(mac, (bytes, bytearray)) or not hmac.compare_digest(
+                mac, _mac(token, nonce, direction, inner)):
+            raise AuthError("bad or missing frame MAC")
+    return msgpack.unpackb(inner, raw=False)
+
+
 class RpcServer:
     """Threaded TCP server dispatching methods on a service object.
 
@@ -86,14 +131,44 @@ class RpcServer:
             def handle(self) -> None:  # one connection, many requests
                 sock = self.request
                 sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+                nonce = os.urandom(16)
+                try:
+                    _send_frame(sock, {"tony-rpc": 2, "nonce": nonce,
+                                       "auth": outer._token is not None})
+                except OSError:
+                    return
+                last_id = 0
                 while True:
                     try:
-                        req = _recv_frame(sock)
-                    except (ConnectionError, OSError):
+                        req = _recv_signed(sock, outer._token, nonce,
+                                           _TO_SERVER)
+                    except AuthError as e:
+                        # Unauthenticated peer: say why (signed, so a
+                        # legitimate client can distinguish bad-key from
+                        # network damage), then drop the connection.
+                        try:
+                            _send_signed(
+                                sock, {"id": 0, "ok": False,
+                                       "error": f"AuthError: {e}"},
+                                outer._token, nonce, _TO_CLIENT)
+                        except OSError:
+                            pass
                         return
-                    resp = outer._dispatch(req)
+                    except (RpcError, ConnectionError, OSError):
+                        return
+                    rid = req.get("id", 0) if isinstance(req, dict) else 0
+                    if outer._token is not None and rid <= last_id:
+                        # Replay of a captured frame (MAC valid, id seen):
+                        # the nonce pins frames to this connection, the id
+                        # ordering pins them to one use.
+                        resp = {"id": rid, "ok": False,
+                                "error": "AuthError: replayed request id"}
+                    else:
+                        last_id = max(last_id, rid)
+                        resp = outer._dispatch(req)
                     try:
-                        _send_frame(sock, resp)
+                        _send_signed(sock, resp, outer._token, nonce,
+                                     _TO_CLIENT)
                     except OSError:
                         return
 
@@ -107,8 +182,8 @@ class RpcServer:
     def _dispatch(self, req: Dict[str, Any]) -> Dict[str, Any]:
         rid = req.get("id", 0)
         try:
-            if self._token is not None and req.get("token") != self._token:
-                raise AuthError("invalid or missing auth token")
+            # Auth happened at the frame layer (_recv_signed MAC check);
+            # by the time a request reaches dispatch it is authentic.
             method = str(req.get("method", "")).replace(".", "__")
             if method.startswith("_"):
                 raise RpcError(f"no such method: {req.get('method')}")
@@ -166,14 +241,30 @@ class RpcClient:
         self._retry_sleep_s = retry_sleep_s
         self._connect_timeout_s = connect_timeout_s
         self._sock: Optional[socket.socket] = None
+        self._nonce: bytes = b""
         self._id = 0
         self._lock = threading.Lock()
 
     def _connect(self) -> socket.socket:
         sock = socket.create_connection(self._addr,
                                         timeout=self._connect_timeout_s)
-        sock.settimeout(None)
         sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        # The connect timeout stays armed through the hello read: a peer
+        # that accepts but never greets (wrong service, pre-v2 server)
+        # must error out, not deadlock the first call() forever.
+        try:
+            hello = _recv_frame(sock)
+        except (OSError, RpcError):
+            sock.close()
+            raise
+        sock.settimeout(None)
+        if not isinstance(hello, dict) or "nonce" not in hello:
+            sock.close()
+            raise RpcError("peer is not a tony-rpc v2 server (no hello)")
+        self._nonce = hello["nonce"]
+        # Request ids double as the anti-replay sequence and reset with
+        # each connection's fresh nonce.
+        self._id = 0
         return sock
 
     def call(self, method: str, **args: Any) -> Any:
@@ -185,16 +276,30 @@ class RpcClient:
                         self._sock = self._connect()
                     self._id += 1
                     req = {"id": self._id, "method": method, "args": args}
-                    if self._token is not None:
-                        req["token"] = self._token
-                    _send_frame(self._sock, req)
-                    resp = _recv_frame(self._sock)
+                    _send_signed(self._sock, req, self._token, self._nonce,
+                                 _TO_SERVER)
+                    # Response MAC proves the SERVER holds the secret too
+                    # (mutual auth); a mismatch raises AuthError and is
+                    # not retried.
+                    resp = _recv_signed(self._sock, self._token,
+                                        self._nonce, _TO_CLIENT)
+                    if self._token is not None and \
+                            resp.get("id") not in (self._id, 0):
+                        # Freshness: a recorded signed response from an
+                        # earlier request must not answer this one (id 0
+                        # = the server's pre-dispatch auth error frame).
+                        raise AuthError(
+                            f"response id {resp.get('id')} does not match "
+                            f"request {self._id} (replayed response?)")
                     if not resp.get("ok"):
                         err = resp.get("error", "unknown rpc error")
                         if err.startswith("AuthError"):
                             raise AuthError(err)
                         raise RpcError(err)
                     return resp.get("result")
+                except AuthError:
+                    self._close_locked()
+                    raise
                 except (ConnectionError, OSError) as e:
                     last_err = e
                     self._close_locked()

@@ -47,6 +47,7 @@ import json
 import os
 import shutil
 import time
+from http.client import HTTPException
 from typing import Dict, List, Optional, Tuple
 from urllib import error as urlerror
 from urllib import request as urlrequest
@@ -257,6 +258,11 @@ class GcsStore(Store):
         if env_tok:
             self._token, self._token_expiry = env_tok, float("inf")
             return self._token
+        if time.time() < getattr(self, "_anon_until", 0.0):
+            # Negative cache: off-GCP there is no metadata server, and
+            # paying its 5 s connect timeout per object would turn an
+            # N-object anonymous get_tree into N stalls.
+            return None
         try:
             req = urlrequest.Request(_METADATA_TOKEN_URL,
                                      headers={"Metadata-Flavor": "Google"})
@@ -266,7 +272,8 @@ class GcsStore(Store):
             self._token_expiry = time.time() + float(
                 body.get("expires_in", 300))
         except Exception:  # noqa: BLE001 — off-GCP: anonymous
-            self._token, self._token_expiry = None, time.time() + 300
+            self._token = None
+            self._anon_until = time.time() + 300
         return self._token
 
     # -- http ----------------------------------------------------------
@@ -286,7 +293,11 @@ class GcsStore(Store):
         memory)."""
         delay = self.backoff_s
         refreshed_auth = False
-        for attempt in range(self.retries + 1):
+        attempt = 0
+        # `attempt` counts RETRYABLE failures only; the single-shot auth
+        # refresh must not be able to exhaust the budget (a 401 on the
+        # last attempt previously fell through to an assertion).
+        while True:
             hdrs = dict(headers or {})
             tok = self._bearer()
             if tok:
@@ -322,14 +333,18 @@ class GcsStore(Store):
                 if e.code not in (408, 429) and e.code < 500:
                     raise
                 last = e
-            except urlerror.URLError as e:
+            except (urlerror.URLError, OSError, HTTPException) as e:
+                # OSError/HTTPException (not just URLError): a reset or
+                # truncated read can surface MID-BODY — from r.read() or
+                # the stream_to copy — and those long transfers are
+                # exactly where transient faults land.
                 last = e
-            if attempt == self.retries:
+            if attempt >= self.retries:
                 raise IOError(f"GCS {method} {url} failed after "
                               f"{self.retries + 1} attempts: {last}")
+            attempt += 1
             time.sleep(delay)
             delay *= 2
-        raise AssertionError("unreachable")
 
     def _obj_url(self, bucket: str, key: str, media: bool = False) -> str:
         return (f"{self.endpoint}/storage/v1/b/{quote(bucket, safe='')}"
@@ -367,8 +382,17 @@ class GcsStore(Store):
             raise IOError(f"resumable initiate for gs://{bucket}/{key} "
                           f"returned no session URI")
         offset = 0
+        stalled = 0
         with open(local_path, "rb") as f:
-            while offset < size:
+            while True:
+                if offset >= size:
+                    # Every byte acknowledged yet no 2xx finalize — a
+                    # nonconforming server; "success" here would leave no
+                    # object behind for executors to fetch.
+                    raise IOError(
+                        f"resumable upload of gs://{bucket}/{key}: server "
+                        f"acknowledged all {size} bytes but never "
+                        f"finalized the object")
                 f.seek(offset)
                 chunk = f.read(min(self.CHUNK, size - offset))
                 end = offset + len(chunk)
@@ -377,24 +401,41 @@ class GcsStore(Store):
                     headers={"Content-Range":
                              f"bytes {offset}-{end - 1}/{size}"},
                     ok=(200, 201, 308))
-                if status == 308:
-                    # Server's committed watermark; resume after it. A 308
-                    # WITHOUT a Range header means NOTHING was persisted
-                    # (per the protocol) — resend from the same offset,
-                    # never advance blindly.
-                    rng = hdrs.get("range", "")
-                    if "-" in rng:
-                        offset = int(rng.rsplit("-", 1)[1]) + 1
+                if status != 308:
+                    return          # 200/201: object finalized
+                # 308 = not finished; Range carries the server's committed
+                # watermark (ABSENT = zero bytes persisted — per the
+                # protocol, never advance blindly). Follow the watermark
+                # wherever it is, but bound non-progress: a server that
+                # never advances must become an error, not a spin.
+                rng = hdrs.get("range", "")
+                new_offset = (int(rng.rsplit("-", 1)[1]) + 1
+                              if "-" in rng else 0)
+                if new_offset > offset:
+                    stalled = 0
                 else:
-                    return
+                    stalled += 1
+                    if stalled > 3:
+                        raise IOError(
+                            f"resumable upload of gs://{bucket}/{key} "
+                            f"stalled at byte {offset}/{size} (no "
+                            f"watermark progress after {stalled} attempts)")
+                offset = new_offset
 
     def get_file(self, url: str, local_path: str) -> None:
         bucket, key = _split_gs(url)
         os.makedirs(os.path.dirname(os.path.abspath(local_path)),
                     exist_ok=True)
         tmp = local_path + ".tmp-dl"
-        self._request("GET", self._obj_url(bucket, key, media=True),
-                      stream_to=tmp)
+        try:
+            self._request("GET", self._obj_url(bucket, key, media=True),
+                          stream_to=tmp)
+        except BaseException:
+            try:
+                os.unlink(tmp)      # no half-downloaded leftovers
+            except OSError:
+                pass
+            raise
         os.replace(tmp, local_path)
 
     def exists(self, url: str) -> bool:
