@@ -224,3 +224,13 @@ def test_cli_history_and_events_commands(tmp_path, capsys):
     assert "APPLICATION_INITED" in out and "APPLICATION_FINISHED" in out
 
     assert main(["events", "app_nope", "--history-root", hist]) == 1
+    capsys.readouterr()
+
+    # `tony-tpu logs` — per-task stdout/stderr from TASK_FINISHED events
+    # (yarn logs analogue; JobLog.java:69-80)
+    assert main(["logs", rec.app_id, "--history-root", hist]) == 0
+    out = capsys.readouterr().out
+    assert "worker:0" in out and "stdout.log" in out
+    assert main(["logs", rec.app_id, "--task", "worker:9",
+                 "--history-root", hist]) == 1
+    assert main(["logs", "app_nope", "--history-root", hist]) == 1

@@ -118,11 +118,18 @@ def _cmd_kill(args: argparse.Namespace) -> int:
     return 0
 
 
+def _history_root(args: argparse.Namespace) -> str:
+    """One default for every history-reading subcommand — four diverging
+    copies would silently make history/events/logs/portal look in
+    different places."""
+    return args.history_root or os.path.join(_default_workdir(None),
+                                             "history")
+
+
 def _cmd_history(args: argparse.Namespace) -> int:
     from tony_tpu.events import history
 
-    root = args.history_root or os.path.join(_default_workdir(None),
-                                             "history")
+    root = _history_root(args)
     rows = history.list_jobs(root)
     if not rows:
         print(f"no job history under {root}")
@@ -138,8 +145,7 @@ def _cmd_history(args: argparse.Namespace) -> int:
 def _cmd_events(args: argparse.Namespace) -> int:
     from tony_tpu.events import history
 
-    root = args.history_root or os.path.join(_default_workdir(None),
-                                             "history")
+    root = _history_root(args)
     events = history.read_job_events(root, args.app_id)
     if events is None:
         print(f"no history for {args.app_id} under {root}", file=sys.stderr)
@@ -147,6 +153,61 @@ def _cmd_events(args: argparse.Namespace) -> int:
     for ev in events:
         print(ev)
     return 0
+
+
+def _cmd_logs(args: argparse.Namespace) -> int:
+    """Dump per-task stdout/stderr recorded in the job's TASK_FINISHED
+    events — the terminal analogue of `yarn logs -applicationId` (the
+    reference surfaced NodeManager log URLs per container,
+    ``models/JobLog.java:69-80``; here the paths live in the event
+    stream and the files on the submitting host's workdir)."""
+    from tony_tpu.events import history
+
+    root = _history_root(args)
+    events = history.read_job_events(root, args.app_id)
+    if events is None:
+        print(f"no history for {args.app_id} under {root}", file=sys.stderr)
+        return 1
+    shown = 0
+    for ev in events:
+        if ev.type != "TASK_FINISHED":
+            continue
+        task = ev.payload.get("task", "?")
+        if args.task and task != args.task:
+            continue
+        for path in ev.payload.get("logs", []):
+            print(f"===== {task} — {path} =====")
+            try:
+                with open(path, "r", encoding="utf-8",
+                          errors="replace") as f:
+                    sys.stdout.write(f.read())
+            except OSError as e:
+                # stderr, and NOT counted: purged/deleted logs must not
+                # let the command exit 0 having printed no content.
+                print(f"{task}: {path} unreadable: {e}", file=sys.stderr)
+                continue
+            shown += 1
+    if not shown:
+        print("no readable task logs" +
+              (f" for task {args.task}" if args.task else ""),
+              file=sys.stderr)
+        return 1
+    return 0
+
+
+def _cmd_portal(args: argparse.Namespace) -> int:
+    """Serve the history portal (shortcut for python -m tony_tpu.portal).
+    The CLI defaults to binding localhost: serving job history + raw task
+    logs to every interface is an explicit choice (--host 0.0.0.0), and
+    without a token it should stay local."""
+    from tony_tpu.portal.server import main as portal_main
+
+    argv = ["--history-root", _history_root(args), "--host", args.host]
+    if args.port is not None:
+        argv += ["--port", str(args.port)]
+    if args.token:
+        argv += ["--token", args.token]
+    return portal_main(argv)
 
 
 def build_parser() -> argparse.ArgumentParser:
@@ -197,6 +258,24 @@ def build_parser() -> argparse.ArgumentParser:
     e.add_argument("app_id")
     e.add_argument("--history-root")
     e.set_defaults(fn=_cmd_events)
+
+    lg = sub.add_parser("logs",
+                        help="dump a job's per-task logs (yarn logs "
+                             "analogue)")
+    lg.add_argument("app_id")
+    lg.add_argument("--task", help="only this task, e.g. worker:0")
+    lg.add_argument("--history-root")
+    lg.set_defaults(fn=_cmd_logs)
+
+    po = sub.add_parser("portal", help="serve the history web portal")
+    po.add_argument("--history-root")
+    po.add_argument("--port", type=int, default=None)
+    po.add_argument("--host", default="127.0.0.1",
+                    help="bind address (default localhost; widen only "
+                         "with --token set)")
+    po.add_argument("--token", default=os.environ.get(
+        "TONY_PORTAL_TOKEN", ""))
+    po.set_defaults(fn=_cmd_portal)
     return p
 
 
