@@ -199,7 +199,18 @@ class MoETransformer(nn.Module):
         x = nn.with_logical_constraint(x, ("batch", "seq", "embed"))
         block = MoEBlock
         if cfg.remat:
-            block = nn.remat(MoEBlock, prevent_cse=False)
+            # prevent_cse=True: layers are a Python loop, and with False
+            # XLA CSEs the recomputation away and silently un-remats the
+            # model (same defect found and measured in
+            # models/transformer.py; False is only sound inside
+            # scan/while bodies — see parallel/pipeline.py for the
+            # legitimate case). remat_policy is honoured like the dense
+            # transformer's.
+            import jax as _jax
+
+            policy = (getattr(_jax.checkpoint_policies, cfg.remat_policy)
+                      if cfg.remat_policy else None)
+            block = nn.remat(MoEBlock, prevent_cse=True, policy=policy)
         aux_total = jnp.zeros((), jnp.float32)
         for i in range(cfg.n_layers):
             x, aux = block(cfg, name=f"layer_{i}")(x, positions)
