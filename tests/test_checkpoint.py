@@ -97,3 +97,51 @@ def test_e2e_crash_resume_with_session_retry(tmp_path):
     # w starts [0,1,2,3]; doubled once per step → w[1] == 1·2⁴ regardless
     # of where the resume picked up
     assert float(w1) == 16.0
+
+
+def test_e2e_save_on_preemption_handler(tmp_path):
+    """The TERM-grace-KILL contract end to end: a force-killed job's
+    save-on-SIGTERM handler (install_preemption_handler) gets the grace
+    window and writes a durable checkpoint. The script makes NO periodic
+    saves, so any checkpoint present was written by the handler during
+    teardown — the zero-lost-steps preemption story the kill chain
+    exists for (reference stop-with-grace ApplicationMaster.java:694-711;
+    the reference itself has no checkpoint manager, SURVEY.md §5)."""
+    import threading
+    import time
+
+    from tony_tpu.conf import keys as K
+
+    from test_e2e import make_conf
+    from tony_tpu.client import TonyTpuClient
+
+    ready = tmp_path / "ready"
+    ckpt = tmp_path / "ckpt"
+    conf = make_conf(tmp_path, "train_save_on_preempt.py", workers=1, extra={
+        K.APPLICATION_CHECKPOINT_DIR: str(ckpt),
+        K.COORDINATOR_STOP_GRACE_S: 10,
+    })
+    conf.set(K.EXECUTION_ENV, f"TONY_TEST_READY_FILE={ready}")
+    client = TonyTpuClient(conf, workdir=str(tmp_path / "work"))
+    result = {}
+    t = threading.Thread(target=lambda: result.update(code=client.start()),
+                         daemon=True)
+    t.start()
+    try:
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline and not ready.exists():
+            if not t.is_alive():
+                raise AssertionError(
+                    f"submission died early: client.start() -> {result}")
+            time.sleep(0.1)
+        assert ready.exists(), "worker never reached step 3"
+    finally:
+        client.force_kill()
+        t.join(timeout=60)
+    assert not t.is_alive()
+    with CheckpointManager(str(ckpt), async_save=False) as mgr:
+        latest = mgr.latest_step()
+        assert latest is not None and latest >= 3, \
+            "no handler-written checkpoint survived the force-kill"
+    from procwatch import assert_no_orphans
+    assert_no_orphans(f"TONY_APP_ID={client.app_id}")

@@ -65,6 +65,52 @@ class CheckpointManager:
     def latest_step(self) -> Optional[int]:
         return self._mgr.latest_step()
 
+    def install_preemption_handler(self, snapshot, exit_code: int = 143
+                                   ) -> None:
+        """Save-on-SIGTERM: when the job is being torn down (force-kill,
+        epoch reset, slice teardown), synchronously save the state
+        ``snapshot()`` returns, then exit.
+
+        This is the consumer of the kill chain's TERM→grace→KILL contract
+        (executor forwards SIGTERM to the user process group and backends
+        honour a grace window — utils/proc.py, cluster/*): the handler
+        gets the grace to make one final durable save, so a resumed job
+        loses zero completed steps instead of rolling back to the last
+        periodic save. ``snapshot`` must return ``(step, state)`` and be
+        cheap to call from the main thread (it runs between Python
+        bytecodes — a jitted step in flight completes first).
+
+        Install from the MAIN thread of the training process. Exits with
+        ``exit_code`` (default 143 = 128+SIGTERM, what the supervisor
+        expects of a TERM'd task).
+        """
+        import signal
+        import sys
+
+        fired = {"done": False}
+
+        def _handler(signum, frame):
+            if fired["done"]:
+                # Teardown delivers TERM more than once (the executor
+                # forwards it AND the backend signals the user group
+                # directly); a re-entrant invocation mid-save would
+                # corrupt the in-flight orbax write ("Executor shutdown
+                # has been called") — first one wins, the rest no-op.
+                return
+            fired["done"] = True
+            try:
+                step, state = snapshot()
+                log.warning("SIGTERM: saving preemption checkpoint at "
+                            "step %s", step)
+                self.save(int(step), state, force=True)
+                self.wait()
+                log.warning("preemption checkpoint durable; exiting")
+            except Exception:  # noqa: BLE001 — still exit promptly
+                log.exception("preemption save failed")
+            sys.exit(exit_code)
+
+        signal.signal(signal.SIGTERM, _handler)
+
     def wait(self) -> None:
         """Block until queued async saves are durable (call before exit)."""
         self._mgr.wait_until_finished()

@@ -557,10 +557,13 @@ class Coordinator:
         while True:
             if self._stop_requested.is_set():
                 self.session.fail(self._stop_reason or "stop requested")
-                for t in self.session.all_tasks():
-                    if t.handle is not None and not t.status.terminal:
-                        self.backend.kill_task(t.handle, grace_s=0.0)
-                        self.session.mark_killed(t.task_id)
+                # TERM with the FULL configured grace (reference
+                # stop-with-grace, ApplicationMaster.java:694-711): a
+                # force-killed job's save-on-SIGTERM handlers
+                # (checkpoint/manager.install_preemption_handler) get the
+                # whole window to make the final save durable.
+                self._kill_all_tasks(
+                    self.conf.get_int(K.COORDINATOR_STOP_GRACE_S, 15))
                 return self.session.status
             if timeout_s and (time.monotonic() - self._schedule_start
                               > timeout_s):
@@ -586,11 +589,39 @@ class Coordinator:
                 return self.session.update_status()
             time.sleep(interval)
 
+    def _kill_all_tasks(self, grace_s: float,
+                        mark: str = "killed") -> None:
+        """TERM→grace→KILL every non-terminal task, CONCURRENTLY: each
+        kill_task blocks up to grace_s, and a serial loop would make
+        teardown latency N·grace — longer than the client is willing to
+        wait for the coordinator. One loop, one grace policy per call
+        site (the previous three hand-rolled copies had three different
+        caps, which is how the preemption-save window silently shrank to
+        2 s)."""
+        tasks = [t for t in self.session.all_tasks()
+                 if t.handle is not None and not t.status.terminal]
+        threads = [threading.Thread(
+            target=self.backend.kill_task, args=(t.handle,),
+            kwargs={"grace_s": grace_s}, daemon=True,
+            name=f"kill-{t.task_id}") for t in tasks]
+        for th in threads:
+            th.start()
+        for th in threads:
+            th.join(timeout=grace_s + 30)
+        for t in tasks:
+            if mark == "none":
+                continue          # epoch reset: the session is replaced
+            if mark == "teardown" and not t.tracked:
+                t.status = TaskStatus.SUCCEEDED  # ps-style normal teardown
+            else:
+                self.session.mark_killed(t.task_id)
+
     def _reset_session(self) -> None:
-        grace = self.conf.get_int(K.COORDINATOR_STOP_GRACE_S, 15)
-        for t in self.session.all_tasks():
-            if t.handle is not None and not t.status.terminal:
-                self.backend.kill_task(t.handle, grace_s=min(grace, 1))
+        # Short grace: the whole point of an epoch reset is a fast retry,
+        # and the failed epoch's periodic checkpoints are the resume
+        # source (save-on-TERM still gets 1 s for tiny states).
+        grace = min(self.conf.get_int(K.COORDINATOR_STOP_GRACE_S, 15), 1)
+        self._kill_all_tasks(grace, mark="none")
         # Wait for the old gang to be FULLY down, draining exits as they
         # land. Breaking on the first empty poll is not enough: a killed
         # task that hasn't exited yet polls as nothing-to-report, and
@@ -611,14 +642,13 @@ class Coordinator:
     def _stop(self) -> None:
         """Reference ``stop()`` :670-711 — stop running tasks with grace,
         wait for the client finish signal, finalize history."""
-        grace = self.conf.get_int(K.COORDINATOR_STOP_GRACE_S, 15)
-        for t in self.session.all_tasks():
-            if t.handle is not None and not t.status.terminal:
-                self.backend.kill_task(t.handle, grace_s=min(grace, 2))
-                if not t.tracked:
-                    t.status = TaskStatus.SUCCEEDED  # ps-style normal teardown
-                else:
-                    self.session.mark_killed(t.task_id)
+        # Full grace: the survivors here are untracked services (ps,
+        # heads, notebooks) on a job that already finished — they get the
+        # same TERM window as everyone else (a TERM-honouring service
+        # exits immediately; only TERM-ignoring ones cost the window).
+        self._kill_all_tasks(
+            self.conf.get_int(K.COORDINATOR_STOP_GRACE_S, 15),
+            mark="teardown")
         if self.conf.get_bool(K.APPLICATION_NUM_CLIENTS_TO_WAIT, True):
             self.client_signalled_finish.wait(
                 timeout=self.conf.get_int(K.COORDINATOR_STOP_GRACE_S, 15))
