@@ -145,3 +145,28 @@ def test_e2e_save_on_preemption_handler(tmp_path):
             "no handler-written checkpoint survived the force-kill"
     from procwatch import assert_no_orphans
     assert_no_orphans(f"TONY_APP_ID={client.app_id}")
+
+
+def test_preemption_handler_defers_while_save_in_flight(tmp_path):
+    """TERM landing while the main thread is INSIDE an orbax save must not
+    re-enter orbax (corrupts the in-flight write): the handler defers, and
+    the final save runs the moment the periodic call completes."""
+    import signal
+    import time
+
+    mgr = CheckpointManager(str(tmp_path / "c"), async_save=False)
+    state = {"w": jnp.zeros(2)}
+    mgr.install_preemption_handler(lambda: (9, state), exit_code=143)
+    try:
+        mgr._busy = True                    # simulate: inside mgr.save()
+        os.kill(os.getpid(), signal.SIGTERM)
+        time.sleep(0)                       # let the handler run
+        assert mgr._preempt["deferred"] and not mgr._preempt["fired"]
+        mgr._busy = False
+        with pytest.raises(SystemExit) as e:
+            mgr.save(8, state, force=True)  # completes, then deferred save
+        assert e.value.code == 143
+        assert set(mgr._mgr.all_steps()) == {8, 9}  # both saves durable
+    finally:
+        signal.signal(signal.SIGTERM, signal.SIG_DFL)
+        mgr.close()

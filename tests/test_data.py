@@ -140,3 +140,43 @@ def test_feeds_a_train_step(mesh):
         state, m = step(state, batch, jax.random.key(0))
         batch = next(it)
     assert jnp.isfinite(m["loss"])
+
+
+def test_pack_documents_roundtrip_and_mask():
+    """Greedy packing: docs + EOS concatenated, fixed [N, seq] rows, mask
+    zero only on the final row's padding."""
+    import numpy as np
+
+    from tony_tpu.data import pack_documents
+
+    docs = [[5, 6, 7], [8], [9, 10, 11, 12, 13]]
+    toks, mask = pack_documents(docs, seq=4, eos_id=1, pad_id=0)
+    stream = [5, 6, 7, 1, 8, 1, 9, 10, 11, 12, 13, 1]
+    assert toks.shape == (3, 4) and mask.shape == (3, 4)
+    assert toks.ravel().tolist() == stream  # 12 tokens fill 3 rows exactly
+    assert mask.min() == 1.0                # no padding needed
+
+    toks, mask = pack_documents([[5, 6]], seq=4, eos_id=1, pad_id=0)
+    assert toks.tolist() == [[5, 6, 1, 0]]
+    assert mask.tolist() == [[1, 1, 1, 0]]
+
+    import pytest
+
+    with pytest.raises(ValueError, match="no documents"):
+        pack_documents([], seq=4, eos_id=1)
+
+
+def test_pack_documents_feeds_masked_loss():
+    """Packed rows + mask drive causal_lm_loss's masked path (padding
+    predictions excluded)."""
+    import jax
+    import jax.numpy as jnp
+
+    from tony_tpu.data import pack_documents
+    from tony_tpu.models.transformer import causal_lm_loss
+
+    toks, mask = pack_documents([[3, 4, 5], [6, 7]], seq=8, eos_id=1)
+    logits = jax.random.normal(jax.random.key(0),
+                               (toks.shape[0], toks.shape[1], 16))
+    loss = causal_lm_loss(logits, jnp.asarray(toks), mask=jnp.asarray(mask))
+    assert jnp.isfinite(loss) and loss > 0

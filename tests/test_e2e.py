@@ -234,3 +234,46 @@ def test_cli_history_and_events_commands(tmp_path, capsys):
     assert main(["logs", rec.app_id, "--task", "worker:9",
                  "--history-root", hist]) == 1
     assert main(["logs", "app_nope", "--history-root", hist]) == 1
+
+
+def test_cli_status_command(tmp_path, capsys):
+    """`tony-tpu status`: live report from a running coordinator, history
+    fallback after it finishes, clean error for unknown ids (reference
+    client status-poll surface TonyClient.java:838, as a command)."""
+    import threading
+    import time
+
+    from tony_tpu.cli.main import main
+
+    ready = tmp_path / "ready"
+    conf = make_conf(tmp_path, "train_save_on_preempt.py", workers=1, extra={
+        "tony.application.checkpoint-dir": str(tmp_path / "ckpt"),
+    })
+    conf.set(K.EXECUTION_ENV, f"TONY_TEST_READY_FILE={ready}")
+    client = TonyTpuClient(conf, workdir=str(tmp_path / "work"))
+    rec = Recorder()
+    client.add_listener(rec)
+    t = threading.Thread(target=client.start, daemon=True)
+    t.start()
+    try:
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline and not ready.exists():
+            time.sleep(0.1)
+        assert ready.exists()
+        # live path: coordinator answers with the running report
+        assert main(["status", rec.app_id,
+                     "--workdir", str(tmp_path / "work")]) == 0
+        out = capsys.readouterr().out
+        assert "RUNNING" in out and "worker:0" in out
+    finally:
+        client.force_kill()
+        t.join(timeout=60)
+    # history fallback: job finished, coordinator gone
+    assert main(["status", rec.app_id,
+                 "--workdir", str(tmp_path / "work"),
+                 "--history-root", str(tmp_path / "history")]) == 0
+    out = capsys.readouterr().out
+    assert "KILLED" in out
+    assert main(["status", "app_nope",
+                 "--workdir", str(tmp_path / "work"),
+                 "--history-root", str(tmp_path / "history")]) == 1

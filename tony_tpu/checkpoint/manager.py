@@ -32,6 +32,8 @@ class CheckpointManager:
         import orbax.checkpoint as ocp
 
         self._ocp = ocp
+        self._busy = False               # main thread inside an orbax call
+        self._preempt: Optional[dict] = None
         self._mgr = ocp.CheckpointManager(
             ocp.path.utils.to_absolute_path(str(directory))
             if hasattr(ocp.path, "utils") else str(directory),
@@ -44,8 +46,14 @@ class CheckpointManager:
     def save(self, step: int, state: Any, force: bool = False) -> bool:
         """Queue an (async) save; returns False when skipped by the
         save_interval_steps policy."""
-        return self._mgr.save(
-            int(step), args=self._ocp.args.StandardSave(state), force=force)
+        self._busy = True
+        try:
+            return self._mgr.save(
+                int(step), args=self._ocp.args.StandardSave(state),
+                force=force)
+        finally:
+            self._busy = False
+            self._run_deferred_preemption()
 
     def restore(self, step: Optional[int], like: Any) -> Any:
         """Restore ``step`` (or the latest when None) with the shardings of
@@ -85,35 +93,59 @@ class CheckpointManager:
         expects of a TERM'd task).
         """
         import signal
-        import sys
 
-        fired = {"done": False}
+        self._preempt = {"fired": False, "deferred": False,
+                         "snapshot": snapshot, "exit_code": exit_code}
 
         def _handler(signum, frame):
-            if fired["done"]:
+            st = self._preempt
+            if st["fired"]:
                 # Teardown delivers TERM more than once (the executor
                 # forwards it AND the backend signals the user group
-                # directly); a re-entrant invocation mid-save would
-                # corrupt the in-flight orbax write ("Executor shutdown
-                # has been called") — first one wins, the rest no-op.
+                # directly) — first one wins, the rest no-op.
                 return
-            fired["done"] = True
-            try:
-                step, state = snapshot()
-                log.warning("SIGTERM: saving preemption checkpoint at "
-                            "step %s", step)
-                self.save(int(step), state, force=True)
-                self.wait()
-                log.warning("preemption checkpoint durable; exiting")
-            except Exception:  # noqa: BLE001 — still exit promptly
-                log.exception("preemption save failed")
-            sys.exit(exit_code)
+            if self._busy:
+                # TERM landed while the main thread is INSIDE an orbax
+                # call (a periodic save/wait): a re-entrant save would
+                # corrupt the in-flight write ("Executor shutdown has
+                # been called"). Defer — save()/wait() run the final
+                # save the moment the in-flight call completes.
+                st["deferred"] = True
+                return
+            st["fired"] = True
+            self._do_preemption_save()
 
         signal.signal(signal.SIGTERM, _handler)
 
+    def _run_deferred_preemption(self) -> None:
+        st = self._preempt
+        if st is not None and st["deferred"] and not st["fired"]:
+            st["fired"] = True
+            self._do_preemption_save()
+
+    def _do_preemption_save(self) -> None:
+        import sys
+
+        st = self._preempt
+        try:
+            step, state = st["snapshot"]()
+            log.warning("SIGTERM: saving preemption checkpoint at step %s",
+                        step)
+            self.save(int(step), state, force=True)
+            self.wait()
+            log.warning("preemption checkpoint durable; exiting")
+        except Exception:  # noqa: BLE001 — still exit promptly
+            log.exception("preemption save failed")
+        sys.exit(st["exit_code"])
+
     def wait(self) -> None:
         """Block until queued async saves are durable (call before exit)."""
-        self._mgr.wait_until_finished()
+        self._busy = True
+        try:
+            self._mgr.wait_until_finished()
+        finally:
+            self._busy = False
+            self._run_deferred_preemption()
 
     def close(self) -> None:
         self._mgr.close()

@@ -93,29 +93,78 @@ def _cmd_kill(args: argparse.Namespace) -> int:
     ``forceKillApplication`` TonyClient.java:959, as a standalone command:
     the coordinator's RPC endpoint is discovered from the job dir's
     address file, like the client does at submit)."""
-    import json
-
-    from tony_tpu.rpc.wire import RpcClient
-
-    workdir = _default_workdir(args.workdir)
-    addr_file = os.path.join(workdir, "jobs", args.app_id,
-                             "coordinator.addr")
-    if not os.path.exists(addr_file):
-        print(f"no coordinator address for {args.app_id} under {workdir} "
-              f"(wrong --workdir, or the job already finished)",
-              file=sys.stderr)
+    rpc = _coordinator_rpc(args.app_id, args.workdir)
+    if rpc is None:
+        print(f"no coordinator address for {args.app_id} under "
+              f"{_default_workdir(args.workdir)} (wrong --workdir, or the "
+              f"job already finished)", file=sys.stderr)
         return 1
-    with open(addr_file, encoding="utf-8") as f:
-        addr = json.load(f)
     try:
-        RpcClient(addr["host"], addr["port"],
-                  token=addr.get("token") or None,
-                  max_retries=2, retry_sleep_s=0.5).call("kill_application")
+        rpc.call("kill_application")
     except Exception as e:  # noqa: BLE001
         print(f"kill failed (coordinator gone?): {e}", file=sys.stderr)
         return 1
     print(f"kill signal sent to {args.app_id}")
     return 0
+
+
+def _coordinator_rpc(app_id: str, workdir: Optional[str]):
+    """RpcClient for a RUNNING job's coordinator, from the job dir's
+    address file (how kill/status reach a job after the submitting
+    process is gone); None when the file is absent."""
+    import json
+
+    from tony_tpu.rpc.wire import RpcClient
+
+    addr_file = os.path.join(_default_workdir(workdir), "jobs", app_id,
+                             "coordinator.addr")
+    if not os.path.exists(addr_file):
+        return None
+    with open(addr_file, encoding="utf-8") as f:
+        addr = json.load(f)
+    return RpcClient(addr["host"], addr["port"],
+                     token=addr.get("token") or None,
+                     max_retries=2, retry_sleep_s=0.5)
+
+
+def _cmd_status(args: argparse.Namespace) -> int:
+    """Live application report from a running job's coordinator
+    (reference: the client's status poll surface, ``TonyClient.java:838``;
+    the yarn `application -status` analogue). Falls back to history for
+    finished jobs."""
+    rpc = _coordinator_rpc(args.app_id, args.workdir)
+    if rpc is not None:
+        try:
+            report = rpc.call("get_application_report")
+            print(f"app_id:   {report['app_id']}")
+            print(f"status:   {report['status']}")
+            print(f"attempt:  {report['attempt']} "
+                  f"(retries left: {report['retries_left']})")
+            if report.get("failure_reason"):
+                print(f"reason:   {report['failure_reason']}")
+            if report.get("tb_url"):
+                print(f"tb_url:   {report['tb_url']}")
+            for t in report.get("tasks", []):
+                print(f"  {t['name']}:{t['index']:<3} {t['status']:<10} "
+                      f"{t.get('host', '') or ''}")
+            return 0
+        except Exception as e:  # noqa: BLE001
+            print(f"(coordinator unreachable: {e}; trying history)",
+                  file=sys.stderr)
+    from tony_tpu.events import history
+
+    root = _history_root(args)
+    for r in history.list_jobs(root):
+        if r.app_id == args.app_id:
+            print(f"app_id:   {r.app_id}")
+            print(f"status:   {r.status or 'RUNNING'}")
+            print(f"user:     {r.user}")
+            print(f"started:  {r.started_iso}")
+            return 0
+    print(f"unknown application {args.app_id} (not running under "
+          f"{_default_workdir(args.workdir)}, no history under {root})",
+          file=sys.stderr)
+    return 1
 
 
 def _history_root(args: argparse.Namespace) -> str:
@@ -249,6 +298,15 @@ def build_parser() -> argparse.ArgumentParser:
     k.add_argument("--workdir", help="client workdir the job was "
                                      "submitted from (default ~/.tony-tpu)")
     k.set_defaults(fn=_cmd_kill)
+
+    st = sub.add_parser("status",
+                        help="live report for a running job (falls back "
+                             "to history for finished ones)")
+    st.add_argument("app_id")
+    st.add_argument("--workdir", help="client workdir the job was "
+                                      "submitted from")
+    st.add_argument("--history-root")
+    st.set_defaults(fn=_cmd_status)
 
     h = sub.add_parser("history", help="list finished jobs")
     h.add_argument("--history-root")

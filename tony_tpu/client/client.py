@@ -350,15 +350,17 @@ class TonyTpuClient:
         except Exception:  # noqa: BLE001
             pass
         if self._coord_proc is not None and self._coord_proc.poll() is None:
-            # The coordinator's teardown legitimately takes up to the
-            # configured stop grace (TERM window for save-on-preemption
-            # handlers) — wait it out before escalating, or the
-            # escalation itself orphans the user processes mid-save.
+            # The coordinator's teardown legitimately takes up to TWO
+            # grace windows (kill ladder in _monitor, then _stop's
+            # client-finish wait when nothing signals finish — the Ctrl-C
+            # path) — wait them out before escalating, or the escalation
+            # itself orphans user processes mid-preemption-save and
+            # leaves history unfinalized.
             from tony_tpu.conf import keys as K
 
             grace = self.conf.get_int(K.COORDINATOR_STOP_GRACE_S, 15)
             try:
-                self._coord_proc.wait(timeout=grace + 15)
+                self._coord_proc.wait(timeout=2 * grace + 15)
             except subprocess.TimeoutExpired:
                 self._coord_proc.terminate()
 

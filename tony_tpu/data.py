@@ -161,6 +161,44 @@ def token_file_batches(mesh: Mesh, path: str, global_batch: int, seq: int,
                                 start_step=start_step)
 
 
+def pack_documents(docs, seq: int, eos_id: int, pad_id: int = 0):
+    """Pack variable-length tokenized documents into fixed [N, seq] rows —
+    the shape XLA wants (static; no per-batch padding waste).
+
+    GPT-style greedy packing: documents are concatenated, each terminated
+    by ``eos_id``, and the stream is sliced into rows of ``seq``. Returns
+    ``(tokens, loss_mask)`` int32/float32 arrays where the mask is 0 only
+    on the final row's padding — next-token targets crossing a document
+    boundary stay in the loss (standard pretraining practice; the EOS
+    token is what the model learns as the boundary). Note: attention also
+    crosses packed-document boundaries (no segment masking) — acceptable
+    for pretraining, not for SFT-style strict isolation.
+
+    Deterministic and order-preserving, so every process packing the same
+    corpus sees identical rows (the ShardedBatchIterator contract). Feed
+    the result through ``write_token_file``/``TokenFileDataset`` for the
+    mmap path, or slice rows directly for small corpora.
+    """
+    if seq < 2:
+        raise ValueError(f"seq must be >= 2, got {seq}")
+    eos = np.asarray([eos_id], np.int32)
+    # Vectorized concatenation — a boxed-int Python list would cost ~28
+    # bytes/token and dominate wall time on real (1e8+ token) corpora.
+    pieces: list = []
+    for d in docs:
+        pieces.append(np.asarray(d, np.int32).ravel())
+        pieces.append(eos)
+    if not pieces:
+        raise ValueError("no documents to pack")
+    stream = np.concatenate(pieces)
+    n = -(-len(stream) // seq)
+    flat = np.full((n * seq,), pad_id, np.int32)
+    flat[:len(stream)] = stream
+    mask = np.zeros((n * seq,), np.float32)
+    mask[:len(stream)] = 1.0
+    return flat.reshape(n, seq), mask.reshape(n, seq)
+
+
 def write_token_file(path: str, tokens: "np.ndarray",
                      dtype=np.uint16) -> str:
     """Write a flat token array as a ``.bin`` corpus (tooling/tests).
