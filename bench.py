@@ -245,14 +245,18 @@ def main():
         # (8k: b8+remat 34.7k vs b4 no-remat 42.1k; 32k b1: 20.8k either
         # way) — remat is a fit lever here, not a speed lever. See the
         # big point below for remat under real memory pressure.
-        for label, seq, batch, steps in (
-                ("longctx_8k_chunked_ce", 8192, 4, 12),
-                ("longctx_32k_chunked_ce", 32768, 1, 8)):
+        # Loss-chunk sizes from the v5e sweep (docs/perf.md): at 32k the
+        # optimum is 8192 (21.1k tok/s vs 20.3k at 16384 — bigger chunks
+        # lose scan overhead until the [B,C,V] tile hits HBM pressure;
+        # full-seq OOMs); at 8k the 2048 default is already best.
+        for label, seq, batch, steps, chunk in (
+                ("longctx_8k_chunked_ce", 8192, 4, 12, 2048),
+                ("longctx_32k_chunked_ce", 32768, 1, 8, 8192)):
             try:
                 detail[label] = measure_point(
                     build_flagship_config(seq),
                     batch=batch, seq=seq, steps=steps, chunked=True,
-                    reps=2)
+                    loss_chunk=chunk, reps=2)
             except Exception as e:  # noqa: BLE001
                 print(f"# {label} failed: {e}", file=sys.stderr)
                 detail[label] = {"error": str(e)[:300]}
