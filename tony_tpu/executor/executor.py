@@ -76,11 +76,13 @@ class Heartbeater(threading.Thread):
         self._client = client
         self._task_id = task_id
         self._interval_s = interval_s
-        self._stop = threading.Event()
+        # _stop_evt, not _stop: threading.Thread has a private _stop()
+        # method; shadowing it with an Event breaks Thread.join().
+        self._stop_evt = threading.Event()
         self._skip = int(os.environ.get(constants.TEST_NUM_HB_MISS, "0") or 0)
 
     def run(self) -> None:
-        while not self._stop.wait(self._interval_s):
+        while not self._stop_evt.wait(self._interval_s):
             if self._skip > 0:
                 self._skip -= 1
                 log.warning("TEST hook: skipping heartbeat (%d more)",
@@ -93,7 +95,7 @@ class Heartbeater(threading.Thread):
                 log.warning("heartbeat failed: %s", e)
 
     def stop(self) -> None:
-        self._stop.set()
+        self._stop_evt.set()
 
 
 class TaskExecutor:
@@ -303,6 +305,13 @@ class TaskExecutor:
                 log.warning("could not write %s: %s",
                             constants.USER_PGID_FILE, e)
 
+        # Spot/preemptible TPU VMs: the metadata server's advance notice
+        # becomes a SIGTERM to the user group, so save-on-preemption
+        # handlers run inside the warning window (executor/preemption.py;
+        # silently off when no metadata server answers).
+        from tony_tpu.executor.preemption import start_for_executor
+        preempt_watcher = start_for_executor(_user_proc)
+
         try:
             exit_code = procutil.execute_shell(
                 self.command,
@@ -311,6 +320,8 @@ class TaskExecutor:
                 env=env, on_start=_on_user_start)
         finally:
             _user_proc[:] = []
+            if preempt_watcher is not None:
+                preempt_watcher.stop()
             monitor.stop()
             if self.rendezvous_port.reuse:
                 self.rendezvous_port.release()
