@@ -51,11 +51,16 @@ def step(state):
     return state.apply_gradients(grads), l
 
 
+# telemetry.step feeds utilization into TASK_FINISHED metrics / the
+# portal /metrics view when run under tony-tpu.
+from tony_tpu import telemetry
+
 first = last = None
 with jax.set_mesh(mesh):
     for i in range(STEPS):
-        state, l = step(state)
-        last = float(l)
+        with telemetry.step():
+            state, l = step(state)
+            last = float(l)
         first = first if first is not None else last
 print(f"process {jax.process_index()}: loss {first:.4f} -> {last:.4f}")
 assert last < first, "loss did not decrease"

@@ -44,10 +44,16 @@ def loss_fn(params, b, rng):
 
 state, state_sh = init_sharded_state(model, x, optax.adam(1e-3), mesh)
 step = jit_train_step(loss_fn, mesh, state_sh, batch)
+# telemetry.step feeds utilization (steps/s, duty cycle) into the job's
+# TASK_FINISHED metrics and the portal /metrics view when run under
+# tony-tpu; standalone it is a no-op beyond a timestamp.
+from tony_tpu import telemetry
+
 first = last = None
 for i in range(STEPS):
-    state, m = step(state, batch, jax.random.key(i))
-    last = float(m["loss"])
+    with telemetry.step():
+        state, m = step(state, batch, jax.random.key(i))
+        last = float(m["loss"])
     first = first if first is not None else last
 print(f"process {jax.process_index()}: loss {first:.4f} -> {last:.4f}")
 assert last < first, "loss did not decrease"
