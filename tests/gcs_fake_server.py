@@ -96,6 +96,9 @@ class GcsFakeServer:
                 self._send(404)
 
             def _list(self, bucket: str, q: dict):
+                if bucket not in server.objects:
+                    # real GCS 404s a list on a nonexistent bucket
+                    return self._jsend(404, {"error": "bucket notFound"})
                 prefix = q.get("prefix", "")
                 delim = q.get("delimiter", "")
                 page = min(int(q.get("maxResults", "1000")),

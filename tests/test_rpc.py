@@ -170,7 +170,10 @@ def test_secret_never_crosses_the_wire_and_frames_are_signed():
             raw = raw[4 + n:]
     signed = [f for f in frames if "tony-rpc" not in f]
     assert signed, frames
-    assert all(set(f) == {"p", "m"} and len(f["m"]) == 32 for f in signed)
+    assert all(set(f) <= {"p", "m", "cn"} and len(f["m"]) == 32
+               for f in signed)
+    # exactly one frame (the client's first) carries the client nonce
+    assert sum(1 for f in signed if "cn" in f) == 1
 
 
 def test_tampered_frame_rejected():
@@ -230,6 +233,110 @@ def test_replayed_frame_rejected():
         s.close()
     finally:
         srv.stop()
+
+
+def test_replayed_connection_rejected_by_client():
+    """Server-direction replay (ADVICE r4 medium): an on-path attacker who
+    recorded a whole connection (hello + signed responses) and plays it
+    back to a NEW client must be refused — the new client's fresh nonce is
+    absent from the recorded response MACs."""
+    import socket as socketlib
+    import struct
+
+    import msgpack
+
+    captured = []
+    real_sendall = socketlib.socket.sendall
+
+    def spy_sendall(self, data):
+        captured.append(bytes(data))
+        return real_sendall(self, data)
+
+    srv = RpcServer(EchoService(), port=0, token="tok")
+    srv.start()
+    socketlib.socket.sendall = spy_sendall
+    try:
+        c = RpcClient("127.0.0.1", srv.port, token="tok", max_retries=1,
+                      retry_sleep_s=0.01)
+        assert c.call("add", a=1, b=2) == 3
+        c.close()
+    finally:
+        socketlib.socket.sendall = real_sendall
+        srv.stop()
+
+    # split the capture into frames; keep only what the SERVER sent
+    # (the hello, and frames whose inner payload is a response)
+    server_raw = []
+    for raw in captured:
+        while raw:
+            n = struct.unpack(">I", raw[:4])[0]
+            frame_bytes, raw = raw[:4 + n], raw[4 + n:]
+            f = msgpack.unpackb(frame_bytes[4:], raw=False)
+            if "tony-rpc" in f or (
+                    "p" in f and "ok" in msgpack.unpackb(f["p"], raw=False)):
+                server_raw.append(frame_bytes)
+    assert len(server_raw) >= 2       # hello + at least one response
+
+    # a dumb replay "server": hello immediately, then one recorded
+    # response per client frame received
+    replay_srv = socketlib.socket(socketlib.AF_INET, socketlib.SOCK_STREAM)
+    replay_srv.setsockopt(socketlib.SOL_SOCKET, socketlib.SO_REUSEADDR, 1)
+    replay_srv.bind(("127.0.0.1", 0))
+    replay_srv.listen(1)
+    port = replay_srv.getsockname()[1]
+
+    def replay():
+        conn, _ = replay_srv.accept()
+        conn.sendall(server_raw[0])                    # recorded hello
+        for resp in server_raw[1:]:
+            n = struct.unpack(">I", conn.recv(4))[0]
+            while n > 0:
+                n -= len(conn.recv(n))
+            conn.sendall(resp)                         # recorded response
+        conn.close()
+
+    t = threading.Thread(target=replay, daemon=True)
+    t.start()
+    try:
+        victim = RpcClient("127.0.0.1", port, token="tok", max_retries=1,
+                           retry_sleep_s=0.01)
+        with pytest.raises(AuthError):
+            victim.call("add", a=1, b=2)
+        victim.close()
+    finally:
+        replay_srv.close()
+        t.join(timeout=5)
+
+
+def test_v2_server_named_clearly_by_v3_client():
+    """A pre-dual-nonce (v2) server must produce a protocol-version error
+    at connect, not a misleading 'bad frame MAC' on the first call."""
+    import socket as socketlib
+
+    from tony_tpu.rpc.wire import _send_frame
+
+    lsock = socketlib.socket(socketlib.AF_INET, socketlib.SOCK_STREAM)
+    lsock.setsockopt(socketlib.SOL_SOCKET, socketlib.SO_REUSEADDR, 1)
+    lsock.bind(("127.0.0.1", 0))
+    lsock.listen(1)
+    port = lsock.getsockname()[1]
+
+    def v2_hello():
+        conn, _ = lsock.accept()
+        _send_frame(conn, {"tony-rpc": 2, "nonce": b"x" * 16, "auth": True})
+        conn.recv(4096)
+        conn.close()
+
+    t = threading.Thread(target=v2_hello, daemon=True)
+    t.start()
+    try:
+        c = RpcClient("127.0.0.1", port, token="tok", max_retries=1,
+                      retry_sleep_s=0.01)
+        with pytest.raises(RpcError, match="tony-rpc v2.*requires v3"):
+            c.call("add", a=1, b=1)
+    finally:
+        lsock.close()
+        t.join(timeout=5)
 
 
 def test_unauthenticated_server_rejected_by_auth_client():

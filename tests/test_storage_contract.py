@@ -112,6 +112,28 @@ def test_contract_gs_flat_namespace(store_and_base, tmp_path):
     assert not s.exists(ujoin(base, "p/q/r/one"))
 
 
+@pytest.mark.parametrize("store_and_base",
+                         ["fakegcs", "gcs"], indirect=True)
+def test_contract_gs_bucket_root_exists_is_boolean(store_and_base, tmp_path):
+    """exists() on gs://bucket (empty object name) answers via the prefix
+    listing instead of building a malformed '…/o/' URL (ADVICE r4): True
+    once the bucket holds anything, False on an empty/unknown bucket —
+    never an exception."""
+    s, base = store_and_base
+    bucket_root = base.rsplit("/", 1)[0]          # gs://bucket
+    assert not s.exists(bucket_root)
+    assert not s.exists(bucket_root + "/")
+    # a bucket the backend has never heard of (real GCS 404s the listing)
+    assert not s.exists("gs://never-created-bucket")
+    assert not s.isdir("gs://never-created-bucket/p")
+    assert s.list("gs://never-created-bucket/p") == []
+    f = tmp_path / "seed.txt"
+    f.write_text("x")
+    s.put_file(str(f), ujoin(base, "seed.txt"))
+    assert s.exists(bucket_root)
+    assert s.exists(bucket_root + "/")
+
+
 # ---------------------------------------------------------------------------
 # Wire-level behavior of the REAL client (GcsStore only)
 # ---------------------------------------------------------------------------

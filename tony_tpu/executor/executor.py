@@ -320,6 +320,16 @@ class TaskExecutor:
                 env=env, on_start=_on_user_start)
         finally:
             _user_proc[:] = []
+            # The group is reaped (execute_shell's finally); drop the pgid
+            # file so later backend kills can't TERM a recycled group id
+            # while the executor lingers through reporting/teardown
+            # (ADVICE r4: same-user pgid reuse isn't caught by the
+            # PermissionError guard).
+            try:
+                os.unlink(os.path.join(os.getcwd(),
+                                       constants.USER_PGID_FILE))
+            except OSError:
+                pass
             if preempt_watcher is not None:
                 preempt_watcher.stop()
             monitor.stop()

@@ -15,19 +15,27 @@ channel ``rpc/MetricsRpc.java``). Differences, on purpose:
 - Optional shared-secret auth replaces the ClientToAMToken secret manager
   (``ApplicationMaster.java:433-452``) — but the secret itself NEVER
   crosses the wire: with a token configured, every frame carries an
-  HMAC-SHA256 over (per-connection server nonce ‖ direction ‖ payload),
-  keyed by the token. That gives peer authentication, frame integrity,
-  and replay protection (the nonce binds frames to this connection; the
-  server additionally requires strictly increasing request ids), without
-  the cert-distribution burden of TLS on ephemeral TPU-VM gangs. What it
-  does NOT give is confidentiality — the control plane carries cluster
-  specs/metrics/exit codes, no secrets (the storage credential rides env,
-  never RPC; see storage/store.py).
+  HMAC-SHA256 over (server nonce ‖ client nonce ‖ direction ‖ payload),
+  keyed by the token. Both peers contribute per-connection entropy: the
+  server's nonce rides the hello, the client's rides its first frame, and
+  every MAC in either direction binds both. That gives peer
+  authentication, frame integrity, and replay protection in BOTH
+  directions — a recorded connection cannot be replayed to a client
+  (the client's fresh nonce is absent from old response MACs) nor to a
+  server (its fresh nonce is absent from old request MACs), and within
+  a connection the server additionally requires strictly increasing
+  request ids — without the cert-distribution burden of TLS on ephemeral
+  TPU-VM gangs (TLS is available as an opt-in; see make_ssl_context).
+  What HMAC alone does NOT give is confidentiality — the control plane
+  carries cluster specs/metrics/exit codes, no secrets (the storage
+  credential rides env, never RPC; see storage/store.py).
 
 Wire format: 4-byte big-endian length, then a msgpack map per frame.
 - hello (server → client, once per connection):
-    {"tony-rpc": 2, "nonce": bytes, "auth": bool}
+    {"tony-rpc": 3, "nonce": bytes, "auth": bool}
 - signed frame: {"p": <inner msgpack bytes>, "m": <hmac>}; unsigned: {"p"}
+  (the client's FIRST frame additionally carries {"cn": bytes}, its
+  connection nonce; all MACs use server_nonce + client_nonce)
 - inner request:  {"id": int, "method": str, "args": {...}}
 - inner response: {"id": int, "ok": bool, "result"| "error"}
 """
@@ -90,17 +98,19 @@ def _mac(token: str, nonce: bytes, direction: bytes, payload: bytes) -> bytes:
 
 
 def _send_signed(sock: socket.socket, obj: Any, token: Optional[str],
-                 nonce: bytes, direction: bytes) -> None:
+                 nonce: bytes, direction: bytes,
+                 extra: Optional[Dict[str, Any]] = None) -> None:
     inner = msgpack.packb(obj, use_bin_type=True)
     frame: Dict[str, Any] = {"p": inner}
+    if extra:
+        frame.update(extra)
     if token:
         frame["m"] = _mac(token, nonce, direction, inner)
     _send_frame(sock, frame)
 
 
-def _recv_signed(sock: socket.socket, token: Optional[str],
-                 nonce: bytes, direction: bytes) -> Any:
-    frame = _recv_frame(sock)
+def _verify_frame(frame: Any, token: Optional[str],
+                  nonce: bytes, direction: bytes) -> Any:
     if not isinstance(frame, dict) or "p" not in frame:
         raise RpcError("malformed frame (no payload)")
     inner = frame["p"]
@@ -110,6 +120,11 @@ def _recv_signed(sock: socket.socket, token: Optional[str],
                 mac, _mac(token, nonce, direction, inner)):
             raise AuthError("bad or missing frame MAC")
     return msgpack.unpackb(inner, raw=False)
+
+
+def _recv_signed(sock: socket.socket, token: Optional[str],
+                 nonce: bytes, direction: bytes) -> Any:
+    return _verify_frame(_recv_frame(sock), token, nonce, direction)
 
 
 class RpcServer:
@@ -124,7 +139,7 @@ class RpcServer:
     def __init__(self, service: Any, host: str = "127.0.0.1", port: int = 0,
                  token: Optional[str] = None):
         self._service = service
-        self._token = token
+        self._token = token or None     # "" = unauthenticated, like None
         outer = self
 
         class Handler(socketserver.BaseRequestHandler):
@@ -133,15 +148,33 @@ class RpcServer:
                 sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
                 nonce = os.urandom(16)
                 try:
-                    _send_frame(sock, {"tony-rpc": 2, "nonce": nonce,
+                    _send_frame(sock, {"tony-rpc": 3, "nonce": nonce,
                                        "auth": outer._token is not None})
                 except OSError:
                     return
                 last_id = 0
+                first = True
                 while True:
                     try:
-                        req = _recv_signed(sock, outer._token, nonce,
-                                           _TO_SERVER)
+                        frame = _recv_frame(sock)
+                        if first:
+                            # The client's first frame carries its own
+                            # connection nonce; from here on every MAC
+                            # (both directions) binds both nonces, so a
+                            # recorded connection cannot be replayed to a
+                            # fresh client — old response MACs lack this
+                            # client's entropy.
+                            cn = frame.get("cn", b"") \
+                                if isinstance(frame, dict) else b""
+                            # Exactly 16 bytes or nothing: an unauthenticated
+                            # peer must not be able to inflate every HMAC for
+                            # the connection's lifetime with a huge cn.
+                            if isinstance(cn, (bytes, bytearray)) \
+                                    and len(cn) == 16:
+                                nonce = nonce + bytes(cn)
+                            first = False
+                        req = _verify_frame(frame, outer._token, nonce,
+                                            _TO_SERVER)
                     except AuthError as e:
                         # Unauthenticated peer: say why (signed, so a
                         # legitimate client can distinguish bad-key from
@@ -236,12 +269,14 @@ class RpcClient:
                  max_retries: int = 10, retry_sleep_s: float = 2.0,
                  connect_timeout_s: float = 10.0):
         self._addr = (host, port)
-        self._token = token
+        self._token = token or None     # "" = unauthenticated, like None
         self._max_retries = max_retries
         self._retry_sleep_s = retry_sleep_s
         self._connect_timeout_s = connect_timeout_s
         self._sock: Optional[socket.socket] = None
         self._nonce: bytes = b""
+        self._client_nonce: bytes = b""
+        self._hello_pending = False
         self._id = 0
         self._lock = threading.Lock()
 
@@ -260,8 +295,22 @@ class RpcClient:
         sock.settimeout(None)
         if not isinstance(hello, dict) or "nonce" not in hello:
             sock.close()
-            raise RpcError("peer is not a tony-rpc v2 server (no hello)")
-        self._nonce = hello["nonce"]
+            raise RpcError("peer is not a tony-rpc server (no hello)")
+        if self._token is not None and hello.get("tony-rpc") != 3:
+            # A v2 server verifies MACs over its nonce alone; our dual-nonce
+            # MACs would fail there with a misleading "bad frame MAC". Name
+            # the real problem instead.
+            sock.close()
+            raise RpcError(
+                f"peer speaks tony-rpc v{hello.get('tony-rpc')}; this "
+                "authenticated client requires v3 (dual-nonce MACs)")
+        # Contribute our own freshness: the combined nonce goes into every
+        # MAC both ways, so recorded responses from an old connection can
+        # never satisfy this one (ADVICE r4: the hello alone gave the
+        # client no replay protection).
+        self._client_nonce = os.urandom(16)
+        self._nonce = hello["nonce"] + self._client_nonce
+        self._hello_pending = True
         # Request ids double as the anti-replay sequence and reset with
         # each connection's fresh nonce.
         self._id = 0
@@ -276,8 +325,11 @@ class RpcClient:
                         self._sock = self._connect()
                     self._id += 1
                     req = {"id": self._id, "method": method, "args": args}
+                    extra = {"cn": self._client_nonce} \
+                        if self._token and self._hello_pending else None
                     _send_signed(self._sock, req, self._token, self._nonce,
-                                 _TO_SERVER)
+                                 _TO_SERVER, extra=extra)
+                    self._hello_pending = False
                     # Response MAC proves the SERVER holds the secret too
                     # (mutual auth); a mismatch raises AuthError and is
                     # not retried.

@@ -347,6 +347,10 @@ class GcsStore(Store):
             delay *= 2
 
     def _obj_url(self, bucket: str, key: str, media: bool = False) -> str:
+        if not key:
+            # '…/o/' with an empty name is a 400-class API error; callers
+            # that can mean a bucket root (exists) must branch before here.
+            raise ValueError(f"gs://{bucket} has no object name")
         return (f"{self.endpoint}/storage/v1/b/{quote(bucket, safe='')}"
                 f"/o/{quote(key, safe='')}" + ("?alt=media" if media else ""))
 
@@ -440,6 +444,11 @@ class GcsStore(Store):
 
     def exists(self, url: str) -> bool:
         bucket, key = _split_gs(url)
+        if not key:
+            # gs://bucket[/]: there is no object with an empty name (the
+            # API would 400 on '…/o/'); answer via the prefix listing like
+            # the other stores do (ADVICE r4).
+            return self.isdir(url)
         try:
             self._request("GET", self._obj_url(bucket, key))
             return True
@@ -448,8 +457,11 @@ class GcsStore(Store):
 
     def isdir(self, url: str) -> bool:
         bucket, key = _split_gs(url)
-        items, prefixes = self._list_page(bucket, _as_prefix(key),
-                                          max_results=1, first_hit=True)
+        try:
+            items, prefixes = self._list_page(bucket, _as_prefix(key),
+                                              max_results=1, first_hit=True)
+        except FileNotFoundError:
+            return False        # unknown bucket: a boolean, not a throw
         return bool(items or prefixes)
 
     def _list_page(self, bucket: str, prefix: str, max_results: int = 1000,
@@ -482,7 +494,10 @@ class GcsStore(Store):
     def list(self, url: str) -> List[str]:
         bucket, key = _split_gs(url)
         prefix = _as_prefix(key)
-        names, prefixes = self._list_page(bucket, prefix)
+        try:
+            names, prefixes = self._list_page(bucket, prefix)
+        except FileNotFoundError:
+            return []           # unknown bucket lists like a missing prefix
         children = {n[len(prefix):] for n in names if n != prefix}
         children |= {p[len(prefix):].rstrip("/") for p in prefixes}
         return sorted(c for c in children if c)
