@@ -1,0 +1,257 @@
+"""In-process Cloud TPU v2 API server: the wire-level test double for
+``TpuApiClient`` (``tony.gcloud.api-endpoint`` / ``TONY_TPU_API_ENDPOINT``
+points at it).
+
+Implements the slice of the API the provisioner speaks — node create
+(returning a long-running operation), operation polling, node get, node
+delete — plus knobs that force the failure modes the provisioner must
+survive: creates that are denied (quota/stockout), operations that take
+several polls, nodes that never leave CREATING (exercise the acquire
+timeout), bearer-token enforcement, and **preemption**: flip a node's
+state to PREEMPTED either explicitly (``preempt()``) or when a filesystem
+path appears (``preempt_when_path_exists`` — the condition-trigger that
+makes "preempt AFTER the first checkpoint is durable" deterministic, same
+discipline as the TEST_SLICE_FAIL_HOST ``host#<glob>`` hook).
+
+Like ``gcs_fake_server.py``, this double tests the client's REQUESTS, not
+a re-implementation of its logic.
+"""
+
+from __future__ import annotations
+
+import glob as globmod
+import json
+import re
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Dict, List, Optional
+from urllib.parse import parse_qs, urlparse
+
+
+class TpuApiFakeServer:
+    def __init__(self, hosts_per_node: int = 1, ready_after_polls: int = 1,
+                 op_done_after_polls: int = 1, require_token: str = "",
+                 deny_creates: int = 0, stuck_in_creating: bool = False,
+                 preempt_when_path_exists: str = "",
+                 fail_first_n: int = 0):
+        self.hosts_per_node = hosts_per_node
+        #: node GETs before CREATING flips to READY
+        self.ready_after_polls = ready_after_polls
+        #: operation GETs before done=true
+        self.op_done_after_polls = op_done_after_polls
+        self.require_token = require_token
+        self.deny_creates = deny_creates        # 429 the first N creates
+        self.stuck_in_creating = stuck_in_creating
+        self.preempt_when_path_exists = preempt_when_path_exists
+        self.fail_first_n = fail_first_n        # 503 the first N requests
+        self.nodes: Dict[str, dict] = {}        # node_id -> node resource
+        self.node_polls: Dict[str, int] = {}
+        self.ops: Dict[str, dict] = {}          # op name -> op resource
+        self.op_polls: Dict[str, int] = {}
+        self.create_count = 0
+        self.delete_count = 0
+        self.created_names: List[str] = []
+        self.deleted_names: List[str] = []
+        self._preempted_once = False
+        self._n_ops = 0
+        self._next_ip = 0
+        self.lock = threading.Lock()
+        server = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a):
+                pass
+
+            def _jsend(self, code: int, obj: dict):
+                body = json.dumps(obj).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def _gate(self) -> bool:
+                with server.lock:
+                    if server.fail_first_n > 0:
+                        server.fail_first_n -= 1
+                        self._jsend(503, {"error": "flaky"})
+                        return False
+                if server.require_token:
+                    auth = self.headers.get("Authorization", "")
+                    if auth != f"Bearer {server.require_token}":
+                        self._jsend(401 if not auth else 403,
+                                    {"error": "denied"})
+                        return False
+                return True
+
+            # -- GET: node / operation -----------------------------------
+            def do_GET(self):
+                if not self._gate():
+                    return
+                path = urlparse(self.path).path
+                m = re.match(r"^/v2/(projects/[^/]+/locations/[^/]+"
+                             r"/operations/[^/]+)$", path)
+                if m:
+                    return self._get_op(m.group(1))
+                m = re.match(r"^/v2/projects/[^/]+/locations/[^/]+"
+                             r"/nodes/([^/]+)$", path)
+                if m:
+                    return self._get_node(m.group(1))
+                self._jsend(404, {"error": f"no route {path}"})
+
+            def _get_op(self, name: str):
+                with server.lock:
+                    op = server.ops.get(name)
+                    if op is None:
+                        return self._jsend(404, {"error": "op notFound"})
+                    server.op_polls[name] = server.op_polls.get(name, 0) + 1
+                    if (not op["done"] and server.op_polls[name]
+                            >= server.op_done_after_polls):
+                        op["done"] = True
+                        fin = op.pop("_on_done", None)
+                    else:
+                        fin = None
+                    if fin:
+                        fin()
+                    self._jsend(200, {k: v for k, v in op.items()
+                                      if not k.startswith("_")})
+
+            def _get_node(self, node_id: str):
+                with server.lock:
+                    server._maybe_conditional_preempt()
+                    node = server.nodes.get(node_id)
+                    if node is None:
+                        return self._jsend(404, {"error": "node notFound"})
+                    server.node_polls[node_id] = \
+                        server.node_polls.get(node_id, 0) + 1
+                    if (node["state"] == "CREATING"
+                            and not server.stuck_in_creating
+                            and server.node_polls[node_id]
+                            >= server.ready_after_polls):
+                        node["state"] = "READY"
+                    self._jsend(200, node)
+
+            # -- POST: create --------------------------------------------
+            def do_POST(self):
+                if not self._gate():
+                    return
+                u = urlparse(self.path)
+                q = {k: v[0] for k, v in parse_qs(u.query).items()}
+                m = re.match(r"^/v2/(projects/([^/]+)/locations/([^/]+))"
+                             r"/nodes$", u.path)
+                if not m:
+                    return self._jsend(404, {"error": "no route"})
+                parent, node_id = m.group(1), q.get("nodeId", "")
+                n = int(self.headers.get("Content-Length", "0") or 0)
+                body = json.loads(self.rfile.read(n).decode() or "{}")
+                with server.lock:
+                    server.create_count += 1
+                    if server.deny_creates > 0:
+                        server.deny_creates -= 1
+                        return self._jsend(429, {"error": {
+                            "code": 429, "status": "RESOURCE_EXHAUSTED",
+                            "message": "no capacity for "
+                                       + body.get("acceleratorType", "?")}})
+                    if node_id in server.nodes:
+                        return self._jsend(409, {"error": {
+                            "code": 409, "message": "already exists"}})
+                    endpoints = []
+                    for _ in range(server.hosts_per_node):
+                        server._next_ip += 1
+                        endpoints.append(
+                            {"ipAddress": f"10.0.0.{server._next_ip}",
+                             "port": 8470})
+                    server.nodes[node_id] = {
+                        "name": f"{parent}/nodes/{node_id}",
+                        "state": "CREATING",
+                        "acceleratorType":
+                            body.get("acceleratorType", ""),
+                        "runtimeVersion": body.get("runtimeVersion", ""),
+                        "schedulingConfig":
+                            body.get("schedulingConfig", {}),
+                        "labels": body.get("labels", {}),
+                        "networkEndpoints": endpoints,
+                    }
+                    server.created_names.append(node_id)
+                    op = server._new_op(parent)
+                    self._jsend(200, {k: v for k, v in op.items()
+                                      if not k.startswith("_")})
+
+            # -- DELETE: delete node -------------------------------------
+            def do_DELETE(self):
+                if not self._gate():
+                    return
+                path = urlparse(self.path).path
+                m = re.match(r"^/v2/(projects/[^/]+/locations/[^/]+)"
+                             r"/nodes/([^/]+)$", path)
+                if not m:
+                    return self._jsend(404, {"error": "no route"})
+                parent, node_id = m.group(1), m.group(2)
+                with server.lock:
+                    if node_id not in server.nodes:
+                        return self._jsend(404,
+                                           {"error": "node notFound"})
+                    server.delete_count += 1
+                    server.deleted_names.append(node_id)
+                    # the node disappears when the delete op completes
+                    op = server._new_op(
+                        parent,
+                        on_done=lambda: server.nodes.pop(node_id, None))
+                    self._jsend(200, {k: v for k, v in op.items()
+                                      if not k.startswith("_")})
+
+        class Server(ThreadingHTTPServer):
+            daemon_threads = True
+
+        self._httpd = Server(("127.0.0.1", 0), Handler)
+        self._thread: Optional[threading.Thread] = None
+
+    # -- helpers (call with self.lock held from handlers) ---------------
+    def _new_op(self, parent: str, on_done=None) -> dict:
+        self._n_ops += 1
+        name = f"{parent}/operations/op-{self._n_ops}"
+        op = {"name": name, "done": self.op_done_after_polls <= 0}
+        if on_done is not None:
+            if op["done"]:
+                on_done()
+            else:
+                op["_on_done"] = on_done
+        self.ops[name] = op
+        return op
+
+    def _maybe_conditional_preempt(self) -> None:
+        """preempt_when_path_exists: once the glob matches, the FIRST node
+        flips to PREEMPTED (once per server) — deterministic condition-
+        triggered spot reclaim."""
+        if (not self.preempt_when_path_exists or self._preempted_once
+                or not self.nodes):
+            return
+        if not globmod.glob(self.preempt_when_path_exists):
+            return
+        node_id = next(iter(self.nodes))
+        if self.nodes[node_id]["state"] == "READY":
+            self.nodes[node_id]["state"] = "PREEMPTED"
+            self._preempted_once = True
+
+    # -- public test API -------------------------------------------------
+    @property
+    def endpoint(self) -> str:
+        host, port = self._httpd.server_address
+        return f"http://{host}:{port}"
+
+    def preempt(self, node_id: str) -> None:
+        with self.lock:
+            self.nodes[node_id]["state"] = "PREEMPTED"
+
+    def start(self) -> "TpuApiFakeServer":
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, name="tpu-api-fake",
+            daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        if self._thread:
+            self._thread.join(timeout=5)

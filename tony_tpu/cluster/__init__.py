@@ -3,3 +3,5 @@ from tony_tpu.cluster.local import LocalProcessBackend  # noqa: F401
 from tony_tpu.cluster.tpu import (  # noqa: F401
     FakeSliceProvisioner, SliceLease, SliceProvisionError, SliceProvisioner,
     StaticSshProvisioner, TpuSliceBackend)
+from tony_tpu.cluster.gcloud import (  # noqa: F401
+    GcloudSliceLease, GcloudTpuProvisioner, TpuApiClient, TpuApiError)

@@ -93,7 +93,13 @@ class HostChannel:
 
     def alive(self) -> bool:
         """Is the host itself still reachable?"""
-        return True
+        return not getattr(self, "_forced_lost", False)
+
+    def mark_lost(self) -> None:
+        """An outside authority (the cloud API reporting the node
+        PREEMPTED/DELETED — cluster/gcloud.py) declares this host gone:
+        ``alive()`` goes False without waiting for a probe to time out."""
+        self._forced_lost = True
 
     def log_paths(self, handle: object) -> Optional[Tuple[str, str]]:
         return None
@@ -164,6 +170,11 @@ class LocalSimHostChannel(HostChannel):
 
     def alive(self) -> bool:
         return self._alive
+
+    def mark_lost(self) -> None:
+        # For a sim host, "the cloud reclaimed the VM" means its
+        # processes die too.
+        self.simulate_loss()
 
     def log_paths(self, handle):
         wd = handle["workdir"]
@@ -276,6 +287,13 @@ class SshHostChannel(HostChannel):
     def poll(self, handle) -> Optional[int]:
         rc = handle["popen"].poll()
         if rc is None:
+            if getattr(self, "_forced_lost", False):
+                # The cloud API declared the VM gone (lease check). The
+                # local ssh client may take minutes of TCP timeout to
+                # notice (a SUSPENDED VM drops packets silently); tasks
+                # on this host are lost NOW — waiting would wedge
+                # gang_active() and block the re-lease.
+                return HOST_LOST_EXIT
             return None
         if rc == 255:
             # ssh reports ITS OWN failures as 255, but a remote command
@@ -288,6 +306,8 @@ class SshHostChannel(HostChannel):
         return 128 - rc if rc < 0 else rc
 
     def alive(self) -> bool:
+        if getattr(self, "_forced_lost", False):
+            return False    # the cloud API already said the VM is gone
         # A real ssh probe per call would serialize 15 s round trips into
         # every launch (lost_hosts() runs before each one) — cache for 5 s.
         now = time.monotonic()
@@ -585,6 +605,12 @@ class TpuSliceBackend(Backend):
 
     def poll_completions(self) -> List[Tuple[str, int]]:
         self._maybe_test_fail_host()
+        if self.lease is not None and hasattr(self.lease, "check"):
+            # Leases with an external health authority (the Cloud TPU API:
+            # preemption flips the node state server-side) get it consulted
+            # on the same cadence as task polling; a terminal state marks
+            # every host lost and the loop below reports the tasks.
+            self.lease.check()
         done: List[Tuple[str, int]] = []
         with self._lock:
             tasks = list(self._tasks.values())

@@ -62,7 +62,9 @@ APPLICATION_BACKEND = _key(
 SLICE_PROVISIONER = _key(
     "tony.slice.provisioner", "fake", str,
     "tpu-slice backend only: fake (LocalSimHostChannel inventory for "
-    "tests/CI) | ssh (StaticSshProvisioner over tony.slice.hosts).")
+    "tests/CI) | ssh (StaticSshProvisioner over tony.slice.hosts) | "
+    "gcloud (GcloudTpuProvisioner — the framework creates/deletes TPU "
+    "nodes itself via the Cloud TPU API; see tony.gcloud.*).")
 SLICE_NUM_HOSTS = _key(
     "tony.slice.num-hosts", 1, int,
     "tpu-slice backend only: hosts per slice lease (all-or-nothing grant; "
@@ -80,6 +82,59 @@ SLICE_FAKE_INVENTORY = _key(
     "tpu-slice+fake only: total fake hosts in the provisioner inventory; "
     "0 means same as tony.slice.num-hosts (deny-capacity tests set it "
     "lower).")
+GCLOUD_PROJECT = _key(
+    "tony.gcloud.project", "", str,
+    "tpu-slice+gcloud only: GCP project the provisioner creates TPU nodes "
+    "in (cluster/gcloud.py — the YARN-RM role, "
+    "ApplicationMaster.java:1051-1070, re-designed as the Cloud TPU API).")
+GCLOUD_ZONE = _key(
+    "tony.gcloud.zone", "", str,
+    "tpu-slice+gcloud only: zone for TPU nodes (e.g. us-central2-b).")
+GCLOUD_ACCELERATOR_TYPE = _key(
+    "tony.gcloud.accelerator-type", "", str,
+    "tpu-slice+gcloud only: TPU accelerator type to create (e.g. "
+    "v5litepod-16); its host count must equal tony.slice.num-hosts.")
+GCLOUD_RUNTIME_VERSION = _key(
+    "tony.gcloud.runtime-version", "tpu-ubuntu2204-base", str,
+    "tpu-slice+gcloud only: TPU VM runtime image version.")
+GCLOUD_NODE_PREFIX = _key(
+    "tony.gcloud.node-prefix", "tony", str,
+    "tpu-slice+gcloud only: created node names are "
+    "<prefix>-<random>; the random suffix avoids collisions across "
+    "concurrent jobs (409s retry with a fresh name).")
+GCLOUD_SSH_USER = _key(
+    "tony.gcloud.ssh-user", "", str,
+    "tpu-slice+gcloud only: login user for ssh channels onto the node's "
+    "VMs; empty = the coordinator's current user.")
+GCLOUD_SPOT = _key(
+    "tony.gcloud.spot", False, bool,
+    "tpu-slice+gcloud only: create preemptible (spot) nodes. Preemption "
+    "is detected via the node state and recovers through the normal "
+    "re-lease + retry-epoch machinery (plus the in-VM advance-notice "
+    "watcher, executor/preemption.py).")
+GCLOUD_NETWORK = _key(
+    "tony.gcloud.network", "", str,
+    "tpu-slice+gcloud only: VPC network for the node; empty = project "
+    "default.")
+GCLOUD_CREATE_TIMEOUT_S = _key(
+    "tony.gcloud.create-timeout-s", 900, int,
+    "tpu-slice+gcloud only: bound on create-operation + READY polling "
+    "before the acquire fails (and deletes the half-created node).")
+GCLOUD_POLL_INTERVAL_S = _key(
+    "tony.gcloud.poll-interval-s", 5.0, float,
+    "tpu-slice+gcloud only: cadence for operation/READY polling and for "
+    "the lease's node-state health checks.")
+GCLOUD_CHANNEL = _key(
+    "tony.gcloud.channel", "ssh", str,
+    "tpu-slice+gcloud only: how to reach the node's VMs: ssh (production) "
+    "| localsim (test substrate: each API-reported endpoint becomes a "
+    "local process host, so the full create/preempt/delete lifecycle is "
+    "e2e-testable against the fake API server).")
+GCLOUD_API_ENDPOINT = _key(
+    "tony.gcloud.api-endpoint", "", str,
+    "tpu-slice+gcloud only: Cloud TPU API endpoint override (tests point "
+    "this at tests/tpu_api_fake_server.py; empty = "
+    "https://tpu.googleapis.com, or the TONY_TPU_API_ENDPOINT env var).")
 APPLICATION_PROFILER_ENABLED = _key(
     "tony.application.profiler-enabled", False, bool,
     "Export TONY_PROFILE_DIR (under the job history dir) to the chief "
@@ -425,7 +480,7 @@ def coerce(name: str, value: Any) -> Any:
                 raise ValueError(f"config key {name!r} needs an integer, "
                                  f"got {value!r}") from e
         return value
-    if value in ("", None) and key.type in (int, bool):
+    if value in ("", None) and key.type in (int, bool, float):
         return key.default
     if key.type is bool and isinstance(value, str):
         return value.strip().lower() in ("true", "1", "yes", "on")
@@ -434,6 +489,12 @@ def coerce(name: str, value: Any) -> Any:
             return int(value)
         except (TypeError, ValueError) as e:
             raise ValueError(f"config key {name!r} needs an integer, "
+                             f"got {value!r}") from e
+    if key.type is float and not isinstance(value, bool):
+        try:
+            return float(value)
+        except (TypeError, ValueError) as e:
+            raise ValueError(f"config key {name!r} needs a number, "
                              f"got {value!r}") from e
     if key.type is str:
         return str(value)

@@ -47,6 +47,37 @@ def _make_backend(conf, workdir):
         elif prov_kind == "fake":
             inv = int(conf.get(K.SLICE_FAKE_INVENTORY, 0)) or n_hosts
             prov = FakeSliceProvisioner(inv, os.path.join(workdir, "hosts"))
+        elif prov_kind == "gcloud":
+            # The framework acquires its own compute via the Cloud TPU API
+            # (cluster/gcloud.py) — no operator-run create-tpu-slice.sh.
+            from tony_tpu.cluster.gcloud import (GcloudTpuProvisioner,
+                                                TpuApiClient,
+                                                localsim_channel_factory)
+
+            api = TpuApiClient(
+                project=str(conf.get(K.GCLOUD_PROJECT, "")),
+                zone=str(conf.get(K.GCLOUD_ZONE, "")),
+                endpoint=str(conf.get(K.GCLOUD_API_ENDPOINT, "")) or None)
+            factory = None
+            if str(conf.get(K.GCLOUD_CHANNEL, "ssh")) == "localsim":
+                factory = localsim_channel_factory(
+                    os.path.join(workdir, "hosts"))
+            prov = GcloudTpuProvisioner(
+                api,
+                accelerator_type=str(
+                    conf.get(K.GCLOUD_ACCELERATOR_TYPE, "")),
+                runtime_version=str(conf.get(K.GCLOUD_RUNTIME_VERSION, "")),
+                node_prefix=str(conf.get(K.GCLOUD_NODE_PREFIX, "tony")),
+                ssh_user=str(conf.get(K.GCLOUD_SSH_USER, "")),
+                remote_python=str(
+                    conf.get(K.SLICE_REMOTE_PYTHON, "python3")),
+                create_timeout_s=float(
+                    conf.get(K.GCLOUD_CREATE_TIMEOUT_S, 900)),
+                poll_interval_s=float(
+                    conf.get(K.GCLOUD_POLL_INTERVAL_S, 5.0)),
+                spot=bool(conf.get(K.GCLOUD_SPOT, False)),
+                network=str(conf.get(K.GCLOUD_NETWORK, "")),
+                channel_factory=factory)
         else:
             raise ValueError(f"unknown tony.slice.provisioner {prov_kind!r}")
         return TpuSliceBackend(prov, n_hosts, workdir)

@@ -53,13 +53,12 @@ from urllib import error as urlerror
 from urllib import request as urlrequest
 from urllib.parse import quote, unquote, urlparse
 
+from tony_tpu.utils.gcp import GcpBearer
+
 STORAGE_TOKEN_ENV = "TONY_STORAGE_TOKEN"
 FAKE_GCS_ROOT_ENV = "TONY_FAKE_GCS_ROOT"
 GCS_ENDPOINT_ENV = "TONY_GCS_ENDPOINT"
 REQUIRE_TOKEN_MARKER = ".require_token"
-
-_METADATA_TOKEN_URL = ("http://metadata.google.internal/computeMetadata/v1/"
-                       "instance/service-accounts/default/token")
 
 
 class StoreAuthError(PermissionError):
@@ -244,37 +243,15 @@ class GcsStore(Store):
                  retries: int = 4, backoff_s: float = 1.0):
         self.endpoint = (endpoint or os.environ.get(GCS_ENDPOINT_ENV)
                          or "https://storage.googleapis.com").rstrip("/")
-        self._explicit_cred = credential
-        self._token: Optional[str] = credential
-        self._token_expiry = float("inf") if credential else 0.0
+        self._auth = GcpBearer(credential)
         self.retries = retries
         self.backoff_s = backoff_s
 
     # -- auth ----------------------------------------------------------
     def _bearer(self) -> Optional[str]:
-        if self._token and time.time() < self._token_expiry - 60:
-            return self._token
-        env_tok = os.environ.get("GOOGLE_OAUTH_ACCESS_TOKEN")
-        if env_tok:
-            self._token, self._token_expiry = env_tok, float("inf")
-            return self._token
-        if time.time() < getattr(self, "_anon_until", 0.0):
-            # Negative cache: off-GCP there is no metadata server, and
-            # paying its 5 s connect timeout per object would turn an
-            # N-object anonymous get_tree into N stalls.
-            return None
-        try:
-            req = urlrequest.Request(_METADATA_TOKEN_URL,
-                                     headers={"Metadata-Flavor": "Google"})
-            with urlrequest.urlopen(req, timeout=5) as r:
-                body = json.loads(r.read().decode())
-            self._token = body.get("access_token")
-            self._token_expiry = time.time() + float(
-                body.get("expires_in", 300))
-        except Exception:  # noqa: BLE001 — off-GCP: anonymous
-            self._token = None
-            self._anon_until = time.time() + 300
-        return self._token
+        # Shared resolution (explicit → env → metadata server, cached with
+        # negative cache): utils/gcp.py, also used by the TPU provisioner.
+        return self._auth.token()
 
     # -- http ----------------------------------------------------------
     def _request(self, method: str, url: str, data: Optional[bytes] = None,
@@ -320,11 +297,11 @@ class GcsStore(Store):
                 if e.code == 404:
                     raise FileNotFoundError(f"{url} not in store") from e
                 if e.code in (401, 403):
-                    if not refreshed_auth and self._explicit_cred is None:
+                    if not refreshed_auth and self._auth.explicit is None:
                         # Cached env/metadata token may simply have
                         # expired: drop it and retry once with a fresh one.
                         refreshed_auth = True
-                        self._token, self._token_expiry = None, 0.0
+                        self._auth.invalidate()
                         continue
                     raise StoreAuthError(
                         f"GCS denied {method} {url}: HTTP {e.code} "
