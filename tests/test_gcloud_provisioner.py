@@ -169,22 +169,26 @@ def test_name_conflict_retries_with_fresh_suffix(monkeypatch):
 
 
 def test_lost_create_response_adopts_own_node(monkeypatch):
-    """A 409 on a name whose node exists WITH our label and shape is our
-    own create whose response was lost mid-retry — the provisioner must
-    adopt that (running, billing) node, not abandon it."""
+    """A 409 on a name whose node carries THIS attempt's nonce label is
+    our own create whose response was lost mid-retry — the provisioner
+    must adopt that (running, billing) node, not abandon it. A node
+    without the nonce (another job's) is never adopted — see
+    test_name_conflict_retries_with_fresh_suffix."""
     seq = [b"\x00\x00\x00"]
     real_urandom = os.urandom
     monkeypatch.setattr(
         "tony_tpu.cluster.gcloud.os.urandom",
-        lambda n: seq.pop(0) if seq and n == 3 else real_urandom(n))
+        lambda n: (seq.pop(0) if seq and n == 3
+                   else b"\x00" * 8 if n == 8 else real_urandom(n)))
     server = TpuApiFakeServer().start()
     try:
-        # The pre-existing node looks exactly like what our create built:
-        # tony-managed label, matching accelerator type, READY.
+        # The pre-existing node looks exactly like what our create built —
+        # crucially including the per-attempt nonce label.
         server.nodes["tony-000000"] = {
             "name": "projects/proj/locations/z/nodes/tony-000000",
             "state": "READY", "acceleratorType": "v5litepod-16",
-            "labels": {"tony-managed": "true"},
+            "labels": {"tony-managed": "true",
+                       "tony-nonce": "00" * 8},
             "networkEndpoints": [{"ipAddress": "10.9.9.9", "port": 8470}]}
         prov = _prov(_api(server),
                      channel_factory=lambda hid, ep: _localsim(hid))

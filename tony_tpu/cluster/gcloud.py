@@ -83,7 +83,8 @@ class TpuApiClient:
     def __init__(self, project: str, zone: str,
                  endpoint: Optional[str] = None,
                  credential: Optional[str] = None,
-                 retries: int = 4, backoff_s: float = 1.0):
+                 retries: int = 4, backoff_s: float = 1.0,
+                 timeout_s: float = 60.0):
         if not project or not zone:
             raise ValueError("TpuApiClient needs a project and a zone")
         self.project = project
@@ -93,6 +94,19 @@ class TpuApiClient:
         self._auth = GcpBearer(credential)
         self.retries = retries
         self.backoff_s = backoff_s
+        self.timeout_s = timeout_s
+
+    def probe_clone(self) -> "TpuApiClient":
+        """A low-latency sibling for health probes: no retries, short
+        timeout, SAME auth cache. Control-plane mutations want the full
+        retry discipline; a periodic health check running inside the
+        coordinator's poll loop must never stall it for minutes on an
+        API blip (it tolerates failure anyway — it just returns)."""
+        clone = TpuApiClient.__new__(TpuApiClient)
+        clone.__dict__.update(self.__dict__)
+        clone.retries = 0
+        clone.timeout_s = 10.0
+        return clone
 
     @property
     def parent(self) -> str:
@@ -103,6 +117,7 @@ class TpuApiClient:
         return json_request(method, f"{self.endpoint}/v2/{path}",
                             auth=self._auth, body=body,
                             retries=self.retries, backoff_s=self.backoff_s,
+                            timeout_s=self.timeout_s,
                             error_cls=TpuApiError)
 
     # -- the four calls the provisioner makes --------------------------
@@ -149,7 +164,9 @@ class GcloudSliceLease(SliceLease):
     def __init__(self, slice_id: str, hosts: List[HostChannel],
                  api: TpuApiClient, poll_interval_s: float):
         super().__init__(slice_id, hosts)
-        self._api = api
+        # Health probes ride a no-retry/short-timeout clone so a flaky
+        # API endpoint cannot stall the coordinator's poll loop.
+        self._api = api.probe_clone()
         self._poll_interval_s = poll_interval_s
         self._last_check = 0.0
         self.terminal_state: Optional[str] = None
@@ -235,11 +252,14 @@ class GcloudTpuProvisioner(SliceProvisioner):
                               python=self.remote_python)
 
     # -- SliceProvisioner ----------------------------------------------
-    def _node_body(self) -> dict:
+    def _node_body(self, nonce: str) -> dict:
         body: dict = {
             "acceleratorType": self.accelerator_type,
             "runtimeVersion": self.runtime_version,
-            "labels": {"tony-managed": "true"},
+            # The nonce makes THIS create attempt identifiable: a 409
+            # whose existing node carries it is our own create with a
+            # lost response, not someone else's node (see acquire).
+            "labels": {"tony-managed": "true", "tony-nonce": nonce},
         }
         if self.spot:
             body["schedulingConfig"] = {"preemptible": True}
@@ -248,25 +268,30 @@ class GcloudTpuProvisioner(SliceProvisioner):
         return body
 
     def acquire(self, n_hosts: int, node_pool: str = "") -> SliceLease:
+        # ONE deadline for the whole acquire (create op + READY polling)
+        # — tony.gcloud.create-timeout-s promises a bound on the sum, not
+        # per phase.
+        deadline = time.monotonic() + self.create_timeout_s
         node_id = ""
         op: Optional[dict] = None
         last_err: Optional[Exception] = None
         for _ in range(3):
             node_id = f"{self.node_prefix}-{os.urandom(3).hex()}"
+            nonce = os.urandom(8).hex()
             try:
-                op = self.api.create_node(node_id, self._node_body())
+                op = self.api.create_node(node_id, self._node_body(nonce))
                 break
             except TpuApiError as e:
                 if e.code == 409:
                     # Two ways to 409 on a name WE just randomized: our
                     # own create succeeded but its response was lost and
-                    # the transport retry hit the existing node (the
-                    # likely case — 2^24 random space makes a true
-                    # collision vanishingly rare), or another job really
-                    # holds the name. Probe: a tony-managed node of our
-                    # shape is ours — adopt it rather than leak a
-                    # billing node with no owner.
-                    if self._probe_is_ours(node_id):
+                    # the transport retry hit the existing node, or
+                    # another job really holds the name. The per-attempt
+                    # nonce label distinguishes them exactly — only OUR
+                    # lost create carries this nonce, so a concurrent
+                    # tony job's node can never be adopted (and later
+                    # deleted) by mistake.
+                    if self._probe_is_ours(node_id, nonce):
                         log.warning(
                             "create of %s 409'd but the node is ours "
                             "(lost create response); adopting", node_id)
@@ -282,9 +307,10 @@ class GcloudTpuProvisioner(SliceProvisioner):
         self._owned[node_id] = True
         try:
             if op is not None:
-                self.api.wait_operation(op, self.create_timeout_s,
-                                        self.poll_interval_s)
-            node = self._await_ready(node_id)
+                self.api.wait_operation(
+                    op, max(0.0, deadline - time.monotonic()),
+                    self.poll_interval_s)
+            node = self._await_ready(node_id, deadline)
             endpoints = node.get("networkEndpoints") or []
             if len(endpoints) != n_hosts:
                 raise SliceProvisionError(
@@ -306,21 +332,20 @@ class GcloudTpuProvisioner(SliceProvisioner):
         return GcloudSliceLease(node_id, hosts, self.api,
                                 self.poll_interval_s)
 
-    def _probe_is_ours(self, node_id: str) -> bool:
-        """After a 409 on a name we generated: does the node exist with
-        our label and shape? (The lost-create-response case.)"""
+    def _probe_is_ours(self, node_id: str, nonce: str) -> bool:
+        """After a 409 on a name we generated: does the node carry the
+        nonce of THIS create attempt? (The lost-create-response case.)"""
         try:
             node = self.api.get_node(node_id)
         except Exception:  # noqa: BLE001 — can't tell: treat as not ours
             return False
-        return (node.get("labels", {}).get("tony-managed") == "true"
-                and node.get("acceleratorType") == self.accelerator_type)
+        return node.get("labels", {}).get("tony-nonce") == nonce
 
-    def _await_ready(self, node_id: str) -> dict:
+    def _await_ready(self, node_id: str, deadline: float) -> dict:
         """The create op finishing does not mean the node is usable —
         poll the node itself to READY (the API may report CREATING for a
-        while after, and endpoints appear only when READY)."""
-        deadline = time.monotonic() + self.create_timeout_s
+        while after, and endpoints appear only when READY). ``deadline``
+        is the acquire-wide monotonic bound."""
         while True:
             node = self.api.get_node(node_id)
             state = str(node.get("state", ""))

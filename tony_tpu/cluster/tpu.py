@@ -104,6 +104,16 @@ class HostChannel:
     def log_paths(self, handle: object) -> Optional[Tuple[str, str]]:
         return None
 
+    def fetch_logs(self, handle: object) -> None:
+        """Pull the task's stdout/stderr to the coordinator's machine if
+        they live remotely — a no-op where ``log_paths`` already points at
+        local files. Called by the backend when a task completes or is
+        killed, BEFORE the TASK_FINISHED event snapshots the paths, so
+        `tony-tpu logs` / the portal read real content instead of paths
+        stranded on a TPU VM (the reference surfaces NodeManager log URLs
+        per container, ``models/JobLog.java:69-80``,
+        ``util/Utils.java:215-230``; with no NM, the coordinator fetches)."""
+
 
 class LocalSimHostChannel(HostChannel):
     """A 'host' that is really a local process group — same contract as a
@@ -292,7 +302,14 @@ class SshHostChannel(HostChannel):
                 # local ssh client may take minutes of TCP timeout to
                 # notice (a SUSPENDED VM drops packets silently); tasks
                 # on this host are lost NOW — waiting would wedge
-                # gang_active() and block the re-lease.
+                # gang_active() and block the re-lease. Kill the local
+                # client too: the task is terminal after this report, so
+                # nothing else would ever reap the hung ssh process.
+                handle["popen"].kill()
+                try:
+                    handle["popen"].wait(timeout=5)
+                except subprocess.TimeoutExpired:
+                    pass
                 return HOST_LOST_EXIT
             return None
         if rc == 255:
@@ -304,6 +321,66 @@ class SshHostChannel(HostChannel):
             self._alive_cache = None
             return 255 if self.alive() else HOST_LOST_EXIT
         return 128 - rc if rc < 0 else rc
+
+    #: bound on fetched log size per stream — TASK_FINISHED wants tails
+    #: for diagnosis, not multi-GB training stdout over the control plane
+    LOG_TAIL_BYTES = 1024 * 1024
+
+    def fetch_logs(self, handle) -> None:
+        if handle.get("logs_fetched"):
+            return
+        if not self.alive():
+            # The VM is gone (preemption/suspend) and its disk with it;
+            # paying ssh connect timeouts per stream would stall the
+            # coordinator's completion loop for nothing.
+            return
+        wd = handle["workdir"]
+        os.makedirs(wd, exist_ok=True)   # local mirror of the remote path
+        for name in ("stdout.log", "stderr.log"):
+            local = os.path.join(wd, name)
+            # Download to a temp file, then atomically replace: on a
+            # shared filesystem (or the stub-ssh test substrate) the
+            # "remote" file IS this local path, and opening it for write
+            # before tail reads it would truncate the very content being
+            # fetched.
+            tmp = local + ".fetch-tmp"
+            ok = False
+            try:
+                with open(tmp, "wb") as f:
+                    p = self._ssh(
+                        f"tail -c {self.LOG_TAIL_BYTES} "
+                        f"{shlex.quote(wd)}/{name} 2>/dev/null || true",
+                        stdout=f, stderr=subprocess.DEVNULL)
+                    try:
+                        ok = p.wait(timeout=30) == 0
+                    except subprocess.TimeoutExpired:
+                        p.kill()
+                # Replace only on a CLEAN fetch: a transport failure
+                # (255) or timeout leaves tmp empty/partial, and on a
+                # shared filesystem `local` IS the authoritative file —
+                # clobbering it with a bad fetch would destroy the log.
+                if ok:
+                    os.replace(tmp, local)
+            except OSError as e:
+                ok = False
+                log.warning("could not fetch %s from %s: %s", name,
+                            self.host_id, e)
+            if not ok:
+                try:
+                    os.unlink(tmp)
+                except OSError:
+                    pass
+        handle["logs_fetched"] = True
+
+    def log_paths(self, handle) -> Optional[Tuple[str, str]]:
+        """The FETCHED copies (fetch_logs), which mirror the remote
+        workdir path locally; None until a fetch produced content."""
+        wd = handle["workdir"]
+        out = os.path.join(wd, "stdout.log")
+        err = os.path.join(wd, "stderr.log")
+        if os.path.isfile(out) or os.path.isfile(err):
+            return (out, err)
+        return None
 
     def alive(self) -> bool:
         if getattr(self, "_forced_lost", False):
@@ -602,6 +679,9 @@ class TpuSliceBackend(Backend):
     def kill_task(self, handle: object, grace_s: float = 0.0) -> None:
         if isinstance(handle, _SliceTask):
             handle.host.kill(handle.handle, grace_s=grace_s)
+            # A force-killed job's logs are the diagnosis artifact; pull
+            # them while the host (and lease) still exist.
+            handle.host.fetch_logs(handle.handle)
 
     def poll_completions(self) -> List[Tuple[str, int]]:
         self._maybe_test_fail_host()
@@ -623,6 +703,10 @@ class TpuSliceBackend(Backend):
                 if rc == HOST_LOST_EXIT and not st.host.alive():
                     log.warning("host %s lost; %s reported exit %d",
                                 st.host.host_id, st.spec.task_id, rc)
+                # Bring remote stdout/stderr home BEFORE the coordinator
+                # snapshots log paths into TASK_FINISHED (no-op for local
+                # channels; skipped for dead hosts).
+                st.host.fetch_logs(st.handle)
                 done.append((st.spec.task_id, rc))
         return done
 
@@ -639,6 +723,7 @@ class TpuSliceBackend(Backend):
         for st in tasks:
             if st.host.alive():
                 st.host.kill(st.handle, grace_s=0.5)
+                st.host.fetch_logs(st.handle)
         if self.lease is not None:
             self.provisioner.release(self.lease)
             self.lease = None

@@ -67,7 +67,7 @@ class GcpBearer:
 
 def json_request(method: str, url: str, auth: GcpBearer,
                  body: Optional[dict] = None, retries: int = 4,
-                 backoff_s: float = 1.0,
+                 backoff_s: float = 1.0, timeout_s: float = 60.0,
                  error_cls: Type[Exception] = RuntimeError) -> dict:
     """One JSON-API call with bearer auth and bounded retry — the retry
     discipline shared by GCP control-plane clients (the Cloud TPU
@@ -102,7 +102,7 @@ def json_request(method: str, url: str, auth: GcpBearer,
         req = urlrequest.Request(url, data=data, headers=headers,
                                  method=method)
         try:
-            with urlrequest.urlopen(req, timeout=60) as r:
+            with urlrequest.urlopen(req, timeout=timeout_s) as r:
                 return json.loads(r.read().decode() or "{}")
         except urlerror.HTTPError as e:
             detail = e.read().decode(errors="replace")[:512]
