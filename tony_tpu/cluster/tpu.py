@@ -336,6 +336,10 @@ class SshHostChannel(HostChannel):
             return
         wd = handle["workdir"]
         os.makedirs(wd, exist_ok=True)   # local mirror of the remote path
+        # Both streams fetch CONCURRENTLY (launch all, then wait): this
+        # runs inside the coordinator's poll loop, where serial 30 s ssh
+        # round trips would stall completion processing for the gang.
+        procs = []
         for name in ("stdout.log", "stderr.log"):
             local = os.path.join(wd, name)
             # Download to a temp file, then atomically replace: on a
@@ -344,33 +348,47 @@ class SshHostChannel(HostChannel):
             # before tail reads it would truncate the very content being
             # fetched.
             tmp = local + ".fetch-tmp"
-            ok = False
             try:
-                with open(tmp, "wb") as f:
-                    p = self._ssh(
-                        f"tail -c {self.LOG_TAIL_BYTES} "
-                        f"{shlex.quote(wd)}/{name} 2>/dev/null || true",
-                        stdout=f, stderr=subprocess.DEVNULL)
-                    try:
-                        ok = p.wait(timeout=30) == 0
-                    except subprocess.TimeoutExpired:
-                        p.kill()
-                # Replace only on a CLEAN fetch: a transport failure
-                # (255) or timeout leaves tmp empty/partial, and on a
-                # shared filesystem `local` IS the authoritative file —
-                # clobbering it with a bad fetch would destroy the log.
-                if ok:
-                    os.replace(tmp, local)
+                f = open(tmp, "wb")
+                p = self._ssh(
+                    f"tail -c {self.LOG_TAIL_BYTES} "
+                    f"{shlex.quote(wd)}/{name} 2>/dev/null || true",
+                    stdout=f, stderr=subprocess.DEVNULL)
+                procs.append((name, local, tmp, f, p))
             except OSError as e:
-                ok = False
                 log.warning("could not fetch %s from %s: %s", name,
                             self.host_id, e)
+        all_ok = len(procs) == 2
+        for name, local, tmp, f, p in procs:
+            ok = False
+            try:
+                ok = p.wait(timeout=15) == 0
+            except subprocess.TimeoutExpired:
+                p.kill()
+                try:
+                    p.wait(timeout=5)    # reap — no zombie per timeout
+                except subprocess.TimeoutExpired:
+                    pass
+            f.close()
+            # Replace only on a CLEAN fetch: a transport failure (255)
+            # or timeout leaves tmp empty/partial, and on a shared
+            # filesystem `local` IS the authoritative file — clobbering
+            # it with a bad fetch would destroy the log.
+            if ok:
+                try:
+                    os.replace(tmp, local)
+                except OSError:
+                    ok = False
             if not ok:
+                all_ok = False
                 try:
                     os.unlink(tmp)
                 except OSError:
                     pass
-        handle["logs_fetched"] = True
+        if all_ok:
+            # Only a fully-clean fetch is final; a transient ssh failure
+            # stays retryable (the next completion/kill hook retries).
+            handle["logs_fetched"] = True
 
     def log_paths(self, handle) -> Optional[Tuple[str, str]]:
         """The FETCHED copies (fetch_logs), which mirror the remote
@@ -692,6 +710,7 @@ class TpuSliceBackend(Backend):
             # every host lost and the loop below reports the tasks.
             self.lease.check()
         done: List[Tuple[str, int]] = []
+        newly_done: List[_SliceTask] = []
         with self._lock:
             tasks = list(self._tasks.values())
         for st in tasks:
@@ -703,11 +722,22 @@ class TpuSliceBackend(Backend):
                 if rc == HOST_LOST_EXIT and not st.host.alive():
                     log.warning("host %s lost; %s reported exit %d",
                                 st.host.host_id, st.spec.task_id, rc)
-                # Bring remote stdout/stderr home BEFORE the coordinator
-                # snapshots log paths into TASK_FINISHED (no-op for local
-                # channels; skipped for dead hosts).
-                st.host.fetch_logs(st.handle)
+                newly_done.append(st)
                 done.append((st.spec.task_id, rc))
+        # Bring remote stdout/stderr home BEFORE the coordinator snapshots
+        # log paths into TASK_FINISHED (no-op for local channels; skipped
+        # for dead hosts) — one thread per task so a whole gang finishing
+        # in one poll cycle pays one fetch latency, not N.
+        if len(newly_done) > 1:
+            fetchers = [threading.Thread(target=st.host.fetch_logs,
+                                         args=(st.handle,), daemon=True)
+                        for st in newly_done]
+            for t in fetchers:
+                t.start()
+            for t in fetchers:
+                t.join(timeout=30)
+        elif newly_done:
+            newly_done[0].host.fetch_logs(newly_done[0].handle)
         return done
 
     def task_log_paths(self, task_id: str) -> Optional[Tuple[str, str]]:

@@ -366,7 +366,7 @@ def _round_up(x: int, m: int) -> int:
     return -(-x // m) * m
 
 
-def _fwd_impl(q, k, v, scale, causal, block_q, block_k):
+def _fwd_impl(q, k, v, scale, causal, block_q, block_k, out_dtype=None):
     b, h, sq, d = q.shape
     hk = k.shape[1]
     g = h // hk
@@ -406,7 +406,10 @@ def _fwd_impl(q, k, v, scale, causal, block_q, block_k):
                          lambda b, h, i, j: (b, h, 0, i)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((b, h, sq, d), q.dtype),
+            # out_dtype=f32 hands the caller the kernel's own f32
+            # accumulator unrounded — ring attention threads it through
+            # hops so error stays flat in sp degree (ops/ring.py).
+            jax.ShapeDtypeStruct((b, h, sq, d), out_dtype or q.dtype),
             jax.ShapeDtypeStruct((b, h, STAT_SUB, sq), jnp.float32),
         ],
         scratch_shapes=(
@@ -546,22 +549,27 @@ def _flash_bwd(scale, causal, block_q, block_k, res, do):
 _flash.defvjp(_flash_fwd, _flash_bwd)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
-def _flash_lse(q, k, v, scale, causal, block_q, block_k):
-    o, lse = _fwd_impl(q, k, v, scale, causal, block_q, block_k)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def _flash_lse(q, k, v, scale, causal, block_q, block_k, out_dtype):
+    o, lse = _fwd_impl(q, k, v, scale, causal, block_q, block_k, out_dtype)
     return o, lse[:, :, 0, :]
 
 
-def _flash_lse_fwd(q, k, v, scale, causal, block_q, block_k):
-    o, lse = _fwd_impl(q, k, v, scale, causal, block_q, block_k)
+def _flash_lse_fwd(q, k, v, scale, causal, block_q, block_k, out_dtype):
+    o, lse = _fwd_impl(q, k, v, scale, causal, block_q, block_k, out_dtype)
     return (o, lse[:, :, 0, :]), (q, k, v, o, lse)
 
 
-def _flash_lse_bwd(scale, causal, block_q, block_k, res, cts):
+def _flash_lse_bwd(scale, causal, block_q, block_k, out_dtype, res, cts):
     do, dlse = cts
     q, k, v, o, lse = res
-    return _bwd_impl(q, k, v, o, lse, do, scale, causal, block_q, block_k,
-                     dlse=dlse)
+    # With out_dtype=f32 the cotangent arrives f32 while q/k/v are bf16;
+    # the backward kernels' matmuls must stay at the INPUT dtype's MXU
+    # rate (and Mosaic wants matched operand dtypes) — the o·do delta
+    # product inside _bwd_impl is f32 regardless, so no precision is
+    # given up that the pre-out_dtype path had.
+    return _bwd_impl(q, k, v, o, lse, do.astype(q.dtype), scale, causal,
+                     block_q, block_k, dlse=dlse)
 
 
 _flash_lse.defvjp(_flash_lse_fwd, _flash_lse_bwd)
@@ -593,7 +601,8 @@ def flash_attention_with_lse(q: jax.Array, k: jax.Array, v: jax.Array,
                              causal: bool = True,
                              scale: Optional[float] = None,
                              block_q: int = DEFAULT_BLOCK,
-                             block_k: int = DEFAULT_BLOCK):
+                             block_k: int = DEFAULT_BLOCK,
+                             out_dtype=None):
     """Flash attention returning ``(o [B,S,H,D], lse [B,S,H] f32)``.
 
     ``lse`` is the per-row logsumexp of the (scaled, masked) scores — the
@@ -605,9 +614,14 @@ def flash_attention_with_lse(q: jax.Array, k: jax.Array, v: jax.Array,
 
     which is what ring attention does across ``sp`` hops (``ops/ring.py``).
     Both outputs are differentiable (the lse cotangent rides the existing
-    backward's delta statistic)."""
+    backward's delta statistic).
+
+    ``out_dtype=jnp.float32`` returns the kernel's f32 accumulator
+    unrounded (inputs and matmul rate unchanged) — for callers that merge
+    partials and must not pay a bf16 rounding per merge."""
     qh, kh, vh, scale = _check_and_transpose(q, k, v, causal, scale)
-    oh, lse = _flash_lse(qh, kh, vh, scale, causal, block_q, block_k)
+    oh, lse = _flash_lse(qh, kh, vh, scale, causal, block_q, block_k,
+                         out_dtype)
     return oh.transpose(0, 2, 1, 3), lse.transpose(0, 2, 1)
 
 

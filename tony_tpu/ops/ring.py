@@ -99,14 +99,13 @@ def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     carry H_kv heads with H_kv | H). Call inside shard_map with the
     sequence dim sharded over ``axis_name``.
 
-    Precision note (blockwise design tradeoff): each hop's partial output
-    leaves the flash kernel in the INPUT dtype (bf16 in production) and is
-    upcast to f32 only for the logsumexp merge — per-hop results are
-    rounded to bf16 before accumulation, so error grows ~linearly with the
-    number of hops (sp degree) at long context, unlike a formulation that
-    threads one f32 accumulator through every hop. Correctness tests pass
-    at f32; if bf16 ring error at high sp degree ever matters, have the
-    internal flash path return its f32 accumulator for this caller."""
+    Precision: each hop's partial output leaves the flash kernel as the
+    kernel's OWN f32 accumulator (``out_dtype=f32`` — never rounded to the
+    input dtype), and hops merge in f32 by the exact logsumexp rule, so
+    the only rounding to bf16 is the single final cast. Ring error is
+    therefore ~flat in the sp degree (asserted by
+    ``test_ring_error_flat_in_sp_degree``); the wire/rotation dtype of the
+    K/V chunks stays the input dtype — ICI bandwidth is unchanged."""
     b, s_loc, h, d = q.shape
     hk = k.shape[2]
     if k.shape[2] != v.shape[2]:
@@ -128,17 +127,16 @@ def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     scale = scale if scale is not None else d ** -0.5
     perm = [(j, (j + 1) % n) for j in range(n)]
     flash = functools.partial(flash_attention_with_lse, scale=scale,
-                              block_q=block_q, block_k=block_k)
+                              block_q=block_q, block_k=block_k,
+                              out_dtype=jnp.float32)
 
     def hop_full(args):
         k_c, v_c = args
-        o_c, lse_c = flash(q, k_c, v_c, causal=False)
-        return o_c.astype(jnp.float32), lse_c
+        return flash(q, k_c, v_c, causal=False)
 
     def hop_diag(args):
         k_c, v_c = args
-        o_c, lse_c = flash(q, k_c, v_c, causal=True)
-        return o_c.astype(jnp.float32), lse_c
+        return flash(q, k_c, v_c, causal=True)
 
     def hop_skip(args):
         return (jnp.zeros((b, s_loc, h, d), jnp.float32),
