@@ -65,6 +65,41 @@ def test_custom_loader_and_multiple_leaves(mesh):
     assert it.step == 2
 
 
+def test_prefetch_yields_identical_stream(mesh):
+    """The double-buffered path (default prefetch=2) must hand the
+    consumer exactly the synchronous stream — same batches, same order —
+    and report `step` as CONSUMED batches (the checkpoint/resume key),
+    not how far the buffer ran ahead."""
+    sync = synthetic_lm_batches(mesh, 8, 16, 50, seed=11)
+    sync.prefetch = 0
+    pre = synthetic_lm_batches(mesh, 8, 16, 50, seed=11)
+    assert pre.prefetch == 2
+    for i in range(5):
+        np.testing.assert_array_equal(np.asarray(next(pre)["tokens"]),
+                                      np.asarray(next(sync)["tokens"]))
+        assert pre.step == i + 1
+    pre.close()
+
+
+def test_prefetch_surfaces_loader_errors():
+    mesh = build_mesh(MeshSpec(dp=8))
+
+    def boom(step, rows):
+        if step >= 2:
+            raise RuntimeError("corpus truncated")
+        n = rows.stop - rows.start
+        return {"x": np.zeros((n, 2), np.float32)}
+
+    it = ShardedBatchIterator(mesh=mesh, global_batch=8, load_local=boom,
+                              prefetch=2)
+    next(it)
+    next(it)
+    with pytest.raises(RuntimeError, match="corpus truncated"):
+        for _ in range(3):
+            next(it)
+    it.close()
+
+
 def test_token_file_dataset_windows_and_determinism(mesh, tmp_path):
     """Memory-mapped corpus reader: windows are real corpus content,
     identical across restarts AND across process layouts (rows computed

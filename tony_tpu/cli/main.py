@@ -122,9 +122,13 @@ def _coordinator_rpc(app_id: str, workdir: Optional[str]):
         return None
     with open(addr_file, encoding="utf-8") as f:
         addr = json.load(f)
+    tls = None
+    if addr.get("tls_cert"):
+        from tony_tpu.rpc.wire import client_tls_context
+        tls = client_tls_context(addr["tls_cert"])
     return RpcClient(addr["host"], addr["port"],
                      token=addr.get("token") or None,
-                     max_retries=2, retry_sleep_s=0.5)
+                     max_retries=2, retry_sleep_s=0.5, tls=tls)
 
 
 def _cmd_status(args: argparse.Namespace) -> int:

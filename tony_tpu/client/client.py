@@ -289,8 +289,12 @@ class TonyTpuClient:
                                            timeout_s=60)
         if addr is None:
             raise RuntimeError("coordinator address never appeared")
+        tls = None
+        if addr.get("tls_cert"):
+            from tony_tpu.rpc.wire import client_tls_context
+            tls = client_tls_context(addr["tls_cert"])
         return RpcClient(addr["host"], addr["port"],
-                         token=addr.get("token") or None)
+                         token=addr.get("token") or None, tls=tls)
 
     def _monitor(self, addr_file: str) -> int:
         """Reference ``monitorApplication`` :838-892 (1 s poll; task-info

@@ -327,6 +327,15 @@ class SshHostChannel(HostChannel):
     LOG_TAIL_BYTES = 1024 * 1024
 
     def fetch_logs(self, handle) -> None:
+        # One fetch at a time per handle: completion, kill and stop hooks
+        # can race (e.g. a fetch thread abandoned by a join timeout vs a
+        # later retry), and two writers interleaving into the same
+        # .fetch-tmp would corrupt the very file the atomic-replace
+        # protects. dict.setdefault is atomic under the GIL.
+        with handle.setdefault("fetch_lock", threading.Lock()):
+            self._fetch_logs_locked(handle)
+
+    def _fetch_logs_locked(self, handle) -> None:
         if handle.get("logs_fetched"):
             return
         if not self.alive():
@@ -348,6 +357,7 @@ class SshHostChannel(HostChannel):
             # before tail reads it would truncate the very content being
             # fetched.
             tmp = local + ".fetch-tmp"
+            f = None
             try:
                 f = open(tmp, "wb")
                 p = self._ssh(
@@ -358,6 +368,12 @@ class SshHostChannel(HostChannel):
             except OSError as e:
                 log.warning("could not fetch %s from %s: %s", name,
                             self.host_id, e)
+                if f is not None:       # Popen failed after open: no leak
+                    f.close()
+                    try:
+                        os.unlink(tmp)
+                    except OSError:
+                        pass
         all_ok = len(procs) == 2
         for name, local, tmp, f, p in procs:
             ok = False

@@ -194,6 +194,23 @@ APPLICATION_SECURITY_ENABLED = _key(
     "tony.application.security.enabled", False, bool,
     "Enable token auth on the control-plane RPC "
     "(reference ApplicationMaster.java:433-452).")
+SECURITY_TLS_CERT = _key(
+    "tony.application.security.tls-cert", "", str,
+    "PEM certificate path: set together with tls-key to wrap the "
+    "control-plane RPC (and the portal, if started with it) in TLS. "
+    "Clients PIN this exact cert (self-signed pairs need no CA); the "
+    "path must be readable on every host (shared fs or staged).")
+SECURITY_TLS_KEY = _key(
+    "tony.application.security.tls-key", "", str,
+    "PEM private-key path for tls-cert — needed only where servers run "
+    "(the coordinator / portal host), never on task hosts.")
+
+JAX_COMPILE_CACHE_DIR = _key(
+    "tony.jax.compilation-cache-dir", "~/.cache/tony-tpu/jaxcache", str,
+    "Persistent XLA compile cache exported to jax tasks as "
+    "JAX_COMPILATION_CACHE_DIR (host-stable path, expanded on the task "
+    "host, so repeat jobs skip first-compile — most of the cold "
+    "submit-to-first-step). The task's own env wins; empty disables.")
 
 # --- task / executor ------------------------------------------------------
 TASK_HEARTBEAT_INTERVAL_MS = _key(

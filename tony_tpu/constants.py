@@ -63,6 +63,7 @@ DMLC_USE_KUBERNETES = "DMLC_USE_KUBERNETES"
 JAX_COORDINATOR_ADDRESS = "JAX_COORDINATOR_ADDRESS"
 JAX_NUM_PROCESSES = "JAX_NUM_PROCESSES"
 JAX_PROCESS_ID = "JAX_PROCESS_ID"
+JAX_COMPILATION_CACHE_DIR = "JAX_COMPILATION_CACHE_DIR"
 
 # TensorBoard (reference Constants.java TB_PORT; TaskExecutor.java:83-95)
 TB_PORT = "TB_PORT"

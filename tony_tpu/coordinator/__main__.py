@@ -120,7 +120,8 @@ def main(argv=None) -> int:
     fd = os.open(tmp, os.O_WRONLY | os.O_CREAT | os.O_EXCL, 0o600)
     with os.fdopen(fd, "w", encoding="utf-8") as f:
         json.dump({"host": host, "port": port,
-                   "token": coord.rpc_token or ""}, f)
+                   "token": coord.rpc_token or "",
+                   "tls_cert": coord.tls_cert}, f)
     os.replace(tmp, args.addr_file)
 
     status = coord.run()

@@ -129,11 +129,17 @@ class Coordinator:
             import secrets
             rpc_token = secrets.token_hex(16)
         self.rpc_token = rpc_token
+        tls = None
+        self.tls_cert = str(conf.get(K.SECURITY_TLS_CERT, "") or "")
+        if self.tls_cert:
+            from tony_tpu.rpc.wire import server_tls_context
+            tls = server_tls_context(
+                self.tls_cert, str(conf.get(K.SECURITY_TLS_KEY, "")))
         self.rpc = RpcServer(
             _RpcService(self),
             host=str(conf.get(K.COORDINATOR_HOST_KEY)),
             port=conf.get_int(K.COORDINATOR_PORT_KEY, 0),
-            token=rpc_token)
+            token=rpc_token, tls=tls)
 
         job_dir = history.intermediate_dir(history_root, app_id)
         self.job_dir = job_dir

@@ -24,6 +24,8 @@ Pieces:
 from __future__ import annotations
 
 import dataclasses
+import queue
+import threading
 from typing import Any, Callable, Dict, Iterator, Optional
 
 import jax
@@ -52,28 +54,40 @@ class ShardedBatchIterator:
     batch for ``step`` as a pytree of numpy/jax arrays with leading dim
     ``rows.stop - rows.start``. The iterator assembles them into global
     ``jax.Array``s laid out by ``shardings`` (a pytree matching the batch,
-    or a single sharding applied to every leaf)."""
+    or a single sharding applied to every leaf).
+
+    ``prefetch`` (default 2) double-buffers: a daemon thread loads and
+    device-puts batch N+1..N+prefetch while step N computes, so the host
+    read + H2D transfer hide behind the accelerator (the training loop's
+    ``__next__`` returns an already-device-resident batch). 0 = fully
+    synchronous (the pre-r5 behavior). ``step`` reports the next step the
+    CONSUMER will see — checkpoint/resume keys off consumed batches, not
+    what the buffer got ahead to."""
 
     mesh: Mesh
     global_batch: int
     load_local: Callable[[int, slice], Dict[str, Any]]
     shardings: Optional[Any] = None
     start_step: int = 0
+    prefetch: int = 2
 
     def __post_init__(self):
-        self._step = self.start_step
+        self._step = self.start_step        # next step the WORKER loads
+        self._consumed = self.start_step    # next step the CONSUMER gets
         self._rows = process_batch_slice(self.global_batch)
+        self._q: Optional[queue.Queue] = None
+        self._worker: Optional[threading.Thread] = None
+        self._stop_evt = threading.Event()
 
     @property
     def step(self) -> int:
-        return self._step
+        return self._consumed
 
     def __iter__(self) -> Iterator[Dict[str, Any]]:
         return self
 
-    def __next__(self) -> Dict[str, Any]:
-        local = self.load_local(self._step, self._rows)
-        self._step += 1
+    def _assemble(self, step: int) -> Dict[str, Any]:
+        local = self.load_local(step, self._rows)
 
         def to_global(x, sharding):
             return jax.make_array_from_process_local_data(
@@ -88,6 +102,72 @@ class ShardedBatchIterator:
                         self.mesh, extra_dims=np.asarray(x).ndim - 1)),
                 local)
         return jax.tree.map(to_global, local, self.shardings)
+
+    def _worker_loop(self) -> None:
+        # Snapshot this generation's queue/event: a worker that outlives a
+        # close()+restart (join timeout) must keep talking to ITS queue,
+        # never the successor's.
+        stop, q = self._stop_evt, self._q
+        while not stop.is_set():
+            try:
+                item = self._assemble(self._step)
+                self._step += 1
+            except BaseException as e:  # noqa: BLE001 — surface on get()
+                item = _PrefetchError(e)
+            while not stop.is_set():
+                try:
+                    q.put(item, timeout=0.2)
+                    break
+                except queue.Full:
+                    continue
+            if isinstance(item, _PrefetchError):
+                return                  # consumer re-raises; don't spin
+
+    def __next__(self) -> Dict[str, Any]:
+        if self.prefetch <= 0:
+            batch = self._assemble(self._consumed)
+            self._consumed += 1
+            return batch
+        if self._worker is None:
+            # Fresh event per worker: a close() (or the error path below)
+            # sets the old one, and a restarted worker must not inherit a
+            # stop signal it would obey before producing anything (the
+            # consumer's q.get() would deadlock).
+            self._stop_evt = threading.Event()
+            self._step = self._consumed    # resume where the consumer is
+            self._q = queue.Queue(maxsize=self.prefetch)
+            self._worker = threading.Thread(
+                target=self._worker_loop, name="tony-data-prefetch",
+                daemon=True)
+            self._worker.start()
+        item = self._q.get()
+        if isinstance(item, _PrefetchError):
+            self.close()
+            raise item.exc
+        self._consumed += 1
+        return item
+
+    def close(self) -> None:
+        """Stop the prefetch thread (idempotent). Iterators die with their
+        (daemon) thread anyway; close() makes teardown deterministic for
+        tests and bounded-lifetime loops."""
+        self._stop_evt.set()
+        if self._worker is not None:
+            # Unblock a worker parked on a full queue.
+            try:
+                while True:
+                    self._q.get_nowait()
+            except queue.Empty:
+                pass
+            self._worker.join(timeout=5)
+            self._worker = None
+
+
+class _PrefetchError:
+    """Exception envelope crossing the prefetch queue."""
+
+    def __init__(self, exc: BaseException):
+        self.exc = exc
 
 
 def synthetic_lm_batches(mesh: Mesh, global_batch: int, seq: int,

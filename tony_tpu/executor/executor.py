@@ -124,10 +124,15 @@ class TaskExecutor:
         self.conf = (TonyTpuConfig.load_final(conf_path)
                      if conf_path and os.path.exists(conf_path)
                      else TonyTpuConfig())
+        tls = None
+        tls_cert = str(self.conf.get(K.SECURITY_TLS_CERT, "") or "")
+        if tls_cert:
+            from tony_tpu.rpc.wire import client_tls_context
+            tls = client_tls_context(tls_cert)
         self.client = RpcClient(
             self.coordinator_host, self.coordinator_port,
             token=e.get("TONY_RPC_TOKEN") or None,
-            max_retries=10, retry_sleep_s=2.0)
+            max_retries=10, retry_sleep_s=2.0, tls=tls)
         self.hostname = e.get("TONY_ADVERTISED_HOST") or socket.gethostname()
         try:
             socket.getaddrinfo(self.hostname, None)

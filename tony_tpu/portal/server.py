@@ -64,7 +64,8 @@ class PortalServer:
     def __init__(self, history_root: str, port: int = 0,
                  host: str = "127.0.0.1", mover_interval_s: float = 300.0,
                  purger_interval_s: float = 3600.0,
-                 retention_days: int = 30, token: str = ""):
+                 retention_days: int = 30, token: str = "",
+                 tls_cert: str = "", tls_key: str = ""):
         # Optional bearer auth: with a token set, every request must carry
         # "Authorization: Bearer <token>" or gets 401. The reference portal
         # ran behind keytab-login Play infra (hadoop/Requirements.java:
@@ -91,6 +92,21 @@ class PortalServer:
                 portal._route(self)
 
         self.httpd = ThreadingHTTPServer((host, port), Handler)
+        if tls_cert:
+            # HTTPS opt-in (same cert pair as the RPC plane): without it a
+            # bearer token rides plaintext HTTP, which is only acceptable
+            # on localhost. do_handshake_on_connect=False defers the
+            # handshake from accept() (which runs in the single
+            # serve_forever thread — a stalled client there would hang the
+            # whole portal) to the first read, inside the per-request
+            # handler thread; Handler.timeout bounds that thread too.
+            from tony_tpu.rpc.wire import server_tls_context
+            Handler.timeout = 60
+            self.httpd.socket = server_tls_context(
+                tls_cert, tls_key).wrap_socket(
+                    self.httpd.socket, server_side=True,
+                    do_handshake_on_connect=False)
+        self.scheme = "https" if tls_cert else "http"
         self.port = self.httpd.server_address[1]
 
     # -- lifecycle -------------------------------------------------------
@@ -123,7 +139,7 @@ class PortalServer:
 
     @property
     def url(self) -> str:
-        return f"http://{self.httpd.server_address[0]}:{self.port}"
+        return f"{self.scheme}://{self.httpd.server_address[0]}:{self.port}"
 
     # -- routing ---------------------------------------------------------
     def _route(self, req: BaseHTTPRequestHandler) -> None:
@@ -375,6 +391,10 @@ def main(argv=None) -> int:
         help="require 'Authorization: Bearer <token>' on every request "
              "(default: $TONY_PORTAL_TOKEN; empty = open — keep the bind "
              "host local then)")
+    p.add_argument("--tls-cert", default="",
+                   help="PEM cert path: serve HTTPS (pair with --tls-key)")
+    p.add_argument("--tls-key", default="",
+                   help="PEM private-key path for --tls-cert")
     args = p.parse_args(argv)
     conf = TonyTpuConfig()
     port = args.port if args.port is not None \
@@ -384,7 +404,7 @@ def main(argv=None) -> int:
         mover_interval_s=conf.get_int(K.HISTORY_MOVER_INTERVAL_S, 300),
         purger_interval_s=conf.get_int(K.HISTORY_PURGER_INTERVAL_S, 3600),
         retention_days=conf.get_int(K.HISTORY_RETENTION_DAYS, 30),
-        token=args.token)
+        token=args.token, tls_cert=args.tls_cert, tls_key=args.tls_key)
     srv.start()
     log.info("portal serving %s at %s", args.history_root, srv.url)
     try:

@@ -48,6 +48,7 @@ import logging
 import os
 import socket
 import socketserver
+import ssl
 import struct
 import threading
 import time
@@ -56,6 +57,30 @@ from typing import Any, Dict, Optional, Tuple
 import msgpack
 
 log = logging.getLogger(__name__)
+
+
+def server_tls_context(cert_path: str, key_path: str) -> ssl.SSLContext:
+    """TLS context for the coordinator side (RPC server / portal): present
+    ``cert_path`` (PEM), key from ``key_path``. Opt-in confidentiality on
+    top of the HMAC plane — reference analogue: Hadoop IPC rode the
+    cluster's SASL/token machinery (``ApplicationMaster.java:433-452``);
+    here the operator ships one self-signed pair via config
+    (tony.application.security.tls-*)."""
+    ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+    ctx.load_cert_chain(cert_path, key_path)
+    return ctx
+
+
+def client_tls_context(cert_path: str) -> ssl.SSLContext:
+    """TLS context for clients (submitter, executors): PIN the server's
+    certificate (self-signed pairs on ephemeral gangs have no CA and their
+    IPs aren't in any SAN — pinning the exact cert is both simpler and
+    stricter than hostname verification)."""
+    ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_CLIENT)
+    ctx.check_hostname = False
+    ctx.verify_mode = ssl.CERT_REQUIRED
+    ctx.load_verify_locations(cert_path)
+    return ctx
 
 _MAX_FRAME = 64 * 1024 * 1024
 _TO_SERVER = b"C"
@@ -137,15 +162,28 @@ class RpcServer:
     """
 
     def __init__(self, service: Any, host: str = "127.0.0.1", port: int = 0,
-                 token: Optional[str] = None):
+                 token: Optional[str] = None,
+                 tls: Optional[ssl.SSLContext] = None):
         self._service = service
         self._token = token or None     # "" = unauthenticated, like None
+        self._tls = tls
         outer = self
 
         class Handler(socketserver.BaseRequestHandler):
             def handle(self) -> None:  # one connection, many requests
                 sock = self.request
                 sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+                if outer._tls is not None:
+                    # Per-connection handshake (in this handler thread, so
+                    # a stalling peer never blocks the accept loop); a
+                    # plaintext or wrong-cert peer fails here and is
+                    # dropped before any frame is read.
+                    try:
+                        sock = outer._tls.wrap_socket(sock, server_side=True)
+                    except (ssl.SSLError, OSError) as e:
+                        log.debug("TLS handshake failed from %s: %s",
+                                  self.client_address, e)
+                        return
                 nonce = os.urandom(16)
                 try:
                     _send_frame(sock, {"tony-rpc": 3, "nonce": nonce,
@@ -267,9 +305,11 @@ class RpcClient:
 
     def __init__(self, host: str, port: int, token: Optional[str] = None,
                  max_retries: int = 10, retry_sleep_s: float = 2.0,
-                 connect_timeout_s: float = 10.0):
+                 connect_timeout_s: float = 10.0,
+                 tls: Optional[ssl.SSLContext] = None):
         self._addr = (host, port)
         self._token = token or None     # "" = unauthenticated, like None
+        self._tls = tls
         self._max_retries = max_retries
         self._retry_sleep_s = retry_sleep_s
         self._connect_timeout_s = connect_timeout_s
@@ -284,6 +324,13 @@ class RpcClient:
         sock = socket.create_connection(self._addr,
                                         timeout=self._connect_timeout_s)
         sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        if self._tls is not None:
+            try:
+                sock = self._tls.wrap_socket(
+                    sock, server_hostname=self._addr[0])
+            except (ssl.SSLError, OSError):
+                sock.close()
+                raise
         # The connect timeout stays armed through the hello read: a peer
         # that accepts but never greets (wrong service, pre-v2 server)
         # must error out, not deadlock the first call() forever.
