@@ -175,8 +175,12 @@ def test_e2e_retry_window_never_reports_terminal_status(tmp_path):
         if addr_file is None:
             return
         addr = json.loads(addr_file.read_text())
+        # Fail fast once the coordinator is gone: the default transport
+        # retry budget (10×2 s) would park this thread past its join
+        # timeout after the job ends.
         rpc = RpcClient(addr["host"], addr["port"],
-                        token=addr.get("token") or None)
+                        token=addr.get("token") or None,
+                        max_retries=1, retry_sleep_s=0.05)
         try:
             while not done.is_set():
                 try:

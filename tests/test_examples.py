@@ -22,6 +22,11 @@ def _env(tmp_path):
     return env
 
 
+# Tight poll cadences under test (see make_conf in test_e2e.py).
+_FAST = ["--conf", "tony.client.poll-interval-ms=100",
+         "--conf", "tony.coordinator.monitor-interval-ms=100"]
+
+
 def test_mnist_example_submits_e2e(tmp_path):
     """`tony-tpu submit --conf-file mnist.json` from the example dir, as
     the README says — relative src-dir staged, 2 workers, loss decreases
@@ -32,7 +37,13 @@ def test_mnist_example_submits_e2e(tmp_path):
          "--conf", f"tony.history.location={tmp_path / 'history'}",
          "--conf", "tony.worker.command="
                    f"{sys.executable} mnist_dp.py",
-         "--workdir", str(tmp_path / "work")],
+         "--conf", "tony.application.execution-env=MNIST_STEPS=8",
+         # 2 virtual devices per process: the default 8 makes CPU
+         # jax.distributed spin up a 16-rank Gloo full mesh (~8 s of
+         # TCP handshakes on one core); dp over 2x2 proves the same path.
+         "--conf", "tony.application.execution-env="
+                   "XLA_FLAGS=--xla_force_host_platform_device_count=2",
+         "--workdir", str(tmp_path / "work"), *_FAST],
         cwd=os.path.join(EXAMPLES, "mnist-jax"), env=_env(tmp_path),
         capture_output=True, text=True, timeout=300)
     assert r.returncode == 0, f"stdout:{r.stdout}\nstderr:{r.stderr}"
@@ -64,7 +75,7 @@ def test_mnist_pytorch_ddp_example_submits_e2e(tmp_path):
          "--conf", f"tony.history.location={tmp_path / 'history'}",
          "--conf", "tony.worker.command="
                    f"{sys.executable} mnist_ddp.py",
-         "--workdir", str(tmp_path / "work")],
+         "--workdir", str(tmp_path / "work"), *_FAST],
         cwd=os.path.join(EXAMPLES, "mnist-pytorch"), env=_env(tmp_path),
         capture_output=True, text=True, timeout=300)
     assert r.returncode == 0, f"stdout:{r.stdout}\nstderr:{r.stderr}"
@@ -98,7 +109,7 @@ def test_generic_gang_example_submits_e2e(tmp_path):
          "--conf", f"tony.history.location={tmp_path / 'history'}",
          "--conf", f"tony.head.command={sys.executable} head.py",
          "--conf", f"tony.worker.command={sys.executable} worker.py",
-         "--workdir", str(tmp_path / "work")],
+         "--workdir", str(tmp_path / "work"), *_FAST],
         cwd=os.path.join(EXAMPLES, "generic-gang"), env=_env(tmp_path),
         capture_output=True, text=True, timeout=300)
     assert r.returncode == 0, f"stdout:{r.stdout}\nstderr:{r.stderr}"

@@ -338,8 +338,8 @@ def test_e2e_gcloud_preemption_recreates_node_and_resumes(tmp_path):
         conf.set(K.GCLOUD_SPOT, True)
         conf.set(K.EXECUTION_ENV, f"TONY_TEST_RESULT={result}")
         conf.set(K.EXECUTION_ENV, "TONY_TEST_SELF_CRASH=0")
-        conf.set(K.EXECUTION_ENV, "TONY_TEST_STEPS=6")
-        conf.set(K.EXECUTION_ENV, "TONY_TEST_STEP_SLEEP=0.4")
+        conf.set(K.EXECUTION_ENV, "TONY_TEST_STEPS=4")
+        conf.set(K.EXECUTION_ENV, "TONY_TEST_STEP_SLEEP=0.2")
         client, rec, code = submit(conf, tmp_path)
         assert code == 0, _dump_task_logs(client)
         assert rec.finished[0] == "SUCCEEDED"
@@ -347,8 +347,8 @@ def test_e2e_gcloud_preemption_recreates_node_and_resumes(tmp_path):
         start, end, w1 = result.read_text().split()
         assert int(start) >= 1, \
             f"retried epoch should RESUME (start >= 1), got {start}"
-        assert int(end) == 6
-        assert float(w1) == 2.0 ** 6
+        assert int(end) == 4
+        assert float(w1) == 2.0 ** 4
         # the node lifecycle really happened through the API: the
         # preempted node was deleted and a fresh one created
         assert server.create_count >= 2

@@ -51,6 +51,8 @@ def test_e2e_notebook_reachable_through_proxy(tmp_path):
     conf = TonyTpuConfig()
     conf.set(K.APPLICATION_TIMEOUT_S, 60)
     conf.set(K.HISTORY_LOCATION, str(tmp_path / "history"))
+    conf.set(K.CLIENT_POLL_INTERVAL_MS, 100)
+    conf.set(K.COORDINATOR_MONITOR_INTERVAL_MS, 100)
 
     # Drive the client directly with our own NotebookProxyListener so the
     # test can observe readiness (submit_notebook wires the same pieces).

@@ -166,8 +166,8 @@ def test_ring_attention_grads():
     def loss_ref(q, k, v):
         return jnp.sum(reference_attention(q, k, v) ** 2)
 
-    g_ring = jax.grad(loss_ring, argnums=(0, 1, 2))(q, k, v)
-    g_ref = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    g_ring = jax.jit(jax.grad(loss_ring, argnums=(0, 1, 2)))(q, k, v)
+    g_ref = jax.jit(jax.grad(loss_ref, argnums=(0, 1, 2)))(q, k, v)
     for gr_, gref, name in zip(g_ring, g_ref, "qkv"):
         np.testing.assert_allclose(gr_, gref, atol=1e-4, rtol=1e-4,
                                    err_msg=f"d{name}")
@@ -194,9 +194,9 @@ def test_ring_attention_bf16_grads():
     def loss_ref(q, k, v):
         return jnp.sum(reference_attention(q, k, v) ** 2)
 
-    g_ring = jax.grad(loss_ring, argnums=(0, 1, 2))(q, k, v)
+    g_ring = jax.jit(jax.grad(loss_ring, argnums=(0, 1, 2)))(q, k, v)
     qf, kf, vf = (x.astype(jnp.float32) for x in (q, k, v))
-    g_ref = jax.grad(loss_ref, argnums=(0, 1, 2))(qf, kf, vf)
+    g_ref = jax.jit(jax.grad(loss_ref, argnums=(0, 1, 2)))(qf, kf, vf)
     for gr_, gref, name in zip(g_ring, g_ref, "qkv"):
         assert gr_.dtype == jnp.bfloat16
         err = np.abs(np.asarray(gr_, np.float32) - np.asarray(gref))

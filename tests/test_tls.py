@@ -115,6 +115,19 @@ def test_tls_client_refuses_plaintext_server():
         srv.stop()
 
 
+def test_tls_cert_without_key_fails_fast(certpair, tmp_path):
+    """A cert without its key must fail at submit-time validation — not
+    crash the spawned coordinator before it writes its address file
+    (which surfaces as a 60 s hang + 'address never appeared')."""
+    from tony_tpu.conf.config import ConfigError
+
+    cert, _ = certpair
+    conf = make_conf(tmp_path, "exit_0.py", workers=1,
+                     extra={K.SECURITY_TLS_CERT: cert})
+    with pytest.raises(ConfigError, match="must be set together"):
+        conf.validate()
+
+
 def test_e2e_submit_with_tls_and_auth(certpair, tmp_path):
     """Full job over the TLS control plane: coordinator serves TLS (conf
     keys), the submitting client picks the cert up from the address file,

@@ -293,8 +293,13 @@ class TonyTpuClient:
         if addr.get("tls_cert"):
             from tony_tpu.rpc.wire import client_tls_context
             tls = client_tls_context(addr["tls_cert"])
+        # Short INNER retry budget: the monitor loop around this client
+        # already retries forever (with a coordinator-liveness check per
+        # failure) — stacking the transport's default 10×2 s on top only
+        # delayed dead-coordinator detection by ~20 s.
         return RpcClient(addr["host"], addr["port"],
-                         token=addr.get("token") or None, tls=tls)
+                         token=addr.get("token") or None, tls=tls,
+                         max_retries=3, retry_sleep_s=0.5)
 
     def _monitor(self, addr_file: str) -> int:
         """Reference ``monitorApplication`` :838-892 (1 s poll; task-info

@@ -287,6 +287,15 @@ class TonyTpuConfig:
                 if dep not in jobs:
                     raise ConfigError(
                         f"jobtype {j.name} depends on unknown jobtype {dep}")
+        # TLS wants the pair: a cert without its key would crash the
+        # SPAWNED coordinator before it writes its address file, and the
+        # submitter would see only "coordinator address never appeared".
+        tls_cert = str(self.get(K.SECURITY_TLS_CERT, "") or "")
+        tls_key = str(self.get(K.SECURITY_TLS_KEY, "") or "")
+        if bool(tls_cert) != bool(tls_key):
+            raise ConfigError(
+                f"{K.SECURITY_TLS_CERT} and {K.SECURITY_TLS_KEY} must be "
+                f"set together (got cert={tls_cert!r}, key={tls_key!r})")
 
     # -- freeze / thaw ----------------------------------------------------
     def freeze(self, path: str) -> str:

@@ -81,6 +81,16 @@ def tpu_hbm_in_use_bytes() -> int:
     runtime is attached to *this* process (the usual case — the user process
     owns the chips)."""
     try:
+        import sys
+
+        if "jax" not in sys.modules:
+            # The probe is only meaningful where this process already runs
+            # jax (in-process/notebook modes). IMPORTING jax here costs
+            # ~2.3 s and then reads 0 — in the executor that tax landed in
+            # monitor.stop()'s final sample, i.e. on EVERY task teardown
+            # (found via the r5 suite-latency hunt: a trivial task's
+            # "user process exited" trailed its actual exit by 2.3 s).
+            return 0
         import jax
 
         total = 0

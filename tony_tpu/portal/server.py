@@ -396,6 +396,8 @@ def main(argv=None) -> int:
     p.add_argument("--tls-key", default="",
                    help="PEM private-key path for --tls-cert")
     args = p.parse_args(argv)
+    if bool(args.tls_cert) != bool(args.tls_key):
+        p.error("--tls-cert and --tls-key must be set together")
     conf = TonyTpuConfig()
     port = args.port if args.port is not None \
         else conf.get_int(K.PORTAL_PORT, 19886)

@@ -12,6 +12,33 @@ from typing import Callable, Dict, Optional
 log = logging.getLogger(__name__)
 
 
+def _group_has_live_member(pg: int) -> bool:
+    """Any NON-ZOMBIE process left in group ``pg``? ``killpg(pg, 0)``
+    alone cannot answer this: it succeeds while only zombies remain, and a
+    TERM'd child whose parent hasn't reaped it yet IS a zombie — exactly
+    the teardown window this function is called in (a coordinator killing
+    an executor it owns polls nothing while it waits). Counting a
+    zombie-only group as alive made every such kill burn its FULL grace
+    window (measured: 15 s per failed-job teardown)."""
+    try:
+        entries = os.listdir("/proc")
+    except OSError:
+        return True     # no /proc: fall back to the killpg-only signal
+    for entry in entries:
+        if not entry.isdigit():
+            continue
+        try:
+            with open(f"/proc/{entry}/stat") as f:
+                # "pid (comm) state ppid pgrp ..." — comm may hold spaces/
+                # parens; split after the LAST ')'.
+                rest = f.read().rsplit(")", 1)[1].split()
+            if int(rest[2]) == pg and rest[0] != "Z":
+                return True
+        except (OSError, ValueError, IndexError):
+            continue
+    return False
+
+
 def kill_process_groups(pgids, grace_s: float = 0.0) -> None:
     """TERM → grace → KILL for one or more process groups. The building
     block of the teardown contract (reference stops containers with grace,
@@ -20,7 +47,9 @@ def kill_process_groups(pgids, grace_s: float = 0.0) -> None:
 
     Safe on already-dead groups (ProcessLookupError = nothing left) and on
     pgids we cannot signal (PermissionError = not ours, e.g. after a
-    pid-reuse race — skip rather than kill a stranger)."""
+    pid-reuse race — skip rather than kill a stranger). The grace wait
+    ends when every group member is dead OR a zombie (see
+    ``_group_has_live_member``)."""
     alive = set()
     for pg in pgids:
         if not pg or pg <= 0:
@@ -31,15 +60,24 @@ def kill_process_groups(pgids, grace_s: float = 0.0) -> None:
         except (ProcessLookupError, PermissionError):
             pass
     deadline = time.monotonic() + grace_s
+    zombie_only = set()
     while alive and time.monotonic() < deadline:
         for pg in list(alive):
             try:
                 os.killpg(pg, 0)
             except (ProcessLookupError, PermissionError):
                 alive.discard(pg)
+                continue
+            if not _group_has_live_member(pg):
+                # Stop WAITING on it, but still include it in the KILL
+                # pass below: the /proc snapshot races a fork during the
+                # grace window, and SIGKILL on a truly zombie-only group
+                # is a free no-op.
+                alive.discard(pg)
+                zombie_only.add(pg)
         if alive:
             time.sleep(0.05)
-    for pg in alive:
+    for pg in alive | zombie_only:
         try:
             os.killpg(pg, signal.SIGKILL)
         except (ProcessLookupError, PermissionError):
