@@ -258,6 +258,44 @@ def test_release_of_already_deleted_node_is_quiet():
         server.stop()
 
 
+def test_gcloud_gc_reaps_only_labeled_nodes(capsys):
+    """`tony-tpu gcloud-gc`: a hard-crashed coordinator can strand a
+    billing node (no YARN RM to reap it) — the janitor lists
+    tony-managed nodes ACROSS list pages and, with --delete, removes
+    them, NEVER touching unlabeled nodes."""
+    from tony_tpu.cli.main import main as cli_main
+
+    # page_size=1 forces nextPageToken pagination: a client that reads
+    # only page 1 would miss the leaked node entirely.
+    server = TpuApiFakeServer(page_size=1).start()
+    try:
+        # a leaked tony node + someone else's node in the same zone
+        server.nodes["tony-dead00"] = {
+            "name": "projects/p/locations/z/nodes/tony-dead00",
+            "state": "READY", "acceleratorType": "v5litepod-8",
+            "labels": {"tony-managed": "true", "tony-nonce": "x"},
+            "networkEndpoints": []}
+        server.nodes["someone-else"] = {
+            "name": "projects/p/locations/z/nodes/someone-else",
+            "state": "READY", "acceleratorType": "v5litepod-8",
+            "labels": {}, "networkEndpoints": []}
+        # list-only first: nothing deleted
+        rc = cli_main(["gcloud-gc", "--project", "p", "--zone", "z",
+                       "--api-endpoint", server.endpoint])
+        assert rc == 0
+        out = capsys.readouterr().out
+        assert "tony-dead00" in out and "someone-else" not in out
+        assert "tony-dead00" in server.nodes
+        # --delete reaps the labeled node only
+        rc = cli_main(["gcloud-gc", "--project", "p", "--zone", "z",
+                       "--api-endpoint", server.endpoint, "--delete"])
+        assert rc == 0
+        assert "tony-dead00" not in server.nodes
+        assert "someone-else" in server.nodes
+    finally:
+        server.stop()
+
+
 # ---------------------------------------------------------------------------
 # Preemption: API state is lease health
 # ---------------------------------------------------------------------------

@@ -33,8 +33,9 @@ class TpuApiFakeServer:
                  op_done_after_polls: int = 1, require_token: str = "",
                  deny_creates: int = 0, stuck_in_creating: bool = False,
                  preempt_when_path_exists: str = "",
-                 fail_first_n: int = 0):
+                 fail_first_n: int = 0, page_size: int = 1000):
         self.hosts_per_node = hosts_per_node
+        self.page_size = page_size      # nodes.list page size
         #: node GETs before CREATING flips to READY
         self.ready_after_polls = ready_after_polls
         #: operation GETs before done=true
@@ -97,6 +98,21 @@ class TpuApiFakeServer:
                              r"/nodes/([^/]+)$", path)
                 if m:
                     return self._get_node(m.group(1))
+                if re.match(r"^/v2/projects/[^/]+/locations/[^/]+/nodes$",
+                            path):
+                    q = {k: v[0] for k, v in
+                         parse_qs(urlparse(self.path).query).items()}
+                    with server.lock:
+                        # Paginated like real Cloud TPU list — clients
+                        # that drop nextPageToken miss nodes.
+                        all_nodes = list(server.nodes.values())
+                        start = int(q.get("pageToken", "0") or 0)
+                        page = all_nodes[start:start + server.page_size]
+                        resp = {"nodes": page}
+                        if start + server.page_size < len(all_nodes):
+                            resp["nextPageToken"] = str(
+                                start + server.page_size)
+                        return self._jsend(200, resp)
                 self._jsend(404, {"error": f"no route {path}"})
 
             def _get_op(self, name: str):

@@ -263,6 +263,61 @@ def _cmd_portal(args: argparse.Namespace) -> int:
     return portal_main(argv)
 
 
+def _cmd_gcloud_gc(args: argparse.Namespace) -> int:
+    """Janitor for leaked tony-managed TPU nodes. The provisioner deletes
+    its node on release and on failed acquires, but a HARD-crashed
+    coordinator (SIGKILL, power loss) can strand a billing node — the
+    reference relied on YARN's ResourceManager to reap containers; with
+    no RM, this command is the operator's reaper. Lists nodes carrying
+    the ``tony-managed`` label (and matching --prefix); --delete deletes
+    them. NEVER touches unlabeled nodes."""
+    from tony_tpu.cluster.gcloud import TpuApiClient
+
+    api = TpuApiClient(project=args.project, zone=args.zone,
+                       endpoint=args.api_endpoint or None)
+    managed = [n for n in api.list_nodes()
+               if (n.get("labels", {}).get("tony-managed") == "true"
+                   and n.get("name", "").rsplit("/", 1)[-1]
+                   .startswith(args.prefix))]
+    if not managed:
+        print("no tony-managed nodes found")
+        return 0
+    for n in managed:
+        node_id = n.get("name", "").rsplit("/", 1)[-1]
+        print(f"{node_id}\t{n.get('state', '?')}\t"
+              f"{n.get('acceleratorType', '?')}")
+    if not args.delete:
+        print(f"{len(managed)} node(s); re-run with --delete to remove "
+              f"them (make sure no tony-tpu job is running against them!)")
+        return 0
+    # The filter cannot tell a LEAKED node from one a live coordinator
+    # holds — repeat the warning where it matters, on the destructive
+    # path.
+    print("deleting — make sure no tony-tpu job is running against "
+          "these nodes!", file=sys.stderr)
+    # Deletes are independent long-running ops: issue them ALL first,
+    # then poll — N stranded nodes cost one op latency, not N.
+    failures = 0
+    pending = []
+    for n in managed:
+        node_id = n.get("name", "").rsplit("/", 1)[-1]
+        try:
+            pending.append((node_id, api.delete_node(node_id)))
+        except FileNotFoundError:
+            print(f"{node_id} already gone")
+        except Exception as e:  # noqa: BLE001
+            failures += 1
+            print(f"failed to delete {node_id}: {e}", file=sys.stderr)
+    for node_id, op in pending:
+        try:
+            api.wait_operation(op, timeout_s=300, interval_s=5.0)
+            print(f"deleted {node_id}")
+        except Exception as e:  # noqa: BLE001
+            failures += 1
+            print(f"failed to delete {node_id}: {e}", file=sys.stderr)
+    return 1 if failures else 0
+
+
 def build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(prog="tony-tpu")
     sub = p.add_subparsers(dest="cmd", required=True)
@@ -338,6 +393,21 @@ def build_parser() -> argparse.ArgumentParser:
     po.add_argument("--token", default=os.environ.get(
         "TONY_PORTAL_TOKEN", ""))
     po.set_defaults(fn=_cmd_portal)
+
+    gc = sub.add_parser(
+        "gcloud-gc",
+        help="list/delete leaked tony-managed TPU nodes (the RM-reaper "
+             "role for hard-crashed coordinators)")
+    gc.add_argument("--project", required=True)
+    gc.add_argument("--zone", required=True)
+    gc.add_argument("--prefix", default="tony",
+                    help="only nodes whose id starts with this "
+                         "(tony.gcloud.node-prefix)")
+    gc.add_argument("--delete", action="store_true",
+                    help="actually delete (default: list only)")
+    gc.add_argument("--api-endpoint", default="",
+                    help="Cloud TPU API endpoint override (tests)")
+    gc.set_defaults(fn=_cmd_gcloud_gc)
     return p
 
 

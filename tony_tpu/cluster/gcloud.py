@@ -130,6 +130,24 @@ class TpuApiClient:
     def get_node(self, node_id: str) -> dict:
         return self._request("GET", f"{self.parent}/nodes/{node_id}")
 
+    def list_nodes(self) -> List[dict]:
+        """All nodes in the zone, following ``nextPageToken`` to the end
+        (same discipline as the GCS listing — a janitor that only reads
+        page 1 'finds no leaks' while billing nodes sit on page 2). The
+        janitor's view — see ``cli gcloud-gc``."""
+        nodes: List[dict] = []
+        token = ""
+        while True:
+            path = f"{self.parent}/nodes"
+            if token:
+                from urllib.parse import quote
+                path += f"?pageToken={quote(token, safe='')}"
+            page = self._request("GET", path)
+            nodes += page.get("nodes", [])
+            token = page.get("nextPageToken", "")
+            if not token:
+                return nodes
+
     def delete_node(self, node_id: str) -> dict:
         return self._request("DELETE", f"{self.parent}/nodes/{node_id}")
 

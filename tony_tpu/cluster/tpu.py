@@ -222,7 +222,15 @@ class SshHostChannel(HostChannel):
         self.ssh_args = list(ssh_args or
                              ["-o", "BatchMode=yes",
                               "-o", "ConnectTimeout=10",
-                              "-o", "StrictHostKeyChecking=accept-new"])
+                              "-o", "StrictHostKeyChecking=accept-new",
+                              # A suspended/reclaimed VM drops packets
+                              # silently; without keepalives an ESTABLISHED
+                              # connection (the exec_task channel) can hang
+                              # in TCP timeout for many minutes. 15s×4 ≈
+                              # a 60s organic detection bound even when no
+                              # cloud API reports the loss.
+                              "-o", "ServerAliveInterval=15",
+                              "-o", "ServerAliveCountMax=4"])
         self.python = python
         self._alive_cache: Optional[Tuple[float, bool]] = None
 
