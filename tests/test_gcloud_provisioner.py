@@ -258,6 +258,74 @@ def test_release_of_already_deleted_node_is_quiet():
         server.stop()
 
 
+# ---------------------------------------------------------------------------
+# Queued-resource acquisition (tony.gcloud.queued-resource)
+# ---------------------------------------------------------------------------
+def test_queued_resource_acquire_waits_for_grant_then_leases(tmp_path):
+    """Capacity via the queued-resources API: the request WAITS in the
+    provider's queue, the node materializes when granted, and the lease
+    comes off the node exactly like the direct path; release deletes the
+    queued resource (force — node included)."""
+    server = TpuApiFakeServer(hosts_per_node=2).start()
+    server.qr_active_after_polls = 3          # a few WAITING polls first
+    try:
+        prov = _prov(_api(server), queued=True,
+                     channel_factory=localsim_channel_factory(
+                         str(tmp_path / "hosts")))
+        lease = prov.acquire(2)
+        assert lease.slice_id in server.qrs
+        assert server.qrs[lease.slice_id]["state"]["state"] == "ACTIVE"
+        node = server.nodes[lease.slice_id]
+        assert node["state"] == "READY"
+        assert len(lease.hosts) == 2
+        # tier rode the QR envelope, not schedulingConfig (which the
+        # real API rejects inside a QR node spec)
+        qr = server.qrs[lease.slice_id]
+        assert "guaranteed" in qr
+        assert "schedulingConfig" not in \
+            (qr["tpu"]["nodeSpec"][0].get("node") or {}) or \
+            not qr["tpu"]["nodeSpec"][0]["node"].get("schedulingConfig")
+        prov.release(lease)
+        assert lease.slice_id not in server.qrs
+        assert lease.slice_id not in server.nodes
+    finally:
+        server.stop()
+
+
+def test_queued_resource_spot_tier():
+    server = TpuApiFakeServer().start()
+    try:
+        prov = _prov(_api(server), queued=True, spot=True,
+                     channel_factory=lambda hid, ep: _localsim(hid))
+        lease = prov.acquire(1)
+        qr = server.qrs[lease.slice_id]
+        assert "spot" in qr
+        assert not (qr["tpu"]["nodeSpec"][0].get("node") or {}).get(
+            "schedulingConfig")
+        prov.release(lease)
+    finally:
+        server.stop()
+
+
+def test_queued_resource_no_grant_within_budget_cleans_up():
+    """A request the queue never grants must fail the acquire within
+    tony.gcloud.create-timeout-s AND delete the queued resource — a
+    forgotten WAITING request would eventually grant and bill a node
+    nobody is using."""
+    server = TpuApiFakeServer().start()
+    server.qr_stuck_waiting = True
+    try:
+        prov = _prov(_api(server), queued=True, create_timeout_s=0.3,
+                     poll_interval_s=0.02)
+        with pytest.raises(SliceProvisionError,
+                           match="no capacity granted"):
+            prov.acquire(1)
+        assert server.qrs == {}
+        assert server.nodes == {}
+    finally:
+        server.stop()
+
+
 def test_gcloud_gc_reaps_only_labeled_nodes(capsys):
     """`tony-tpu gcloud-gc`: a hard-crashed coordinator can strand a
     billing node (no YARN RM to reap it) — the janitor lists
@@ -292,6 +360,44 @@ def test_gcloud_gc_reaps_only_labeled_nodes(capsys):
         assert rc == 0
         assert "tony-dead00" not in server.nodes
         assert "someone-else" in server.nodes
+    finally:
+        server.stop()
+
+
+def test_gcloud_gc_reaps_queued_resources_and_their_nodes(capsys):
+    """The queued path's leak shapes: a WAITING request with no node yet
+    (would grant and bill later), and a GRANTED one whose node the API
+    only lets you delete THROUGH the queued resource."""
+    from tony_tpu.cli.main import main as cli_main
+
+    server = TpuApiFakeServer(page_size=1).start()
+    server.qr_active_after_polls = 1
+    try:
+        spec = lambda nid: {"tpu": {"nodeSpec": [{  # noqa: E731
+            "parent": "projects/p/locations/z", "nodeId": nid,
+            "node": {"labels": {"tony-managed": "true"}}}]},
+            "guaranteed": {}}
+        # leaked WAITING request (no node exists yet)
+        server.qrs["tony-wait00"] = {
+            "name": "projects/p/locations/z/queuedResources/tony-wait00",
+            "state": {"state": "WAITING_FOR_RESOURCES"},
+            **spec("tony-wait00"), "_parent": "projects/p/locations/z"}
+        # leaked GRANTED request: QR ACTIVE and its node exists,
+        # deletable only via the QR
+        server.qrs["tony-run00"] = {
+            "name": "projects/p/locations/z/queuedResources/tony-run00",
+            "state": {"state": "ACTIVE"},
+            **spec("tony-run00"), "_parent": "projects/p/locations/z"}
+        server._materialize_node(
+            "projects/p/locations/z", "tony-run00",
+            {"labels": {"tony-managed": "true"}}, state="READY",
+            via_qr=server.qrs["tony-run00"]["name"])
+        rc = cli_main(["gcloud-gc", "--project", "p", "--zone", "z",
+                       "--api-endpoint", server.endpoint, "--delete"])
+        assert rc == 0
+        capsys.readouterr()
+        assert server.qrs == {}
+        assert "tony-run00" not in server.nodes
     finally:
         server.stop()
 

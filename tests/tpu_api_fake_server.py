@@ -46,6 +46,13 @@ class TpuApiFakeServer:
         self.preempt_when_path_exists = preempt_when_path_exists
         self.fail_first_n = fail_first_n        # 503 the first N requests
         self.nodes: Dict[str, dict] = {}        # node_id -> node resource
+        #: queued resources: qr_id -> resource; ACTIVE after
+        #: qr_active_after_polls GETs (stuck forever with
+        #: qr_stuck_waiting), at which point the node materializes.
+        self.qrs: Dict[str, dict] = {}
+        self.qr_polls: Dict[str, int] = {}
+        self.qr_active_after_polls = 1
+        self.qr_stuck_waiting = False
         self.node_polls: Dict[str, int] = {}
         self.ops: Dict[str, dict] = {}          # op name -> op resource
         self.op_polls: Dict[str, int] = {}
@@ -98,22 +105,35 @@ class TpuApiFakeServer:
                              r"/nodes/([^/]+)$", path)
                 if m:
                     return self._get_node(m.group(1))
+                m = re.match(r"^/v2/projects/[^/]+/locations/[^/]+"
+                             r"/queuedResources/([^/]+)$", path)
+                if m:
+                    return self._get_qr(m.group(1))
                 if re.match(r"^/v2/projects/[^/]+/locations/[^/]+/nodes$",
                             path):
-                    q = {k: v[0] for k, v in
-                         parse_qs(urlparse(self.path).query).items()}
-                    with server.lock:
-                        # Paginated like real Cloud TPU list — clients
-                        # that drop nextPageToken miss nodes.
-                        all_nodes = list(server.nodes.values())
-                        start = int(q.get("pageToken", "0") or 0)
-                        page = all_nodes[start:start + server.page_size]
-                        resp = {"nodes": page}
-                        if start + server.page_size < len(all_nodes):
-                            resp["nextPageToken"] = str(
-                                start + server.page_size)
-                        return self._jsend(200, resp)
+                    return self._list_collection(server.nodes, "nodes")
+                if re.match(r"^/v2/projects/[^/]+/locations/[^/]+"
+                            r"/queuedResources$", path):
+                    return self._list_collection(server.qrs,
+                                                 "queuedResources")
                 self._jsend(404, {"error": f"no route {path}"})
+
+            def _list_collection(self, store: dict, key: str):
+                q = {k: v[0] for k, v in
+                     parse_qs(urlparse(self.path).query).items()}
+                with server.lock:
+                    # Paginated like the real Cloud TPU lists — clients
+                    # that drop nextPageToken miss resources.
+                    items = [{k_: v_ for k_, v_ in it.items()
+                              if not k_.startswith("_")}
+                             for it in store.values()]
+                    start = int(q.get("pageToken", "0") or 0)
+                    page = items[start:start + server.page_size]
+                    resp = {key: page}
+                    if start + server.page_size < len(items):
+                        resp["nextPageToken"] = str(
+                            start + server.page_size)
+                    return self._jsend(200, resp)
 
             def _get_op(self, name: str):
                 with server.lock:
@@ -147,12 +167,55 @@ class TpuApiFakeServer:
                         node["state"] = "READY"
                     self._jsend(200, node)
 
+            def _get_qr(self, qr_id: str):
+                with server.lock:
+                    qr = server.qrs.get(qr_id)
+                    if qr is None:
+                        return self._jsend(404, {"error": "qr notFound"})
+                    server.qr_polls[qr_id] = \
+                        server.qr_polls.get(qr_id, 0) + 1
+                    if (qr["state"]["state"] == "WAITING_FOR_RESOURCES"
+                            and not server.qr_stuck_waiting
+                            and server.qr_polls[qr_id]
+                            >= server.qr_active_after_polls):
+                        # Capacity granted: the node materializes READY.
+                        qr["state"]["state"] = "ACTIVE"
+                        spec = qr["tpu"]["nodeSpec"][0]
+                        server._materialize_node(
+                            qr["_parent"], spec["nodeId"],
+                            spec.get("node", {}), state="READY",
+                            via_qr=qr["name"])
+                    self._jsend(200, {k: v for k, v in qr.items()
+                                      if not k.startswith("_")})
+
             # -- POST: create --------------------------------------------
             def do_POST(self):
                 if not self._gate():
                     return
                 u = urlparse(self.path)
                 q = {k: v[0] for k, v in parse_qs(u.query).items()}
+                m = re.match(r"^/v2/(projects/([^/]+)/locations/([^/]+))"
+                             r"/queuedResources$", u.path)
+                if m:
+                    parent = m.group(1)
+                    qr_id = q.get("queuedResourceId", "")
+                    n = int(self.headers.get("Content-Length", "0") or 0)
+                    body = json.loads(self.rfile.read(n).decode() or "{}")
+                    with server.lock:
+                        if qr_id in server.qrs:
+                            return self._jsend(409, {"error": {
+                                "code": 409, "message": "already exists"}})
+                        # the spec echoes back on GET like the real API
+                        # (clients probe nodeSpec labels after a 409)
+                        server.qrs[qr_id] = {
+                            "name": f"{parent}/queuedResources/{qr_id}",
+                            "state": {"state": "WAITING_FOR_RESOURCES"},
+                            **body, "_parent": parent,
+                        }
+                        op = server._new_op(parent)
+                        return self._jsend(
+                            200, {k: v for k, v in op.items()
+                                  if not k.startswith("_")})
                 m = re.match(r"^/v2/(projects/([^/]+)/locations/([^/]+))"
                              r"/nodes$", u.path)
                 if not m:
@@ -171,33 +234,37 @@ class TpuApiFakeServer:
                     if node_id in server.nodes:
                         return self._jsend(409, {"error": {
                             "code": 409, "message": "already exists"}})
-                    endpoints = []
-                    for _ in range(server.hosts_per_node):
-                        server._next_ip += 1
-                        endpoints.append(
-                            {"ipAddress": f"10.0.0.{server._next_ip}",
-                             "port": 8470})
-                    server.nodes[node_id] = {
-                        "name": f"{parent}/nodes/{node_id}",
-                        "state": "CREATING",
-                        "acceleratorType":
-                            body.get("acceleratorType", ""),
-                        "runtimeVersion": body.get("runtimeVersion", ""),
-                        "schedulingConfig":
-                            body.get("schedulingConfig", {}),
-                        "labels": body.get("labels", {}),
-                        "networkEndpoints": endpoints,
-                    }
-                    server.created_names.append(node_id)
+                    server._materialize_node(parent, node_id, body,
+                                             state="CREATING")
                     op = server._new_op(parent)
                     self._jsend(200, {k: v for k, v in op.items()
                                       if not k.startswith("_")})
 
-            # -- DELETE: delete node -------------------------------------
+            # -- DELETE: node / queued resource --------------------------
             def do_DELETE(self):
                 if not self._gate():
                     return
                 path = urlparse(self.path).path
+                m = re.match(r"^/v2/(projects/[^/]+/locations/[^/]+)"
+                             r"/queuedResources/([^/]+)$", path)
+                if m:
+                    parent, qr_id = m.group(1), m.group(2)
+                    with server.lock:
+                        if qr_id not in server.qrs:
+                            return self._jsend(404,
+                                               {"error": "qr notFound"})
+                        server.delete_count += 1
+                        server.deleted_names.append(qr_id)
+
+                        def _reap(qr_id=qr_id):
+                            # force=true semantics: QR and its node go
+                            # together.
+                            server.qrs.pop(qr_id, None)
+                            server.nodes.pop(qr_id, None)
+                        op = server._new_op(parent, on_done=_reap)
+                        return self._jsend(
+                            200, {k: v for k, v in op.items()
+                                  if not k.startswith("_")})
                 m = re.match(r"^/v2/(projects/[^/]+/locations/[^/]+)"
                              r"/nodes/([^/]+)$", path)
                 if not m:
@@ -207,6 +274,14 @@ class TpuApiFakeServer:
                     if node_id not in server.nodes:
                         return self._jsend(404,
                                            {"error": "node notFound"})
+                    if server.nodes[node_id].get("queuedResource"):
+                        # Real API: a queued-resource-created node must be
+                        # deleted via queuedResources.delete (force).
+                        return self._jsend(400, {"error": {
+                            "code": 400,
+                            "message": "node was created by a queued "
+                                       "resource; delete the queued "
+                                       "resource instead"}})
                     server.delete_count += 1
                     server.deleted_names.append(node_id)
                     # the node disappears when the delete op completes
@@ -223,6 +298,29 @@ class TpuApiFakeServer:
         self._thread: Optional[threading.Thread] = None
 
     # -- helpers (call with self.lock held from handlers) ---------------
+    def _materialize_node(self, parent: str, node_id: str, body: dict,
+                          state: str, via_qr: str = "") -> None:
+        """Create the node resource (direct create starts CREATING and
+        ripens via GET polls; a granted queued resource lands READY and
+        carries its QR's name — real nodes.delete rejects those)."""
+        endpoints = []
+        for _ in range(self.hosts_per_node):
+            self._next_ip += 1
+            endpoints.append({"ipAddress": f"10.0.0.{self._next_ip}",
+                              "port": 8470})
+        self.nodes[node_id] = {
+            "name": f"{parent}/nodes/{node_id}",
+            "state": state,
+            "acceleratorType": body.get("acceleratorType", ""),
+            "runtimeVersion": body.get("runtimeVersion", ""),
+            "schedulingConfig": body.get("schedulingConfig", {}),
+            "labels": body.get("labels", {}),
+            "networkEndpoints": endpoints,
+        }
+        if via_qr:
+            self.nodes[node_id]["queuedResource"] = via_qr
+        self.created_names.append(node_id)
+
     def _new_op(self, parent: str, on_done=None) -> dict:
         self._n_ops += 1
         name = f"{parent}/operations/op-{self._n_ops}"

@@ -275,32 +275,71 @@ def _cmd_gcloud_gc(args: argparse.Namespace) -> int:
 
     api = TpuApiClient(project=args.project, zone=args.zone,
                        endpoint=args.api_endpoint or None)
-    managed = [n for n in api.list_nodes()
-               if (n.get("labels", {}).get("tony-managed") == "true"
-                   and n.get("name", "").rsplit("/", 1)[-1]
-                   .startswith(args.prefix))]
-    if not managed:
-        print("no tony-managed nodes found")
+
+    def _rid(res: dict) -> str:
+        return res.get("name", "").rsplit("/", 1)[-1]
+
+    def _qr_is_managed(qr: dict) -> bool:
+        for spec in (qr.get("tpu") or {}).get("nodeSpec") or []:
+            labels = (spec.get("node") or {}).get("labels") or {}
+            if labels.get("tony-managed") == "true":
+                return True
+        return False
+
+    # Queued resources FIRST: a coordinator that died while its request
+    # was WAITING leaked something with no node yet — and a granted QR's
+    # node can only be deleted through its QR (the API rejects
+    # nodes.delete on queued-resource-created nodes).
+    managed_qrs = [q for q in api.list_queued_resources()
+                   if _qr_is_managed(q) and _rid(q).startswith(args.prefix)]
+    qr_ids = {_rid(q) for q in managed_qrs}
+    qr_node_names = {
+        spec.get("nodeId", "")
+        for q in managed_qrs
+        for spec in (q.get("tpu") or {}).get("nodeSpec") or []}
+    managed_nodes = [
+        n for n in api.list_nodes()
+        if (n.get("labels", {}).get("tony-managed") == "true"
+            and _rid(n).startswith(args.prefix)
+            # nodes a managed QR will reap (or that name their QR) are
+            # handled on the QR side
+            and _rid(n) not in qr_node_names
+            and not n.get("queuedResource"))]
+    if not managed_qrs and not managed_nodes:
+        print("no tony-managed nodes or queued resources found")
         return 0
-    for n in managed:
-        node_id = n.get("name", "").rsplit("/", 1)[-1]
-        print(f"{node_id}\t{n.get('state', '?')}\t"
+    for q in managed_qrs:
+        print(f"{_rid(q)}\tqueued-resource "
+              f"{(q.get('state') or {}).get('state', '?')}")
+    for n in managed_nodes:
+        print(f"{_rid(n)}\tnode {n.get('state', '?')}\t"
               f"{n.get('acceleratorType', '?')}")
     if not args.delete:
-        print(f"{len(managed)} node(s); re-run with --delete to remove "
-              f"them (make sure no tony-tpu job is running against them!)")
+        print(f"{len(managed_qrs)} queued resource(s) + "
+              f"{len(managed_nodes)} node(s); re-run with --delete to "
+              f"remove them (make sure no tony-tpu job is running "
+              f"against them!)")
         return 0
-    # The filter cannot tell a LEAKED node from one a live coordinator
-    # holds — repeat the warning where it matters, on the destructive
-    # path.
+    # The filter cannot tell a LEAKED resource from one a live
+    # coordinator holds — repeat the warning where it matters, on the
+    # destructive path.
     print("deleting — make sure no tony-tpu job is running against "
-          "these nodes!", file=sys.stderr)
+          "these resources!", file=sys.stderr)
     # Deletes are independent long-running ops: issue them ALL first,
-    # then poll — N stranded nodes cost one op latency, not N.
+    # then poll — N stranded resources cost one op latency, not N.
     failures = 0
     pending = []
-    for n in managed:
-        node_id = n.get("name", "").rsplit("/", 1)[-1]
+    for qr_id in sorted(qr_ids):
+        try:
+            pending.append((qr_id,
+                            api.delete_queued_resource(qr_id, force=True)))
+        except FileNotFoundError:
+            print(f"{qr_id} already gone")
+        except Exception as e:  # noqa: BLE001
+            failures += 1
+            print(f"failed to delete {qr_id}: {e}", file=sys.stderr)
+    for n in managed_nodes:
+        node_id = _rid(n)
         try:
             pending.append((node_id, api.delete_node(node_id)))
         except FileNotFoundError:
@@ -308,13 +347,13 @@ def _cmd_gcloud_gc(args: argparse.Namespace) -> int:
         except Exception as e:  # noqa: BLE001
             failures += 1
             print(f"failed to delete {node_id}: {e}", file=sys.stderr)
-    for node_id, op in pending:
+    for rid, op in pending:
         try:
             api.wait_operation(op, timeout_s=300, interval_s=5.0)
-            print(f"deleted {node_id}")
+            print(f"deleted {rid}")
         except Exception as e:  # noqa: BLE001
             failures += 1
-            print(f"failed to delete {node_id}: {e}", file=sys.stderr)
+            print(f"failed to delete {rid}: {e}", file=sys.stderr)
     return 1 if failures else 0
 
 

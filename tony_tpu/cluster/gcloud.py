@@ -130,23 +130,49 @@ class TpuApiClient:
     def get_node(self, node_id: str) -> dict:
         return self._request("GET", f"{self.parent}/nodes/{node_id}")
 
-    def list_nodes(self) -> List[dict]:
-        """All nodes in the zone, following ``nextPageToken`` to the end
+    # -- queued resources (the capacity-queue acquisition path) --------
+    def create_queued_resource(self, qr_id: str, body: dict) -> dict:
+        return self._request(
+            "POST",
+            f"{self.parent}/queuedResources?queuedResourceId={qr_id}",
+            body=body)
+
+    def get_queued_resource(self, qr_id: str) -> dict:
+        return self._request("GET",
+                             f"{self.parent}/queuedResources/{qr_id}")
+
+    def delete_queued_resource(self, qr_id: str,
+                               force: bool = True) -> dict:
+        return self._request(
+            "DELETE", f"{self.parent}/queuedResources/{qr_id}"
+            + ("?force=true" if force else ""))
+
+    def _list_paged(self, collection: str, item_key: str) -> List[dict]:
+        """Paginated zone listing, following ``nextPageToken`` to the end
         (same discipline as the GCS listing — a janitor that only reads
-        page 1 'finds no leaks' while billing nodes sit on page 2). The
-        janitor's view — see ``cli gcloud-gc``."""
-        nodes: List[dict] = []
+        page 1 'finds no leaks' while billing resources sit on page 2)."""
+        from urllib.parse import quote
+
+        items: List[dict] = []
         token = ""
         while True:
-            path = f"{self.parent}/nodes"
+            path = f"{self.parent}/{collection}"
             if token:
-                from urllib.parse import quote
                 path += f"?pageToken={quote(token, safe='')}"
             page = self._request("GET", path)
-            nodes += page.get("nodes", [])
+            items += page.get(item_key, [])
             token = page.get("nextPageToken", "")
             if not token:
-                return nodes
+                return items
+
+    def list_nodes(self) -> List[dict]:
+        """All nodes in the zone (the janitor's view — ``cli gcloud-gc``)."""
+        return self._list_paged("nodes", "nodes")
+
+    def list_queued_resources(self) -> List[dict]:
+        """All queued resources in the zone — a hard-crashed coordinator
+        can leak a WAITING request that later grants and bills."""
+        return self._list_paged("queuedResources", "queuedResources")
 
     def delete_node(self, node_id: str) -> dict:
         return self._request("DELETE", f"{self.parent}/nodes/{node_id}")
@@ -237,7 +263,7 @@ class GcloudTpuProvisioner(SliceProvisioner):
                  ssh_user: str = "", remote_python: str = "python3",
                  create_timeout_s: float = 900.0,
                  poll_interval_s: float = 5.0, spot: bool = False,
-                 network: str = "",
+                 network: str = "", queued: bool = False,
                  channel_factory: Optional[
                      Callable[[str, dict], HostChannel]] = None):
         if not accelerator_type or not runtime_version:
@@ -254,10 +280,18 @@ class GcloudTpuProvisioner(SliceProvisioner):
         self.poll_interval_s = poll_interval_s
         self.spot = spot
         self.network = network
+        #: acquire capacity via the queued-resources API instead of a
+        #: direct node create — the path real TPU capacity is commonly
+        #: granted through (reservations/spot queues): the request WAITS
+        #: in the provider's queue until capacity exists, then the node
+        #: materializes. tony.gcloud.queued-resource.
+        self.queued = queued
         self._channel_factory = channel_factory or self._ssh_channel
         #: node ids this provisioner created and has not yet deleted —
-        #: release() only ever deletes its own nodes.
-        self._owned: Dict[str, bool] = {}
+        #: release() only ever deletes its own nodes. Value records the
+        #: acquisition mode ("node" | "qr") so release tears down the
+        #: right resources.
+        self._owned: Dict[str, str] = {}
 
     # -- channels ------------------------------------------------------
     def _ssh_channel(self, host_id: str, endpoint: dict) -> HostChannel:
@@ -270,7 +304,8 @@ class GcloudTpuProvisioner(SliceProvisioner):
                               python=self.remote_python)
 
     # -- SliceProvisioner ----------------------------------------------
-    def _node_body(self, nonce: str) -> dict:
+    def _node_body(self, nonce: str,
+                   include_scheduling: bool = True) -> dict:
         body: dict = {
             "acceleratorType": self.accelerator_type,
             "runtimeVersion": self.runtime_version,
@@ -279,7 +314,10 @@ class GcloudTpuProvisioner(SliceProvisioner):
             # lost response, not someone else's node (see acquire).
             "labels": {"tony-managed": "true", "tony-nonce": nonce},
         }
-        if self.spot:
+        if self.spot and include_scheduling:
+            # Direct create only: on the queued path the tier is
+            # expressed on the QueuedResource envelope and the API
+            # rejects schedulingConfig inside a QR node spec.
             body["schedulingConfig"] = {"preemptible": True}
         if self.network:
             body["networkConfig"] = {"network": self.network}
@@ -290,6 +328,8 @@ class GcloudTpuProvisioner(SliceProvisioner):
         # — tony.gcloud.create-timeout-s promises a bound on the sum, not
         # per phase.
         deadline = time.monotonic() + self.create_timeout_s
+        if self.queued:
+            return self._acquire_queued(n_hosts, deadline)
         node_id = ""
         op: Optional[dict] = None
         last_err: Optional[Exception] = None
@@ -322,19 +362,14 @@ class GcloudTpuProvisioner(SliceProvisioner):
         else:
             raise SliceProvisionError(
                 f"could not find a free node name: {last_err}")
-        self._owned[node_id] = True
+        self._owned[node_id] = "node"
         try:
             if op is not None:
                 self.api.wait_operation(
                     op, max(0.0, deadline - time.monotonic()),
                     self.poll_interval_s)
             node = self._await_ready(node_id, deadline)
-            endpoints = node.get("networkEndpoints") or []
-            if len(endpoints) != n_hosts:
-                raise SliceProvisionError(
-                    f"node {node_id} ({self.accelerator_type}) has "
-                    f"{len(endpoints)} hosts but the job needs {n_hosts} — "
-                    f"fix tony.slice.num-hosts or the accelerator type")
+            return self._lease_from_node(node_id, node, n_hosts)
         except BaseException as e:
             # All-or-nothing: never leak a half-created (and billing!)
             # node behind a failed acquire.
@@ -343,12 +378,94 @@ class GcloudTpuProvisioner(SliceProvisioner):
                 raise
             raise SliceProvisionError(
                 f"TPU node {node_id} did not become READY: {e}") from e
+
+    def _lease_from_node(self, node_id: str, node: dict,
+                         n_hosts: int) -> SliceLease:
+        endpoints = node.get("networkEndpoints") or []
+        if len(endpoints) != n_hosts:
+            raise SliceProvisionError(
+                f"node {node_id} ({self.accelerator_type}) has "
+                f"{len(endpoints)} hosts but the job needs {n_hosts} — "
+                f"fix tony.slice.num-hosts or the accelerator type")
         hosts = [self._channel_factory(f"{node_id}-host-{i}", ep)
                  for i, ep in enumerate(endpoints)]
         log.info("leased TPU node %s (%s): %d hosts", node_id,
                  self.accelerator_type, len(hosts))
         return GcloudSliceLease(node_id, hosts, self.api,
                                 self.poll_interval_s)
+
+    #: queued-resource states that will never become ACTIVE
+    _QR_TERMINAL = frozenset({"FAILED", "SUSPENDED", "SUSPENDING"})
+
+    def _acquire_queued(self, n_hosts: int, deadline: float) -> SliceLease:
+        """Capacity via the queued-resources API: the request waits in
+        the provider's queue (WAITING_FOR_RESOURCES → PROVISIONING →
+        ACTIVE) and the node materializes when granted. Same
+        all-or-nothing contract: any failure deletes the queued resource
+        (force — taking its half-created node with it)."""
+        qr_id = ""
+        last_err: Optional[Exception] = None
+        for _ in range(3):
+            qr_id = f"{self.node_prefix}-{os.urandom(3).hex()}"
+            nonce = os.urandom(8).hex()
+            body: dict = {"tpu": {"nodeSpec": [{
+                "parent": self.api.parent,
+                "nodeId": qr_id,
+                "node": self._node_body(nonce, include_scheduling=False),
+            }]}}
+            # Queued-resource tier rides the QR, not schedulingConfig.
+            body["spot" if self.spot else "guaranteed"] = {}
+            try:
+                self.api.create_queued_resource(qr_id, body)
+                break
+            except TpuApiError as e:
+                if e.code == 409:
+                    # Same lost-response hazard as the direct path: our
+                    # create may have landed server-side with the
+                    # response dropped, and abandoning that WAITING
+                    # request would let it grant and bill a node nobody
+                    # owns. The per-attempt nonce distinguishes ours.
+                    if self._probe_qr_is_ours(qr_id, nonce):
+                        log.warning(
+                            "queued-resource create of %s 409'd but the "
+                            "request is ours (lost response); adopting",
+                            qr_id)
+                        break
+                    last_err = e    # true collision: new random suffix
+                    continue
+                raise SliceProvisionError(
+                    f"queued-resource create denied: {e}") from e
+        else:
+            raise SliceProvisionError(
+                f"could not find a free queued-resource name: {last_err}")
+        self._owned[qr_id] = "qr"
+        try:
+            while True:
+                qr = self.api.get_queued_resource(qr_id)
+                state = str((qr.get("state") or {}).get("state", ""))
+                if state == "ACTIVE":
+                    break
+                if state in self._QR_TERMINAL:
+                    raise SliceProvisionError(
+                        f"queued resource {qr_id} became {state} "
+                        f"(capacity request rejected)")
+                if time.monotonic() > deadline:
+                    raise SliceProvisionError(
+                        f"queued resource {qr_id} still {state} after "
+                        f"{self.create_timeout_s:.0f}s — no capacity "
+                        f"granted within the acquire budget")
+                time.sleep(self.poll_interval_s)
+            # ACTIVE: the node exists; poll it to READY like the direct
+            # path (endpoints appear with READY).
+            node = self._await_ready(qr_id, deadline)
+            return self._lease_from_node(qr_id, node, n_hosts)
+        except BaseException as e:
+            self._delete_quietly(qr_id)
+            if isinstance(e, SliceProvisionError):
+                raise
+            raise SliceProvisionError(
+                f"queued resource {qr_id} did not become ACTIVE: "
+                f"{e}") from e
 
     def _probe_is_ours(self, node_id: str, nonce: str) -> bool:
         """After a 409 on a name we generated: does the node carry the
@@ -358,6 +475,20 @@ class GcloudTpuProvisioner(SliceProvisioner):
         except Exception:  # noqa: BLE001 — can't tell: treat as not ours
             return False
         return node.get("labels", {}).get("tony-nonce") == nonce
+
+    def _probe_qr_is_ours(self, qr_id: str, nonce: str) -> bool:
+        """QR flavor of the lost-create-response probe: the nonce lives
+        in the queued resource's embedded node spec labels."""
+        try:
+            qr = self.api.get_queued_resource(qr_id)
+        except Exception:  # noqa: BLE001 — can't tell: treat as not ours
+            return False
+        specs = (qr.get("tpu") or {}).get("nodeSpec") or []
+        for spec in specs:
+            labels = (spec.get("node") or {}).get("labels") or {}
+            if labels.get("tony-nonce") == nonce:
+                return True
+        return False
 
     def _await_ready(self, node_id: str, deadline: float) -> dict:
         """The create op finishing does not mean the node is usable —
@@ -380,15 +511,21 @@ class GcloudTpuProvisioner(SliceProvisioner):
             time.sleep(self.poll_interval_s)
 
     def _delete_quietly(self, node_id: str) -> None:
+        mode = self._owned.get(node_id, "node")
         try:
-            op = self.api.delete_node(node_id)
+            if mode == "qr":
+                # force=true takes the queued resource AND its node in
+                # one call, whatever state the grant reached.
+                op = self.api.delete_queued_resource(node_id, force=True)
+            else:
+                op = self.api.delete_node(node_id)
             self.api.wait_operation(op, timeout_s=120,
                                     interval_s=self.poll_interval_s)
         except FileNotFoundError:
             pass                        # already gone
         except Exception as e:  # noqa: BLE001
-            log.warning("best-effort delete of node %s failed: %s",
-                        node_id, e)
+            log.warning("best-effort delete of %s %s failed: %s",
+                        mode, node_id, e)
         finally:
             self._owned.pop(node_id, None)
 

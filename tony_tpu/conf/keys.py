@@ -124,6 +124,13 @@ GCLOUD_POLL_INTERVAL_S = _key(
     "tony.gcloud.poll-interval-s", 5.0, float,
     "tpu-slice+gcloud only: cadence for operation/READY polling and for "
     "the lease's node-state health checks.")
+GCLOUD_QUEUED_RESOURCE = _key(
+    "tony.gcloud.queued-resource", False, bool,
+    "tpu-slice+gcloud only: acquire capacity via the queued-resources "
+    "API (request waits in the provider's queue until granted — the "
+    "path reservations and spot capacity commonly require) instead of "
+    "a direct node create. tony.gcloud.create-timeout-s bounds the "
+    "whole wait.")
 GCLOUD_CHANNEL = _key(
     "tony.gcloud.channel", "ssh", str,
     "tpu-slice+gcloud only: how to reach the node's VMs: ssh (production) "

@@ -77,6 +77,7 @@ def _make_backend(conf, workdir):
                     conf.get(K.GCLOUD_POLL_INTERVAL_S, 5.0)),
                 spot=bool(conf.get(K.GCLOUD_SPOT, False)),
                 network=str(conf.get(K.GCLOUD_NETWORK, "")),
+                queued=bool(conf.get(K.GCLOUD_QUEUED_RESOURCE, False)),
                 channel_factory=factory)
         else:
             raise ValueError(f"unknown tony.slice.provisioner {prov_kind!r}")
