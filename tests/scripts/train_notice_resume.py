@@ -14,7 +14,7 @@ import jax.numpy as jnp
 
 from tony_tpu.checkpoint import CheckpointManager
 
-TOTAL = 8
+TOTAL = 6
 mgr = CheckpointManager(os.environ["TONY_CHECKPOINT_DIR"], async_save=False)
 state = {"step": jnp.zeros((), jnp.int32)}
 latest = mgr.latest_step()

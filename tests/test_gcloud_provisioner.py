@@ -139,7 +139,7 @@ def test_create_timeout_deletes_node():
     try:
         prov = _prov(_api(server), create_timeout_s=0.2,
                      poll_interval_s=0.02)
-        with pytest.raises(SliceProvisionError, match="stuck in CREATING"):
+        with pytest.raises(SliceProvisionError, match="still CREATING"):
             prov.acquire(1)
         assert server.nodes == {}
     finally:
@@ -278,10 +278,10 @@ def test_queued_resource_acquire_waits_for_grant_then_leases(tmp_path):
         node = server.nodes[lease.slice_id]
         assert node["state"] == "READY"
         assert len(lease.hosts) == 2
-        # tier rode the QR envelope, not schedulingConfig (which the
-        # real API rejects inside a QR node spec)
+        # plain on-demand: NEITHER tier field (guaranteed would mean
+        # reservation capacity; schedulingConfig is rejected in QR specs)
         qr = server.qrs[lease.slice_id]
-        assert "guaranteed" in qr
+        assert "guaranteed" not in qr and "spot" not in qr
         assert "schedulingConfig" not in \
             (qr["tpu"]["nodeSpec"][0].get("node") or {}) or \
             not qr["tpu"]["nodeSpec"][0]["node"].get("schedulingConfig")
@@ -302,6 +302,23 @@ def test_queued_resource_spot_tier():
         assert "spot" in qr
         assert not (qr["tpu"]["nodeSpec"][0].get("node") or {}).get(
             "schedulingConfig")
+        prov.release(lease)
+    finally:
+        server.stop()
+
+
+def test_queued_resource_survives_create_visibility_lag():
+    """Right after create the QR may not be GETtable (the create LRO is
+    still materializing it): a 404 within the deadline is 'not visible
+    yet', never 'gone' — aborting there would force-delete a request
+    that was about to succeed."""
+    server = TpuApiFakeServer().start()
+    server.qr_invisible_gets = 2
+    try:
+        prov = _prov(_api(server), queued=True,
+                     channel_factory=lambda hid, ep: _localsim(hid))
+        lease = prov.acquire(1)
+        assert server.qrs[lease.slice_id]["state"]["state"] == "ACTIVE"
         prov.release(lease)
     finally:
         server.stop()
@@ -356,7 +373,8 @@ def test_gcloud_gc_reaps_only_labeled_nodes(capsys):
         assert "tony-dead00" in server.nodes
         # --delete reaps the labeled node only
         rc = cli_main(["gcloud-gc", "--project", "p", "--zone", "z",
-                       "--api-endpoint", server.endpoint, "--delete"])
+                       "--api-endpoint", server.endpoint, "--delete",
+                       "--poll-interval", "0.05"])
         assert rc == 0
         assert "tony-dead00" not in server.nodes
         assert "someone-else" in server.nodes
@@ -393,7 +411,8 @@ def test_gcloud_gc_reaps_queued_resources_and_their_nodes(capsys):
             {"labels": {"tony-managed": "true"}}, state="READY",
             via_qr=server.qrs["tony-run00"]["name"])
         rc = cli_main(["gcloud-gc", "--project", "p", "--zone", "z",
-                       "--api-endpoint", server.endpoint, "--delete"])
+                       "--api-endpoint", server.endpoint, "--delete",
+                       "--poll-interval", "0.05"])
         assert rc == 0
         capsys.readouterr()
         assert server.qrs == {}

@@ -174,7 +174,7 @@ def test_e2e_preemption_notice_saves_then_retry_resumes(tmp_path,
     start, end = result.read_text().split()
     assert int(start) >= 3, \
         f"retry should RESUME from the notice-driven save, got {start}"
-    assert int(end) == 8
+    assert int(end) == 6
 
 
 def _wait_for(path, timeout=60):

@@ -53,6 +53,9 @@ class TpuApiFakeServer:
         self.qr_polls: Dict[str, int] = {}
         self.qr_active_after_polls = 1
         self.qr_stuck_waiting = False
+        #: first N GETs of any QR 404 (models create-LRO eventual
+        #: consistency: the resource isn't GETtable immediately)
+        self.qr_invisible_gets = 0
         self.node_polls: Dict[str, int] = {}
         self.ops: Dict[str, dict] = {}          # op name -> op resource
         self.op_polls: Dict[str, int] = {}
@@ -169,6 +172,9 @@ class TpuApiFakeServer:
 
             def _get_qr(self, qr_id: str):
                 with server.lock:
+                    if server.qr_invisible_gets > 0:
+                        server.qr_invisible_gets -= 1
+                        return self._jsend(404, {"error": "qr notFound"})
                     qr = server.qrs.get(qr_id)
                     if qr is None:
                         return self._jsend(404, {"error": "qr notFound"})

@@ -349,7 +349,8 @@ def _cmd_gcloud_gc(args: argparse.Namespace) -> int:
             print(f"failed to delete {node_id}: {e}", file=sys.stderr)
     for rid, op in pending:
         try:
-            api.wait_operation(op, timeout_s=300, interval_s=5.0)
+            api.wait_operation(op, timeout_s=300,
+                               interval_s=args.poll_interval)
             print(f"deleted {rid}")
         except Exception as e:  # noqa: BLE001
             failures += 1
@@ -446,6 +447,8 @@ def build_parser() -> argparse.ArgumentParser:
                     help="actually delete (default: list only)")
     gc.add_argument("--api-endpoint", default="",
                     help="Cloud TPU API endpoint override (tests)")
+    gc.add_argument("--poll-interval", type=float, default=5.0,
+                    help="delete-operation poll cadence in seconds")
     gc.set_defaults(fn=_cmd_gcloud_gc)
     return p
 

@@ -414,7 +414,10 @@ class GcloudTpuProvisioner(SliceProvisioner):
                 "node": self._node_body(nonce, include_scheduling=False),
             }]}}
             # Queued-resource tier rides the QR, not schedulingConfig.
-            body["spot" if self.spot else "guaranteed"] = {}
+            # Plain on-demand omits BOTH tier fields — "guaranteed" means
+            # reservation/commitment capacity the project may not hold.
+            if self.spot:
+                body["spot"] = {}
             try:
                 self.api.create_queued_resource(qr_id, body)
                 break
@@ -440,21 +443,18 @@ class GcloudTpuProvisioner(SliceProvisioner):
                 f"could not find a free queued-resource name: {last_err}")
         self._owned[qr_id] = "qr"
         try:
-            while True:
-                qr = self.api.get_queued_resource(qr_id)
-                state = str((qr.get("state") or {}).get("state", ""))
-                if state == "ACTIVE":
-                    break
-                if state in self._QR_TERMINAL:
-                    raise SliceProvisionError(
-                        f"queued resource {qr_id} became {state} "
-                        f"(capacity request rejected)")
-                if time.monotonic() > deadline:
-                    raise SliceProvisionError(
-                        f"queued resource {qr_id} still {state} after "
-                        f"{self.create_timeout_s:.0f}s — no capacity "
-                        f"granted within the acquire budget")
-                time.sleep(self.poll_interval_s)
+            self._poll_state(
+                fetch=lambda: self.api.get_queued_resource(qr_id),
+                state_of=lambda qr: str(
+                    (qr.get("state") or {}).get("state", "")),
+                ready_state="ACTIVE", terminal=self._QR_TERMINAL,
+                deadline=deadline, what=f"queued resource {qr_id}",
+                stuck_hint="no capacity granted within the acquire "
+                           "budget",
+                # Right after create the QR may not be GETtable yet
+                # (the create LRO is still materializing it) — a 404
+                # within the deadline is "not visible yet", not gone.
+                tolerate_missing=True)
             # ACTIVE: the node exists; poll it to READY like the direct
             # path (endpoints appear with READY).
             node = self._await_ready(qr_id, deadline)
@@ -490,25 +490,46 @@ class GcloudTpuProvisioner(SliceProvisioner):
                 return True
         return False
 
+    def _poll_state(self, fetch, state_of, ready_state: str,
+                    terminal: frozenset, deadline: float, what: str,
+                    stuck_hint: str = "",
+                    tolerate_missing: bool = False) -> dict:
+        """ONE poll-until-ready-or-terminal-or-deadline loop for both
+        resource kinds (node READY, queued resource ACTIVE) — two copies
+        of the deadline/terminal semantics would drift."""
+        while True:
+            state = ""
+            try:
+                res = fetch()
+                state = state_of(res)
+                if state == ready_state:
+                    return res
+                if state in terminal:
+                    raise SliceProvisionError(
+                        f"{what} became {state} while waiting for "
+                        f"{ready_state}")
+            except FileNotFoundError:
+                if not tolerate_missing:
+                    raise
+                state = "(not yet visible)"
+            if time.monotonic() > deadline:
+                raise SliceProvisionError(
+                    f"{what} still {state or '?'} after "
+                    f"{self.create_timeout_s:.0f}s"
+                    + (f" — {stuck_hint}" if stuck_hint else ""))
+            time.sleep(self.poll_interval_s)
+
     def _await_ready(self, node_id: str, deadline: float) -> dict:
         """The create op finishing does not mean the node is usable —
         poll the node itself to READY (the API may report CREATING for a
         while after, and endpoints appear only when READY). ``deadline``
         is the acquire-wide monotonic bound."""
-        while True:
-            node = self.api.get_node(node_id)
-            state = str(node.get("state", ""))
-            if state == "READY":
-                return node
-            if state in TERMINAL_STATES:
-                raise SliceProvisionError(
-                    f"node {node_id} became {state} while waiting for "
-                    f"READY (stockout/preempt during create)")
-            if time.monotonic() > deadline:
-                raise SliceProvisionError(
-                    f"node {node_id} stuck in {state} after "
-                    f"{self.create_timeout_s:.0f}s")
-            time.sleep(self.poll_interval_s)
+        return self._poll_state(
+            fetch=lambda: self.api.get_node(node_id),
+            state_of=lambda n: str(n.get("state", "")),
+            ready_state="READY", terminal=TERMINAL_STATES,
+            deadline=deadline, what=f"node {node_id}",
+            stuck_hint="stockout/preempt during create")
 
     def _delete_quietly(self, node_id: str) -> None:
         mode = self._owned.get(node_id, "node")
