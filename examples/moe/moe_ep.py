@@ -21,6 +21,7 @@ if int(os.environ.get("JAX_NUM_PROCESSES", "1")) > 1:
 import jax.numpy as jnp
 import optax
 
+from tony_tpu import compat
 from tony_tpu.models.moe import MoEConfig, MoETransformer, moe_lm_loss
 from tony_tpu.parallel import MeshSpec, build_mesh, init_sharded_state
 from tony_tpu.parallel.sharding import DEFAULT_RULES
@@ -56,7 +57,7 @@ def step(state):
 from tony_tpu import telemetry
 
 first = last = None
-with jax.set_mesh(mesh):
+with compat.set_mesh(mesh):
     for i in range(STEPS):
         with telemetry.step():
             state, l = step(state)

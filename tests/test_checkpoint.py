@@ -85,6 +85,9 @@ def test_e2e_crash_resume_with_session_retry(tmp_path):
     # invariants below hold on whichever epoch completes.
     conf = make_conf(tmp_path, "train_with_resume.py", workers=1, extra={
         K.APPLICATION_RETRY_COUNT: 2,
+        # the intentional crash is a user exit(1) = USER_ERROR, terminal
+        # by default — this test wants the reference-compat retry
+        K.APPLICATION_RETRY_USER_ERRORS: True,
         K.APPLICATION_CHECKPOINT_DIR: str(tmp_path / "ckpt"),
     })
     conf.set(K.EXECUTION_ENV, f"TONY_TEST_RESULT={result}")
@@ -169,4 +172,113 @@ def test_preemption_handler_defers_while_save_in_flight(tmp_path):
         assert set(mgr._mgr.all_steps()) == {8, 9}  # both saves durable
     finally:
         signal.signal(signal.SIGTERM, signal.SIG_DFL)
+        mgr.close()
+
+
+# ---------------------------------------------------------------------------
+# Integrity manifests: checksum at save, verify + fallback at restore
+# ---------------------------------------------------------------------------
+def _ckpt_with_steps(tmp_path, steps=(1, 2, 3)):
+    mgr = CheckpointManager(str(tmp_path / "c"), async_save=False,
+                            max_to_keep=10)
+    base = jnp.arange(8.0)
+    for s in steps:
+        mgr.save(s, {"w": base * s}, force=True)
+    mgr.wait()                       # manifests flushed for durable steps
+    return mgr, base
+
+
+def _corrupt_step(mgr, step):
+    """Truncate every file the step's manifest covers (a torn write)."""
+    import json
+
+    with open(mgr.manifest_path(step), encoding="utf-8") as f:
+        manifest = json.load(f)
+    root = os.path.join(mgr._directory, str(step))
+    assert manifest["files"], "manifest should list files"
+    for rel in manifest["files"]:
+        p = os.path.join(root, rel.replace("/", os.sep))
+        size = os.path.getsize(p)
+        with open(p, "r+b") as f:
+            f.truncate(size // 2)
+
+
+def test_manifest_written_and_steps_verify(tmp_path):
+    mgr, base = _ckpt_with_steps(tmp_path)
+    try:
+        for s in (1, 2, 3):
+            assert os.path.exists(mgr.manifest_path(s))
+            assert mgr.verify_step(s)
+        assert mgr.latest_verified_step() == 3
+    finally:
+        mgr.close()
+
+
+def test_corrupt_latest_restores_previous_verified_step(tmp_path):
+    """THE integrity contract: a truncated newest checkpoint must not be
+    restored — restore(None) falls back to the newest verified step."""
+    mgr, base = _ckpt_with_steps(tmp_path)
+    try:
+        _corrupt_step(mgr, 3)
+        assert not mgr.verify_step(3)
+        assert mgr.latest_verified_step() == 2
+        restored = mgr.restore(None, {"w": base})
+        np.testing.assert_array_equal(np.asarray(restored["w"]),
+                                      np.asarray(base * 2))
+    finally:
+        mgr.close()
+
+
+def test_explicitly_requested_corrupt_step_fails_loudly(tmp_path):
+    mgr, base = _ckpt_with_steps(tmp_path)
+    try:
+        _corrupt_step(mgr, 2)
+        with pytest.raises(IOError):
+            mgr.restore(2, {"w": base})
+        # and an explicit GOOD step still restores
+        ok = mgr.restore(3, {"w": base})
+        np.testing.assert_array_equal(np.asarray(ok["w"]),
+                                      np.asarray(base * 3))
+    finally:
+        mgr.close()
+
+
+def test_missing_file_fails_verification(tmp_path):
+    import json
+
+    mgr, base = _ckpt_with_steps(tmp_path, steps=(1, 2))
+    try:
+        with open(mgr.manifest_path(2), encoding="utf-8") as f:
+            manifest = json.load(f)
+        rel = sorted(manifest["files"])[0]
+        os.unlink(os.path.join(mgr._directory, "2",
+                               rel.replace("/", os.sep)))
+        assert not mgr.verify_step(2)
+        assert mgr.latest_verified_step() == 1
+    finally:
+        mgr.close()
+
+
+def test_async_saves_get_manifests_on_wait(tmp_path):
+    mgr = CheckpointManager(str(tmp_path / "c"), async_save=True)
+    try:
+        mgr.save(1, {"w": jnp.arange(4.0)}, force=True)
+        mgr.wait()
+        assert mgr.verify_step(1)
+    finally:
+        mgr.close()
+
+
+def test_checkpoint_save_fault_site(tmp_path):
+    from tony_tpu import faults
+
+    mgr = CheckpointManager(str(tmp_path / "c"), async_save=False)
+    try:
+        faults.install(faults.FaultInjector({"checkpoint.save": "at:2"}))
+        assert mgr.save(1, {"w": jnp.zeros(2)}, force=True)
+        with pytest.raises(faults.InjectedFault):
+            mgr.save(2, {"w": jnp.zeros(2)}, force=True)
+        assert mgr.save(3, {"w": jnp.zeros(2)}, force=True)
+    finally:
+        faults.uninstall()
         mgr.close()

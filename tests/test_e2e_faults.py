@@ -135,9 +135,12 @@ def test_e2e_delayed_completion_notification(tmp_path, monkeypatch):
 def test_e2e_whole_job_retry_succeeds_second_epoch(tmp_path):
     """Whole-job retry (reference AM reset, ``ApplicationMaster.java:
     356-371,559-575``): epoch 0 fails, session is rebuilt with
-    SESSION_ID=1, epoch 1 succeeds."""
+    SESSION_ID=1, epoch 1 succeeds. The failure is a user exit(1), so the
+    reference-compat retry-user-errors knob is required — default policy
+    makes USER_ERROR terminal (see test_e2e_user_error_terminal...)."""
     conf = make_conf(tmp_path, "exit_1_first_epoch.py", workers=2,
-                     extra={K.APPLICATION_RETRY_COUNT: 1})
+                     extra={K.APPLICATION_RETRY_COUNT: 1,
+                            K.APPLICATION_RETRY_USER_ERRORS: True})
     client, rec, code = submit(conf, tmp_path)
     assert code == 0, _dump_task_logs(client)
     assert rec.finished[1].get("session_id") == 1
@@ -156,7 +159,8 @@ def test_e2e_retry_window_never_reports_terminal_status(tmp_path):
     from tony_tpu.rpc.wire import RpcClient
 
     conf = make_conf(tmp_path, "exit_1_first_epoch.py", workers=2,
-                     extra={K.APPLICATION_RETRY_COUNT: 1})
+                     extra={K.APPLICATION_RETRY_COUNT: 1,
+                            K.APPLICATION_RETRY_USER_ERRORS: True})
     observed = []          # (status, attempt) tuples from the poller
     done = threading.Event()
     workdir = tmp_path / "work"
@@ -266,3 +270,152 @@ def test_e2e_tb_port_chief_only_and_tb_launch(tmp_path):
     port = marker.read_text().strip()
     assert port.isdigit()
     assert rec.finished[1].get("tb_url", "").endswith(f":{port}")
+
+
+# ---------------------------------------------------------------------------
+# Conf-driven deterministic fault matrix (tony_tpu/faults.py): every
+# scenario proves RECOVERY, not just detection — the robustness layer's
+# acceptance contract.
+# ---------------------------------------------------------------------------
+def _finished_events(tmp_path, app_id):
+    from tony_tpu.events import history
+
+    return history.read_job_events(str(tmp_path / "history"), app_id)
+
+
+def test_e2e_injected_rpc_drops_recover_via_backoff(tmp_path):
+    """Every executor's first two RPC frames are dropped (rpc.send
+    first:2): the reconnect + full-jitter backoff path absorbs them and
+    the job succeeds in epoch 0 — no retry budget consumed."""
+    conf = make_conf(tmp_path, "exit_0.py", workers=2)
+    conf.set(K.fault_key("rpc.send"), "first:2")
+    conf.set(K.FAULT_SEED, 7)
+    client, rec, code = submit(conf, tmp_path)
+    assert code == 0, _dump_task_logs(client)
+    assert rec.finished[0] == "SUCCEEDED"
+    assert rec.finished[1].get("session_id") == 0, \
+        "transport retries, not a retry epoch, must absorb dropped RPCs"
+
+
+def test_e2e_injected_heartbeat_stall_recovers_via_liveness_retry(tmp_path):
+    """Epoch 0's executor silently stalls its heartbeats (session:0
+    filter): the liveness monitor deems it dead — an INFRA_TRANSIENT
+    failure — and the retry epoch, free of the stall, succeeds."""
+    conf = make_conf(tmp_path, "sleep_5.py", workers=1, extra={
+        K.TASK_HEARTBEAT_INTERVAL_MS: 100,
+        K.TASK_MAX_MISSED_HEARTBEATS: 3,
+        K.APPLICATION_RETRY_COUNT: 1,
+    })
+    conf.set(K.fault_key("heartbeat"), "first:100,session:0")
+    client, rec, code = submit(conf, tmp_path)
+    assert code == 0, _dump_task_logs(client)
+    assert rec.finished[0] == "SUCCEEDED"
+    assert rec.finished[1].get("session_id") == 1, "retry epoch expected"
+    # The classified domain rode the task event stream.
+    evs = _finished_events(tmp_path, rec.app_id)
+    domains = [e.payload.get("failure_domain") for e in evs
+               if e.type == "TASK_FINISHED"]
+    assert "INFRA_TRANSIENT" in domains, domains
+
+
+def test_e2e_injected_spawn_failure_retries(tmp_path):
+    """The backend's first process spawn fails (executor.spawn at:1): an
+    unlaunchable gang is an INFRA_TRANSIENT session failure and the next
+    epoch's spawn succeeds."""
+    conf = make_conf(tmp_path, "exit_0.py", workers=1, extra={
+        K.APPLICATION_RETRY_COUNT: 1,
+    })
+    conf.set(K.fault_key("executor.spawn"), "at:1")
+    client, rec, code = submit(conf, tmp_path)
+    assert code == 0, _dump_task_logs(client)
+    assert rec.finished[1].get("session_id") == 1
+
+
+def test_e2e_injected_storage_burst_absorbed_without_session_failure(
+        tmp_path):
+    """A transient storage-error burst (storage.get first:2 in every
+    process) hits the executors' fetch of the frozen config from the
+    remote store; the store-level retry policy absorbs it — the session
+    never fails, no retry epoch happens."""
+    store_root = tmp_path / "remote-store"
+    conf = make_conf(tmp_path, "exit_0.py", workers=2, extra={
+        K.REMOTE_STORE: f"file://{store_root}",
+    })
+    conf.set(K.fault_key("storage.get"), "first:2")
+    client, rec, code = submit(conf, tmp_path)
+    assert code == 0, _dump_task_logs(client)
+    assert rec.finished[0] == "SUCCEEDED"
+    assert rec.finished[1].get("session_id") == 0, \
+        "storage retries must absorb the burst without a retry epoch"
+
+
+def test_e2e_user_error_is_terminal_on_first_occurrence(tmp_path):
+    """A deterministic user crash (exit 1) must NOT burn retry epochs:
+    even with budget available the job fails once, classified
+    USER_ERROR, and the domain lands in the final report + history."""
+    import time
+
+    conf = make_conf(tmp_path, "exit_1.py", workers=1, extra={
+        K.APPLICATION_RETRY_COUNT: 3,
+    })
+    t0 = time.monotonic()
+    client, rec, code = submit(conf, tmp_path)
+    elapsed = time.monotonic() - t0
+    assert code == constants.EXIT_FAILURE
+    assert rec.finished[0] == "FAILED"
+    report = rec.finished[1]
+    assert report.get("failure_domain") == "USER_ERROR"
+    assert report.get("session_id") == 0, "no retry epoch may run"
+    assert int(report.get("retries_left", -1)) == 3, \
+        "the transient budget must be untouched"
+    assert elapsed < 60, f"{elapsed:.0f}s — wasted retry epochs?"
+    evs = _finished_events(tmp_path, rec.app_id)
+    fin = [e for e in evs if e.type == "APPLICATION_FINISHED"][0]
+    assert fin.payload.get("failure_domain") == "USER_ERROR"
+
+
+def test_e2e_preemption_retries_free_of_the_retry_budget(tmp_path,
+                                                         monkeypatch):
+    """A slice host dies mid-run (the preemption shape) with
+    retry-count=0: the PREEMPTION domain draws on its own budget, the
+    job still retries on a fresh lease and succeeds — expected infra
+    churn cannot exhaust the budget kept for real failures."""
+    from test_cluster_tpu import slice_conf
+
+    monkeypatch.setenv(constants.TEST_SLICE_FAIL_HOST, "fakehost-0")
+    conf = slice_conf(tmp_path, "sleep_5.py", workers=1, n_hosts=1,
+                      inventory=2,
+                      extra={K.APPLICATION_RETRY_COUNT: 0})
+    client, rec, code = submit(conf, tmp_path)
+    assert code == 0, _dump_task_logs(client)
+    assert rec.finished[0] == "SUCCEEDED"
+    report = rec.finished[1]
+    assert report.get("session_id", 0) >= 1, \
+        "host loss must have triggered a (free) retry epoch"
+    evs = _finished_events(tmp_path, rec.app_id)
+    domains = [e.payload.get("failure_domain") for e in evs
+               if e.type == "TASK_FINISHED"]
+    assert "PREEMPTION" in domains, domains
+
+
+def test_e2e_preempted_epoch_with_torn_checkpoint_resumes_verified(
+        tmp_path):
+    """Preemption mid-epoch AND a torn newest checkpoint composed: epoch
+    0 exits 143 (PREEMPTION — free retry even with retry-count=0) after
+    truncating its last save; epoch 1's restore must reject the corrupt
+    step 2 and resume from verified step 1."""
+    result = tmp_path / "result.txt"
+    conf = make_conf(tmp_path, "train_corrupt_then_resume.py", workers=1,
+                     extra={
+                         K.APPLICATION_RETRY_COUNT: 0,
+                         K.APPLICATION_CHECKPOINT_DIR:
+                             str(tmp_path / "ckpt"),
+                     })
+    conf.set(K.EXECUTION_ENV, f"TONY_TEST_RESULT={result}")
+    client, rec, code = submit(conf, tmp_path)
+    assert code == 0, _dump_task_logs(client)
+    assert rec.finished[1].get("session_id") == 1
+    start, end = result.read_text().split()
+    assert int(start) == 1, \
+        f"must fall back to verified step 1, restored {start}"
+    assert int(end) == 4

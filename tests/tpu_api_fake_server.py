@@ -280,9 +280,12 @@ class TpuApiFakeServer:
                     if node_id not in server.nodes:
                         return self._jsend(404,
                                            {"error": "node notFound"})
-                    if server.nodes[node_id].get("queuedResource"):
+                    qr_ref = server.nodes[node_id].get("queuedResource")
+                    if qr_ref and qr_ref.rsplit("/", 1)[-1] in server.qrs:
                         # Real API: a queued-resource-created node must be
-                        # deleted via queuedResources.delete (force).
+                        # deleted via queuedResources.delete (force). A
+                        # DANGLING reference (QR record gone — partial
+                        # force-delete) no longer gates the node.
                         return self._jsend(400, {"error": {
                             "code": 400,
                             "message": "node was created by a queued "

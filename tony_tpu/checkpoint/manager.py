@@ -14,14 +14,37 @@ Resume contract with the coordinator's whole-job retry (sessionId epochs,
 ``ApplicationMaster.java:356-371``): user scripts call ``latest_step()`` at
 startup and restore if non-None — a retried session transparently continues
 from the last completed save.
+
+Integrity contract (new): every durable step gets a per-file sha256
+manifest (``tony-manifest.json`` inside the step directory), written once
+the step's async save is finished and verified before any restore. A
+restart after preemption/crash trusts NOTHING about the newest step: if
+it is partial (killed mid-write) or corrupt (bit rot, truncated upload),
+``restore(None, like)`` falls back to the newest step whose manifest
+verifies, instead of feeding garbage into 8B parameters and training on.
 """
 
 from __future__ import annotations
 
+import hashlib
+import json
 import logging
-from typing import Any, Optional
+import os
+from typing import Any, Dict, List, Optional
+
+from tony_tpu import faults
 
 log = logging.getLogger(__name__)
+
+MANIFEST_NAME = "tony-manifest.json"
+
+
+def _hash_file(path: str) -> str:
+    h = hashlib.sha256()
+    with open(path, "rb") as f:
+        for chunk in iter(lambda: f.read(1024 * 1024), b""):
+            h.update(chunk)
+    return h.hexdigest()
 
 
 class CheckpointManager:
@@ -34,9 +57,18 @@ class CheckpointManager:
         self._ocp = ocp
         self._busy = False               # main thread inside an orbax call
         self._preempt: Optional[dict] = None
+        # Orbax wants an absolute path; URLs (gs://...) pass through as-is.
+        # (ocp.path.utils.to_absolute_path came and went across releases —
+        # resolve locally instead of chasing it.)
+        directory = str(directory)
+        if "://" not in directory:
+            directory = os.path.abspath(directory)
+        self._directory = directory
+        # Steps saved but not yet checksummed: manifests are written only
+        # once the (async) save is durable — wait()/close()/restore().
+        self._pending_manifest: set = set()
         self._mgr = ocp.CheckpointManager(
-            ocp.path.utils.to_absolute_path(str(directory))
-            if hasattr(ocp.path, "utils") else str(directory),
+            directory,
             options=ocp.CheckpointManagerOptions(
                 max_to_keep=max_to_keep,
                 save_interval_steps=save_interval_steps,
@@ -45,30 +77,181 @@ class CheckpointManager:
 
     def save(self, step: int, state: Any, force: bool = False) -> bool:
         """Queue an (async) save; returns False when skipped by the
-        save_interval_steps policy."""
+        save_interval_steps policy. Every accepted step is registered for
+        a manifest, written once the save is durable (wait/close/next
+        restore — async writes must never be checksummed mid-flight)."""
+        faults.check("checkpoint.save")
         self._busy = True
         try:
-            return self._mgr.save(
+            saved = self._mgr.save(
                 int(step), args=self._ocp.args.StandardSave(state),
                 force=force)
         finally:
             self._busy = False
             self._run_deferred_preemption()
+        if saved:
+            self._pending_manifest.add(int(step))
+        return saved
 
-    def restore(self, step: Optional[int], like: Any) -> Any:
-        """Restore ``step`` (or the latest when None) with the shardings of
-        ``like`` — pass the freshly-initialized state (or an eval_shape of
-        it with NamedSharding leaves) so every shard lands on its device."""
+    # -- integrity ------------------------------------------------------
+    def _step_dir(self, step: int) -> str:
+        return os.path.join(self._directory, str(step))
+
+    def _integrity_enabled(self) -> bool:
+        # Remote (gs://...) checkpoint dirs go through tensorstore; the
+        # local-walk manifest does not apply there.
+        return "://" not in self._directory
+
+    def _step_files(self, step: int) -> List[str]:
+        """Step-relative paths of every file of a step (manifest excluded)."""
+        root = self._step_dir(step)
+        out: List[str] = []
+        for base, _, files in os.walk(root):
+            for f in files:
+                if f == MANIFEST_NAME and base == root:
+                    continue
+                rel = os.path.relpath(os.path.join(base, f), root)
+                out.append(rel.replace(os.sep, "/"))
+        return sorted(out)
+
+    def _write_manifest(self, step: int) -> None:
+        root = self._step_dir(step)
+        if not os.path.isdir(root):
+            return
+        files: Dict[str, Dict[str, Any]] = {}
+        for rel in self._step_files(step):
+            p = os.path.join(root, rel.replace("/", os.sep))
+            files[rel] = {"sha256": _hash_file(p),
+                          "size": os.path.getsize(p)}
+        tmp = os.path.join(root, MANIFEST_NAME + ".tmp")
+        with open(tmp, "w", encoding="utf-8") as f:
+            json.dump({"step": int(step), "files": files}, f,
+                      sort_keys=True)
+        os.replace(tmp, os.path.join(root, MANIFEST_NAME))
+
+    def _flush_manifests(self) -> None:
+        """Write manifests for every step whose save is now durable.
+        ONLY call with no async save in flight (after
+        wait_until_finished)."""
+        if not self._integrity_enabled():
+            self._pending_manifest.clear()
+            return
+        for step in sorted(self._pending_manifest):
+            try:
+                self._write_manifest(step)
+            except OSError as e:
+                # A garbage-collected step (max_to_keep) has no dir left.
+                log.debug("no manifest for step %d: %s", step, e)
+        self._pending_manifest.clear()
+
+    def manifest_path(self, step: int) -> str:
+        return os.path.join(self._step_dir(step), MANIFEST_NAME)
+
+    def verify_step(self, step: int) -> bool:
+        """True iff the step has a manifest and every listed file exists
+        with matching size+sha256 (extra files are tolerated — later orbax
+        versions may add metadata)."""
+        mpath = self.manifest_path(step)
+        try:
+            with open(mpath, encoding="utf-8") as f:
+                manifest = json.load(f)
+        except (OSError, ValueError):
+            return False
+        root = self._step_dir(step)
+        for rel, meta in (manifest.get("files") or {}).items():
+            p = os.path.join(root, rel.replace("/", os.sep))
+            try:
+                if os.path.getsize(p) != meta.get("size"):
+                    log.warning("checkpoint step %d: %s size mismatch",
+                                step, rel)
+                    return False
+                if _hash_file(p) != meta.get("sha256"):
+                    log.warning("checkpoint step %d: %s checksum mismatch",
+                                step, rel)
+                    return False
+            except OSError:
+                log.warning("checkpoint step %d: %s missing/unreadable",
+                            step, rel)
+                return False
+        return True
+
+    def latest_verified_step(self) -> Optional[int]:
+        """Newest step whose manifest verifies (None when none do)."""
+        self.wait()
+        for step in sorted(self._mgr.all_steps(), reverse=True):
+            if self.verify_step(int(step)):
+                return int(step)
+        return None
+
+    def restore(self, step: Optional[int], like: Any,
+                verify: bool = True) -> Any:
+        """Restore ``step`` (or the newest GOOD step when None) with the
+        shardings of ``like`` — pass the freshly-initialized state (or an
+        eval_shape of it with NamedSharding leaves) so every shard lands
+        on its device.
+
+        With ``step=None`` and ``verify`` (the default), candidates are
+        tried newest-first: a step whose manifest verifies is restored; a
+        step whose manifest FAILS verification (truncated/corrupt files)
+        is skipped with a warning; a step with no manifest at all (saved
+        by an older build, or the process died before the manifest flush)
+        is attempted and skipped only if orbax itself rejects it. An
+        explicit ``step`` is restored as requested — failing loudly if
+        its manifest does not verify."""
         import jax
 
         target = jax.tree.map(
             lambda x: (jax.ShapeDtypeStruct(x.shape, x.dtype, sharding=x.sharding)
                        if hasattr(x, "sharding") else x), like)
-        step = int(step) if step is not None else self.latest_step()
-        if step is None:
+        verify = verify and self._integrity_enabled()
+        if step is not None:
+            step = int(step)
+            if verify and os.path.exists(self.manifest_path(step)) \
+                    and not self.verify_step(step):
+                raise IOError(
+                    f"checkpoint step {step} failed integrity "
+                    f"verification ({self.manifest_path(step)})")
+            return self._mgr.restore(
+                step, args=self._ocp.args.StandardRestore(target))
+        self.wait()          # flushes pending manifests too
+        candidates = sorted(self._mgr.all_steps(), reverse=True)
+        if not candidates:
             raise FileNotFoundError("no checkpoint to restore")
-        return self._mgr.restore(
-            step, args=self._ocp.args.StandardRestore(target))
+        errors: List[str] = []
+        for cand in candidates:
+            cand = int(cand)
+            has_manifest = os.path.exists(self.manifest_path(cand))
+            if verify and has_manifest and not self.verify_step(cand):
+                log.warning(
+                    "checkpoint step %d is PARTIAL/CORRUPT — falling back "
+                    "to the previous verified step", cand)
+                errors.append(f"step {cand}: integrity check failed")
+                # Quarantine: a rejected step is garbage that would keep
+                # shadowing latest_step() AND block the resumed run from
+                # re-saving the same step number (orbax refuses to
+                # overwrite an existing step).
+                try:
+                    self._mgr.delete(cand)
+                    log.warning("deleted corrupt checkpoint step %d", cand)
+                except Exception as e:  # noqa: BLE001 — best-effort
+                    log.warning("could not delete corrupt step %d: %s",
+                                cand, e)
+                continue
+            try:
+                out = self._mgr.restore(
+                    cand, args=self._ocp.args.StandardRestore(target))
+                if cand != candidates[0]:
+                    log.warning("restored verified step %d (newest was %d)",
+                                cand, int(candidates[0]))
+                return out
+            except Exception as e:  # noqa: BLE001 — try the next-older step
+                if not verify:
+                    raise
+                log.warning("restore of step %d failed (%s); trying older",
+                            cand, e)
+                errors.append(f"step {cand}: {e}")
+        raise FileNotFoundError(
+            "no restorable checkpoint: " + "; ".join(errors))
 
     def latest_step(self) -> Optional[int]:
         return self._mgr.latest_step()
@@ -139,16 +322,20 @@ class CheckpointManager:
         sys.exit(st["exit_code"])
 
     def wait(self) -> None:
-        """Block until queued async saves are durable (call before exit)."""
+        """Block until queued async saves are durable (call before exit);
+        durable steps then get their integrity manifest."""
         self._busy = True
         try:
             self._mgr.wait_until_finished()
+            self._flush_manifests()
         finally:
             self._busy = False
             self._run_deferred_preemption()
 
     def close(self) -> None:
         self._mgr.close()
+        # close() waited for in-flight saves; their manifests are now due.
+        self._flush_manifests()
 
     def __enter__(self) -> "CheckpointManager":
         return self

@@ -41,6 +41,8 @@ class _LogListener(TaskUpdateListener):
         print(f"application finished: {status}")
         if report.get("failure_reason"):
             print(f"reason: {report['failure_reason']}")
+        if report.get("failure_domain"):
+            print(f"failure domain: {report['failure_domain']}")
 
 
 def _cmd_submit(args: argparse.Namespace) -> int:
@@ -143,9 +145,13 @@ def _cmd_status(args: argparse.Namespace) -> int:
             print(f"app_id:   {report['app_id']}")
             print(f"status:   {report['status']}")
             print(f"attempt:  {report['attempt']} "
-                  f"(retries left: {report['retries_left']})")
+                  f"(retries left: {report['retries_left']}, "
+                  f"preemption retries left: "
+                  f"{report.get('preemption_retries_left', '?')})")
             if report.get("failure_reason"):
                 print(f"reason:   {report['failure_reason']}")
+            if report.get("failure_domain"):
+                print(f"domain:   {report['failure_domain']}")
             if report.get("tb_url"):
                 print(f"tb_url:   {report['tb_url']}")
             for t in report.get("tasks", []):
@@ -290,22 +296,34 @@ def _cmd_gcloud_gc(args: argparse.Namespace) -> int:
     # was WAITING leaked something with no node yet — and a granted QR's
     # node can only be deleted through its QR (the API rejects
     # nodes.delete on queued-resource-created nodes).
-    managed_qrs = [q for q in api.list_queued_resources()
+    all_qrs = api.list_queued_resources()
+    managed_qrs = [q for q in all_qrs
                    if _qr_is_managed(q) and _rid(q).startswith(args.prefix)]
     qr_ids = {_rid(q) for q in managed_qrs}
+    live_qr_ids = {_rid(q) for q in all_qrs}
     qr_node_names = {
         spec.get("nodeId", "")
         for q in managed_qrs
         for spec in (q.get("tpu") or {}).get("nodeSpec") or []}
-    managed_nodes = [
+    candidates = [
         n for n in api.list_nodes()
         if (n.get("labels", {}).get("tony-managed") == "true"
             and _rid(n).startswith(args.prefix)
             # nodes a managed QR will reap (or that name their QR) are
             # handled on the QR side
-            and _rid(n) not in qr_node_names
-            and not n.get("queuedResource"))]
-    if not managed_qrs and not managed_nodes:
+            and _rid(n) not in qr_node_names)]
+    managed_nodes = [n for n in candidates if not n.get("queuedResource")]
+    # Leak shape the two lists above miss: a QR-created node whose QR no
+    # longer exists (externally deleted QR, partial force-delete). It has
+    # a queuedResource reference, so the node path skipped it; its QR is
+    # not in the live set, so the QR path never reaps it. These can only
+    # be deleted via their (stale) QR name — and when that 404s, via a
+    # last-resort nodes.delete.
+    stale_qr_nodes = [
+        (n, n["queuedResource"].rsplit("/", 1)[-1]) for n in candidates
+        if n.get("queuedResource")
+        and n["queuedResource"].rsplit("/", 1)[-1] not in live_qr_ids]
+    if not managed_qrs and not managed_nodes and not stale_qr_nodes:
         print("no tony-managed nodes or queued resources found")
         return 0
     for q in managed_qrs:
@@ -314,9 +332,14 @@ def _cmd_gcloud_gc(args: argparse.Namespace) -> int:
     for n in managed_nodes:
         print(f"{_rid(n)}\tnode {n.get('state', '?')}\t"
               f"{n.get('acceleratorType', '?')}")
+    for n, stale_qr in stale_qr_nodes:
+        print(f"{_rid(n)}\tnode {n.get('state', '?')}\t"
+              f"{n.get('acceleratorType', '?')}\t"
+              f"(stale queued-resource {stale_qr})")
     if not args.delete:
         print(f"{len(managed_qrs)} queued resource(s) + "
-              f"{len(managed_nodes)} node(s); re-run with --delete to "
+              f"{len(managed_nodes) + len(stale_qr_nodes)} node(s); "
+              f"re-run with --delete to "
               f"remove them (make sure no tony-tpu job is running "
               f"against them!)")
         return 0
@@ -347,6 +370,29 @@ def _cmd_gcloud_gc(args: argparse.Namespace) -> int:
         except Exception as e:  # noqa: BLE001
             failures += 1
             print(f"failed to delete {node_id}: {e}", file=sys.stderr)
+    for n, stale_qr in stale_qr_nodes:
+        node_id = _rid(n)
+        try:
+            # QR-created nodes must be deleted through their QR; the stale
+            # name may still resolve server-side (partial force-delete).
+            pending.append((node_id,
+                            api.delete_queued_resource(stale_qr,
+                                                       force=True)))
+        except FileNotFoundError:
+            # The QR really is gone — last resort, try the node directly
+            # (some API surfaces allow it once the QR record vanished).
+            try:
+                pending.append((node_id, api.delete_node(node_id)))
+            except FileNotFoundError:
+                print(f"{node_id} already gone")
+            except Exception as e:  # noqa: BLE001
+                failures += 1
+                print(f"failed to delete {node_id} (stale qr {stale_qr}):"
+                      f" {e}", file=sys.stderr)
+        except Exception as e:  # noqa: BLE001
+            failures += 1
+            print(f"failed to delete {node_id} via stale qr {stale_qr}: "
+                  f"{e}", file=sys.stderr)
     for rid, op in pending:
         try:
             api.wait_operation(op, timeout_s=300,

@@ -345,7 +345,9 @@ class TonyTpuClient:
                     except subprocess.TimeoutExpired:
                         log.warning("coordinator slow to exit; killing")
                 if status != "SUCCEEDED" and report.get("failure_reason"):
-                    log.error("application %s: %s", status,
+                    domain = report.get("failure_domain", "")
+                    log.error("application %s%s: %s", status,
+                              f" [{domain}]" if domain else "",
                               report["failure_reason"])
                 return 0 if status == "SUCCEEDED" else constants.EXIT_FAILURE
             time.sleep(interval)

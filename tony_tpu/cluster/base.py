@@ -62,6 +62,13 @@ def build_executor_argv(python: str, spec: TaskLaunchSpec,
     tony-tpu installed (and, for accelerator jobs, ``jax[tpu]`` plus TPU
     device access — typically ``--privileged`` baked into a wrapper image
     or the docker daemon's default runtime on TPU VMs)."""
+    from tony_tpu import faults
+
+    # Single choke point every backend passes through immediately before
+    # its process spawn — the ``executor.spawn`` injection site. A firing
+    # raises, launch_task propagates, and the coordinator's launch-failure
+    # policy (an INFRA_TRANSIENT session failure) takes over.
+    faults.check("executor.spawn")
     if not spec.docker_image:
         return [python, "-m", "tony_tpu.executor"]
     argv = ["docker", "run", "--rm", "--network=host",
@@ -123,6 +130,15 @@ class Backend(abc.ABC):
         """(stdout, stderr) paths/URLs for a task, if the backend captures
         them (the reference surfaces NodeManager log URLs per container,
         ``models/JobLog.java:69-80``)."""
+        return None
+
+    def completion_domain(self, task_id: str) -> Optional[str]:
+        """Failure-domain hint for a completion this backend reported:
+        ``"PREEMPTION"`` when the backend KNOWS the machine went away
+        under the task (slice host lost, node state PREEMPTED) — an exit
+        code alone can't distinguish that from an OOM kill. None = no
+        backend knowledge; the coordinator classifies from the exit code
+        (coordinator/session.py classify_exit)."""
         return None
 
     def gang_active(self) -> bool:

@@ -573,6 +573,10 @@ class TpuSliceBackend(Backend):
         self._lock = threading.Lock()
         self._test_fail_done = False
         self._last_launch = 0.0
+        # task_id → failure-domain hint for completions this backend
+        # attributed to the MACHINE rather than the task (host loss =
+        # preemption; see Backend.completion_domain).
+        self._domains: Dict[str, str] = {}
 
     # -- lease ---------------------------------------------------------
     def gang_active(self) -> bool:
@@ -714,6 +718,9 @@ class TpuSliceBackend(Backend):
         st = _SliceTask(spec, host, handle)
         with self._lock:
             self._tasks[spec.task_id] = st
+            # A relaunched task (retry epoch) must not inherit the old
+            # epoch's host-loss attribution.
+            self._domains.pop(spec.task_id, None)
         self._last_launch = time.monotonic()
         log.info("launched %s on %s", spec.task_id, host.host_id)
         return st
@@ -746,6 +753,11 @@ class TpuSliceBackend(Backend):
                 if rc == HOST_LOST_EXIT and not st.host.alive():
                     log.warning("host %s lost; %s reported exit %d",
                                 st.host.host_id, st.spec.task_id, rc)
+                    # The MACHINE died, not the task: classify as
+                    # PREEMPTION so the coordinator's free-retry budget
+                    # applies (Backend.completion_domain contract).
+                    with self._lock:
+                        self._domains[st.spec.task_id] = "PREEMPTION"
                 newly_done.append(st)
                 done.append((st.spec.task_id, rc))
         # Bring remote stdout/stderr home BEFORE the coordinator snapshots
@@ -770,6 +782,10 @@ class TpuSliceBackend(Backend):
         if st is None:
             return None
         return st.host.log_paths(st.handle)
+
+    def completion_domain(self, task_id: str) -> Optional[str]:
+        with self._lock:
+            return self._domains.get(task_id)
 
     def stop(self) -> None:
         with self._lock:

@@ -51,8 +51,25 @@ APPLICATION_TIMEOUT_S = _key(
     "(reference tony.application.timeout, TonyClient.java:874-882).")
 APPLICATION_RETRY_COUNT = _key(
     "tony.application.retry-count", 0, int,
-    "Coordinator-level whole-job retries (reference tony.am.retry-count, "
-    "ApplicationMaster.java:356-371).")
+    "Coordinator-level whole-job retries for INFRA_TRANSIENT failures "
+    "(reference tony.am.retry-count, ApplicationMaster.java:356-371). "
+    "USER_ERROR failures are terminal on first occurrence unless "
+    "retry-user-errors is set; PREEMPTION failures draw on their own "
+    "budget (preemption-retry-count) without consuming this one.")
+APPLICATION_PREEMPTION_RETRY_COUNT = _key(
+    "tony.application.preemption-retry-count", 3, int,
+    "Whole-job retries for PREEMPTION failures (slice host reclaimed, "
+    "spot notice, save-on-SIGTERM exits). Preemption is expected infra "
+    "churn, so these retries do NOT consume tony.application.retry-count "
+    "— a job preempted twice still has its full transient-failure budget. "
+    "0 disables free preemption retries (preemptions then fail the job "
+    "when retry-count is exhausted).")
+APPLICATION_RETRY_USER_ERRORS = _key(
+    "tony.application.retry-user-errors", False, bool,
+    "Reference-compat escape hatch: when true, USER_ERROR failures "
+    "(nonzero user exits) also consume tony.application.retry-count, "
+    "like TonY's undiscriminating whole-job retry. Default false: a "
+    "deterministic user crash burns retry epochs for nothing.")
 APPLICATION_BACKEND = _key(
     "tony.application.backend", "local", str,
     "Cluster substrate: local (subprocesses on this host, the MiniCluster "
@@ -328,6 +345,46 @@ TPU_MESH_SHAPE = _key(
     "'fsdp=4,tp=2'. One size may be -1 (inferred). Empty = pure-dp mesh "
     "over all devices.")
 
+# --- fault injection (tony_tpu/faults.py) ---------------------------------
+FAULT_SEED = _key(
+    "tony.fault.seed", 0, int,
+    "Seed for the deterministic fault-injection harness: per-site RNGs "
+    "are seeded with (seed, site), and the shared retry-backoff jitter "
+    "is seeded too, so a rehearsed failure replays identically.")
+
+
+def fault_key(site: str) -> str:
+    """Conf key for an injection site: 'rpc.send' → 'tony.fault.rpc-send'."""
+    return f"tony.fault.{site.replace('.', '-')}"
+
+
+# One registered key per injection site (tony_tpu/faults.py SITES); the
+# value is a spec like 'first:2', 'at:3', 'every:5', 'p:0.3,session:0'.
+FAULT_RPC_CONNECT = _key(
+    "tony.fault.rpc-connect", "", str,
+    "Inject a connection failure before RPC client connects "
+    "(spec grammar: tony_tpu/faults.py).")
+FAULT_RPC_SEND = _key(
+    "tony.fault.rpc-send", "", str,
+    "Inject a dropped-connection failure before an RPC request is sent.")
+FAULT_HEARTBEAT = _key(
+    "tony.fault.heartbeat", "", str,
+    "Make the executor silently skip heartbeats that fire this spec "
+    "(the conf-driven generalization of TONY_TEST_NUM_HB_MISS).")
+FAULT_EXECUTOR_SPAWN = _key(
+    "tony.fault.executor-spawn", "", str,
+    "Fail the backend's executor process spawn (launch-path fault).")
+FAULT_STORAGE_PUT = _key(
+    "tony.fault.storage-put", "", str,
+    "Inject a transient store error on put_file (absorbed by the shared "
+    "retry policy — the GCS 503-burst rehearsal).")
+FAULT_STORAGE_GET = _key(
+    "tony.fault.storage-get", "", str,
+    "Inject a transient store error on get_file.")
+FAULT_CHECKPOINT_SAVE = _key(
+    "tony.fault.checkpoint-save", "", str,
+    "Fail CheckpointManager.save before the write starts.")
+
 # --- portal ---------------------------------------------------------------
 PORTAL_PORT = _key(
     "tony.portal.port", 19886, int,
@@ -418,7 +475,7 @@ _JOB_KEY_RE: Pattern[str] = re.compile(
 
 _RESERVED_NON_JOB_SEGMENTS = {
     "application", "task", "coordinator", "client", "history", "tpu", "portal",
-    "keep-failed-task-dirs", "internal",
+    "keep-failed-task-dirs", "internal", "fault",
 }
 
 

@@ -152,4 +152,9 @@ TASK_KILL_GRACE_ENV = "TONY_TASK_KILL_GRACE_S"
 # Exit codes (reference common/TaskStatus semantics, TonySession.java:480-497).
 EXIT_SUCCESS = 0
 EXIT_FAILURE = 1
-EXIT_KILLED = 137  # SIGKILL'd by supervisor / liveness monitor
+EXIT_KILLED = 137     # SIGKILL'd by supervisor / liveness monitor
+# 128+SIGTERM: the exit of a task whose user process was TERM'd — the
+# preemption-notice path (executor/preemption.py TERMs the user group;
+# checkpoint/manager.install_preemption_handler exits with this after its
+# final save). Classified as the PREEMPTION failure domain.
+EXIT_PREEMPTED = 143

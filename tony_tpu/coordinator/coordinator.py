@@ -29,13 +29,13 @@ import threading
 import time
 from typing import Dict, List, Optional
 
-from tony_tpu import constants
+from tony_tpu import constants, faults
 from tony_tpu.cluster.base import Backend, TaskLaunchSpec
 from tony_tpu.conf.config import TonyTpuConfig
 from tony_tpu.conf import keys as K
 from tony_tpu.coordinator.scheduler import GangScheduler
-from tony_tpu.coordinator.session import (Session, SessionStatus, Task,
-                                          TaskStatus)
+from tony_tpu.coordinator.session import (FailureDomain, Session,
+                                          SessionStatus, Task, TaskStatus)
 from tony_tpu.events.events import Event, EventHandler, EventType
 from tony_tpu.events import history
 from tony_tpu.rpc.wire import RpcServer
@@ -117,8 +117,22 @@ class Coordinator:
         self._stop_requested = threading.Event()
         self._stop_reason = ""
         self._started_ms = int(time.time() * 1000)
+        # Per-domain retry budgets (coordinator/session.py FailureDomain):
+        # INFRA_TRANSIENT draws on retry-count; PREEMPTION draws on its
+        # own free budget first (expected churn must not exhaust the
+        # budget kept for real failures); USER_ERROR is terminal unless
+        # the reference-compat escape hatch is set.
         self._retries_total = conf.get_int(K.APPLICATION_RETRY_COUNT, 0)
+        self._preempt_retries_total = conf.get_int(
+            K.APPLICATION_PREEMPTION_RETRY_COUNT, 3)
+        self._retry_user_errors = conf.get_bool(
+            K.APPLICATION_RETRY_USER_ERRORS)
+        self._infra_retries_used = 0
+        self._preempt_retries_used = 0
         self._attempt = 0
+        # Deterministic fault injection (tony.fault.*): install for this
+        # process; _task_env forwards the same spec to every executor.
+        faults.install_from_conf(conf)
         self._last_hb: Dict[str, float] = {}
         self._hb_lock = threading.Lock()
         self._schedule_start: float = 0.0
@@ -210,6 +224,7 @@ class Coordinator:
             or str(self.conf.get(K.STORAGE_TOKEN, "") or "")
         if token:
             env[STORAGE_TOKEN_ENV] = token
+        env.update(faults.env_passthrough())
         for kv in self.conf.get_list(K.EXECUTION_ENV):
             if "=" in kv:
                 k, v = kv.split("=", 1)
@@ -242,11 +257,13 @@ class Coordinator:
             try:
                 task.handle = self.backend.launch_task(spec)
             except Exception as e:  # noqa: BLE001 — e.g. SliceProvisionError
-                # An unlaunchable gang is a session failure (subject to the
-                # normal retry budget), not a coordinator crash — the
-                # analogue of an unserviceable container request.
+                # An unlaunchable gang is an INFRA_TRANSIENT session
+                # failure (subject to the normal retry budget), not a
+                # coordinator crash — the analogue of an unserviceable
+                # container request.
                 log.error("launch of %s failed: %s", task.task_id, e)
-                self.session.fail(f"launch of {task.task_id} failed: {e}")
+                self.session.fail(f"launch of {task.task_id} failed: {e}",
+                                  FailureDomain.INFRA_TRANSIENT)
                 return
             # Each gang launch restarts the registration-timeout clock; the
             # timeout gates on launched-but-unregistered tasks (scoped like
@@ -309,20 +326,49 @@ class Coordinator:
                 self._last_hb[task_id] = time.monotonic()
         return True
 
+    def _retry_available(self, domain: Optional[FailureDomain]) -> bool:
+        """Would the run loop retry a failure of this domain right now?
+        (Pure read — the loop consumes via _consume_retry.)"""
+        infra_left = self._infra_retries_used < self._retries_total
+        if domain == FailureDomain.USER_ERROR:
+            # Terminal on first occurrence: retrying a deterministic user
+            # crash burns epochs for nothing — unless the operator opted
+            # into reference-compat undiscriminating retry.
+            return self._retry_user_errors and infra_left
+        if domain == FailureDomain.PREEMPTION:
+            # Free budget first; once exhausted, preemptions degrade to
+            # drawing on the transient budget rather than failing a job
+            # that still has retries to give.
+            return (self._preempt_retries_used
+                    < self._preempt_retries_total) or infra_left
+        return infra_left
+
+    def _consume_retry(self, domain: Optional[FailureDomain]) -> None:
+        if domain == FailureDomain.PREEMPTION and \
+                self._preempt_retries_used < self._preempt_retries_total:
+            self._preempt_retries_used += 1
+            return
+        self._infra_retries_used += 1
+
     def application_report(self) -> dict:
         status = (self.final_status if self.final_status != SessionStatus.RUNNING
                   else self.session.status)
-        retries_left = max(0, self._retries_total - self._attempt)
+        retries_left = max(0, self._retries_total - self._infra_retries_used)
+        preempt_left = max(0, self._preempt_retries_total
+                           - self._preempt_retries_used)
+        domain = self.session.failure_domain
         if (self.final_status == SessionStatus.RUNNING
                 and status in (SessionStatus.FAILED, SessionStatus.KILLED)
-                and retries_left > 0
+                and self._retry_available(domain)
                 and not self._stop_requested.is_set()):
-            # Whole-job retry window: the current epoch failed but attempts
-            # remain, so the next report may well be RUNNING again. A client
-            # that treats any terminal status as final (ours does, like
-            # ``TonyClient.java:838-892`` gates on the YARN *application*
-            # status, never transient session state) must not observe the
-            # transient FAILED here.
+            # Whole-job retry window: the current epoch failed but the
+            # failed DOMAIN still has budget, so the next report may well
+            # be RUNNING again. A client that treats any terminal status
+            # as final (ours does, like ``TonyClient.java:838-892`` gates
+            # on the YARN *application* status, never transient session
+            # state) must not observe the transient FAILED here. A
+            # USER_ERROR with retry-user-errors off is genuinely final
+            # and reports FAILED immediately — no wasted retry epochs.
             status = SessionStatus.RUNNING
         if self._stop_requested.is_set() and status == SessionStatus.FAILED:
             # Kill teardown window: session.fail(stop_reason) lands before
@@ -335,9 +381,11 @@ class Coordinator:
             "app_id": self.app_id,
             "status": status.value,
             "failure_reason": self.session.failure_reason or self._stop_reason,
+            "failure_domain": domain.value if domain else "",
             "session_id": self.session.session_id,
             "attempt": self._attempt,
             "retries_left": retries_left,
+            "preemption_retries_left": preempt_left,
             "tb_url": self.tb_url,
             "tasks": [t.to_info() for t in self.session.all_tasks()],
         }
@@ -358,11 +406,15 @@ class Coordinator:
         t = self.session.get_task(task_id)
         if t is None or t.status.terminal:
             return
-        self.session.on_task_completed(task_id, exit_code)
+        self.session.on_task_completed(
+            task_id, exit_code,
+            domain_hint=self.backend.completion_domain(task_id))
         logs = self.backend.task_log_paths(task_id)
         self.events.emit(Event(EventType.TASK_FINISHED, {
             "task": task_id, "exit_code": exit_code,
             "status": t.status.value,
+            "failure_domain": (t.failure_domain.value
+                               if t.failure_domain else ""),
             "metrics": self.metrics_store.get(task_id, {}),
             "logs": list(logs) if logs else [],
             "session_id": self.session.session_id}))
@@ -382,7 +434,7 @@ class Coordinator:
                 self.session.fail(
                     f"jobtype {t.job_name} failed with unlaunched dependent "
                     f"jobtypes; DAG cannot make progress (task {task_id} "
-                    f"exit {exit_code})")
+                    f"exit {exit_code})", t.failure_domain)
 
     def _check_heartbeats(self) -> None:
         """Liveness monitor (reference AbstractLivelinessMonitor usage
@@ -404,9 +456,26 @@ class Coordinator:
                 self.backend.kill_task(t.handle, grace_s=0.0)
             # Fail first so the recorded reason is the liveness expiry, not
             # the generic chief/worker-exit policy triggered by the kill.
+            # A wedged/vanished executor is transient infra: the retry
+            # epoch gets a fresh process on (possibly) fresh hardware.
             self.session.fail(f"task {task_id} deemed dead "
-                              f"(missed heartbeats)")
-            self.session.on_task_completed(task_id, constants.EXIT_KILLED)
+                              f"(missed heartbeats)",
+                              FailureDomain.INFRA_TRANSIENT)
+            self.session.on_task_completed(
+                task_id, constants.EXIT_KILLED,
+                domain_hint=FailureDomain.INFRA_TRANSIENT.value)
+            # The kill's eventual backend completion is a no-op (task
+            # already terminal), so THIS is the only place the task's
+            # TASK_FINISHED — with its liveness-expiry domain — can be
+            # emitted.
+            logs = self.backend.task_log_paths(task_id)
+            self.events.emit(Event(EventType.TASK_FINISHED, {
+                "task": task_id, "exit_code": constants.EXIT_KILLED,
+                "status": t.status.value,
+                "failure_domain": FailureDomain.INFRA_TRANSIENT.value,
+                "metrics": self.metrics_store.get(task_id, {}),
+                "logs": list(logs) if logs else [],
+                "session_id": self.session.session_id}))
 
     # ------------------------------------------------------------------
     # Lifecycle
@@ -431,8 +500,8 @@ class Coordinator:
             self.rpc.stop()
             raise CoordinatorCrash("TEST_COORDINATOR_CRASH requested")
 
-        retries = self._retries_total
         attempt = 0
+        retry_domain: Optional[FailureDomain] = None
         try:
             local_cmd = str(self.conf.get(K.COORDINATOR_COMMAND, "") or "")
             single_node = not self.session.tasks
@@ -450,14 +519,31 @@ class Coordinator:
                     self.session.status = SessionStatus.SUCCEEDED
                     return self.final_status
             while True:
-                self._start_session(attempt)
+                self._start_session(attempt, retry_domain)
                 status = self._monitor()
-                if status == SessionStatus.SUCCEEDED or attempt >= retries \
+                if status == SessionStatus.SUCCEEDED \
                         or self._stop_requested.is_set():
                     break
-                log.warning("session %d failed (%s); retrying (%d left)",
-                            attempt, self.session.failure_reason,
-                            retries - attempt)
+                retry_domain = (self.session.failure_domain
+                                or FailureDomain.INFRA_TRANSIENT)
+                if not self._retry_available(retry_domain):
+                    if retry_domain == FailureDomain.USER_ERROR \
+                            and not self._retry_user_errors:
+                        log.error(
+                            "session %d failed with USER_ERROR (%s) — "
+                            "terminal on first occurrence (set %s to "
+                            "retry user errors anyway)", attempt,
+                            self.session.failure_reason,
+                            K.APPLICATION_RETRY_USER_ERRORS)
+                    break
+                log.warning(
+                    "session %d failed [%s] (%s); retrying "
+                    "(transient budget %d/%d used, preemption %d/%d)",
+                    attempt, retry_domain.value,
+                    self.session.failure_reason,
+                    self._infra_retries_used, self._retries_total,
+                    self._preempt_retries_used,
+                    self._preempt_retries_total)
                 self._reset_session()
                 attempt += 1
         finally:
@@ -538,7 +624,9 @@ class Coordinator:
             "metrics": {}, "logs": [], "session_id": 0}))
         return code
 
-    def _start_session(self, attempt: int) -> None:
+    def _start_session(self, attempt: int,
+                       retried_domain: Optional[FailureDomain] = None
+                       ) -> None:
         if attempt > 0:
             # Rebuild the task matrix under a new epoch (reference
             # ``reset`` :559-575 — sessionId++ and re-request everything).
@@ -550,6 +638,12 @@ class Coordinator:
         # concurrent application_report must never see (old FAILED session,
         # new attempt) — that combination un-masks the transient FAILED.
         self._attempt = attempt
+        if attempt > 0 and retried_domain is not None:
+            # Consume the budget only AFTER the fresh RUNNING session is
+            # installed: a report between consumption and install would
+            # see (old FAILED session, exhausted budget) and un-mask the
+            # transient FAILED on the last permitted retry.
+            self._consume_retry(retried_domain)
         self.scheduler = GangScheduler(self.conf, self._launch_job)
         self._schedule_start = time.monotonic()
         self.scheduler.schedule_ready()
@@ -573,7 +667,10 @@ class Coordinator:
                 return self.session.status
             if timeout_s and (time.monotonic() - self._schedule_start
                               > timeout_s):
-                self.session.fail(f"application timed out after {timeout_s}s")
+                # The job exceeded its OWN configured wall-clock budget —
+                # a rerun would exceed it again. USER_ERROR: terminal.
+                self.session.fail(f"application timed out after {timeout_s}s",
+                                  FailureDomain.USER_ERROR)
                 return self.session.status
             if not self.session.all_registered() and reg_timeout_s and \
                     self.session.num_expected > 0 \
@@ -584,7 +681,7 @@ class Coordinator:
                 self.session.fail(
                     f"registration timeout: {self.session.num_registered}/"
                     f"{self.session.num_expected} tasks registered within "
-                    f"{reg_timeout_s}s")
+                    f"{reg_timeout_s}s", FailureDomain.INFRA_TRANSIENT)
                 return self.session.status
             for task_id, exit_code in self.backend.poll_completions():
                 self._process_completion(task_id, exit_code)
@@ -675,6 +772,8 @@ class Coordinator:
         self.events.emit(Event(EventType.APPLICATION_FINISHED, {
             "app_id": self.app_id, "status": self.final_status.value,
             "failure_reason": self.session.failure_reason or "",
+            "failure_domain": (self.session.failure_domain.value
+                               if self.session.failure_domain else ""),
         }))
         self.events.stop(history.final_name(
             self.app_id, self._started_ms, int(time.time() * 1000), self.user,

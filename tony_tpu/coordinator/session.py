@@ -44,6 +44,61 @@ class SessionStatus(str, enum.Enum):
     KILLED = "KILLED"
 
 
+class FailureDomain(str, enum.Enum):
+    """Which kind of thing broke — the axis the retry policy pivots on.
+
+    The reference burned one undiscriminating retry budget on everything
+    (``ApplicationMaster.java:356-371``); at TPU scale the three causes
+    have opposite economics: a user bug reproduces deterministically (any
+    retry is wasted epochs), transient infra deserves the bounded budget,
+    and preemption is EXPECTED churn on spot/reclaimable capacity — it
+    must not be able to exhaust the budget kept for real failures.
+    """
+
+    USER_ERROR = "USER_ERROR"            # non-retryable by default
+    INFRA_TRANSIENT = "INFRA_TRANSIENT"  # retryable, consumes retry-count
+    PREEMPTION = "PREEMPTION"            # retryable on its own free budget
+
+
+#: reduction precedence when one epoch has multiple failed tasks: the
+#: least-retryable domain decides the epoch's fate.
+_DOMAIN_SEVERITY = {FailureDomain.PREEMPTION: 0,
+                    FailureDomain.INFRA_TRANSIENT: 1,
+                    FailureDomain.USER_ERROR: 2}
+
+
+def worst_domain(a: Optional[FailureDomain],
+                 b: Optional[FailureDomain]) -> Optional[FailureDomain]:
+    if a is None:
+        return b
+    if b is None:
+        return a
+    return a if _DOMAIN_SEVERITY[a] >= _DOMAIN_SEVERITY[b] else b
+
+
+def classify_exit(exit_code: int,
+                  hint: Optional[str] = None) -> Optional[FailureDomain]:
+    """Map a task completion to its failure domain.
+
+    ``hint`` is the backend's attribution when it knows the MACHINE died
+    (``Backend.completion_domain``) — exit codes alone cannot tell a lost
+    host (137) from an OOM kill (137). Without a hint:
+    exit 0 → None (no failure); 143 (128+SIGTERM) → PREEMPTION (the
+    advance-notice save path); 137 (SIGKILL) → INFRA_TRANSIENT (liveness
+    kill / OOM / sudden death — retryable, on the accounted budget);
+    anything else → USER_ERROR (the user process chose that exit).
+    """
+    if hint:
+        return FailureDomain(hint)
+    if exit_code == 0:
+        return None
+    if exit_code == constants.EXIT_PREEMPTED:
+        return FailureDomain.PREEMPTION
+    if exit_code == constants.EXIT_KILLED:
+        return FailureDomain.INFRA_TRANSIENT
+    return FailureDomain.USER_ERROR
+
+
 @dataclasses.dataclass
 class Task:
     """One gang member (reference ``TonySession.TonyTask`` :410-551)."""
@@ -59,6 +114,7 @@ class Task:
     registered: bool = False
     tb_url: str = ""
     handle: object = None  # backend-specific process/lease handle
+    failure_domain: Optional[FailureDomain] = None
 
     @property
     def task_id(self) -> str:
@@ -75,6 +131,8 @@ class Task:
             "status": self.status.value, "url": self.tb_url,
             "host": self.host, "port": self.port,
             "exit_code": self.exit_code, "session_id": self.session_id,
+            "failure_domain": (self.failure_domain.value
+                               if self.failure_domain else ""),
         }
 
 
@@ -99,6 +157,7 @@ class Session:
                 self.tasks[t.task_id] = t
         self.status = SessionStatus.RUNNING
         self.failure_reason: Optional[str] = None
+        self.failure_domain: Optional[FailureDomain] = None
         # Jobtypes whose gang has been handed to the backend. The rendezvous
         # barrier and cluster spec cover exactly these (reference
         # ``TonySession.getNumExpectedTasks`` :193 — "scheduled at current
@@ -187,9 +246,11 @@ class Session:
                 t.status = TaskStatus.RUNNING
             return True
 
-    def on_task_completed(self, task_id: str, exit_code: int) -> None:
+    def on_task_completed(self, task_id: str, exit_code: int,
+                          domain_hint: Optional[str] = None) -> None:
         """Apply completion + failure policy (reference
-        ``TonySession.onTaskCompleted`` :251-271)."""
+        ``TonySession.onTaskCompleted`` :251-271). ``domain_hint`` is the
+        backend's failure attribution (``Backend.completion_domain``)."""
         with self._lock:
             t = self.tasks.get(task_id)
             if t is None or t.status.terminal:
@@ -201,20 +262,23 @@ class Session:
             t.status = (TaskStatus.KILLED
                         if exit_code == constants.EXIT_KILLED
                         else TaskStatus.FAILED)
+            domain = classify_exit(exit_code, domain_hint)
+            t.failure_domain = domain
+            tag = f"exit {exit_code}, {domain.value if domain else '?'}"
             if not t.tracked:
                 # Untracked (ps-style) crash is still a job failure when it
                 # dies on its own (reference ApplicationMaster.java:1212-1215).
                 self._fail(f"untracked task {task_id} crashed "
-                           f"(exit {exit_code})")
+                           f"({tag})", domain)
                 return
             if self.is_chief(t.job_name, t.index):
-                self._fail(f"chief task {task_id} failed (exit {exit_code})")
+                self._fail(f"chief task {task_id} failed ({tag})", domain)
             elif t.job_name in self.stop_on_failure:
                 self._fail(f"stop-on-failure jobtype {t.job_name}: task "
-                           f"{task_id} failed (exit {exit_code})")
+                           f"{task_id} failed ({tag})", domain)
             elif self.fail_on_worker_failure:
-                self._fail(f"task {task_id} failed (exit {exit_code}) and "
-                           f"fail-on-worker-failure is enabled")
+                self._fail(f"task {task_id} failed ({tag}) and "
+                           f"fail-on-worker-failure is enabled", domain)
 
     def mark_killed(self, task_id: str, reason: str = "") -> None:
         with self._lock:
@@ -223,14 +287,20 @@ class Session:
                 t.status = TaskStatus.KILLED
                 t.exit_code = constants.EXIT_KILLED
 
-    def _fail(self, reason: str) -> None:
+    def _fail(self, reason: str,
+              domain: Optional[FailureDomain] = None) -> None:
         if self.status == SessionStatus.RUNNING:
             self.status = SessionStatus.FAILED
             self.failure_reason = reason
+        # Even when a reason already landed, keep the WORST domain seen:
+        # a preempted host plus a user crash in the same epoch must not
+        # retry for free.
+        self.failure_domain = worst_domain(self.failure_domain, domain)
 
-    def fail(self, reason: str) -> None:
+    def fail(self, reason: str,
+             domain: Optional[FailureDomain] = None) -> None:
         with self._lock:
-            self._fail(reason)
+            self._fail(reason, domain)
 
     # -- reduction --------------------------------------------------------
     def update_status(self) -> SessionStatus:
@@ -244,9 +314,13 @@ class Session:
                 failed = [t for t in tracked
                           if t.status in (TaskStatus.FAILED, TaskStatus.KILLED)]
                 if failed:
+                    domain = None
+                    for t in failed:
+                        domain = worst_domain(domain, t.failure_domain)
                     self._fail(
                         f"{len(failed)} tracked task(s) failed: "
-                        + ", ".join(t.task_id for t in failed[:5]))
+                        + ", ".join(t.task_id for t in failed[:5]),
+                        domain)
                 else:
                     self.status = SessionStatus.SUCCEEDED
             return self.status

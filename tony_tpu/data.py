@@ -104,14 +104,17 @@ class ShardedBatchIterator:
         return jax.tree.map(to_global, local, self.shardings)
 
     def _worker_loop(self) -> None:
-        # Snapshot this generation's queue/event: a worker that outlives a
-        # close()+restart (join timeout) must keep talking to ITS queue,
-        # never the successor's.
-        stop, q = self._stop_evt, self._q
+        # Snapshot this generation's queue/event/step: a worker that
+        # outlives a close()+restart (join timeout) must keep talking to
+        # ITS queue, never the successor's — and must not mutate the shared
+        # step counter either: a late `self._step += 1` from an abandoned
+        # worker would make the restarted one silently skip a batch
+        # (ADVICE r5).
+        stop, q, step = self._stop_evt, self._q, self._step
         while not stop.is_set():
             try:
-                item = self._assemble(self._step)
-                self._step += 1
+                item = self._assemble(step)
+                step += 1
             except BaseException as e:  # noqa: BLE001 — surface on get()
                 item = _PrefetchError(e)
             while not stop.is_set():

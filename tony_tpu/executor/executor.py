@@ -82,11 +82,18 @@ class Heartbeater(threading.Thread):
         self._skip = int(os.environ.get(constants.TEST_NUM_HB_MISS, "0") or 0)
 
     def run(self) -> None:
+        from tony_tpu import faults
+
         while not self._stop_evt.wait(self._interval_s):
             if self._skip > 0:
                 self._skip -= 1
                 log.warning("TEST hook: skipping heartbeat (%d more)",
                             self._skip)
+                continue
+            if faults.fire("heartbeat"):
+                # Injected stall: the beat is silently dropped, exactly
+                # as if the executor were wedged — the coordinator's
+                # liveness monitor is what must notice.
                 continue
             try:
                 self._client.call("task_executor_heartbeat",
@@ -434,6 +441,12 @@ def main() -> int:
     logging.basicConfig(
         level=logging.INFO,
         format="%(asctime)s %(levelname)s %(name)s: %(message)s")
+    # BEFORE anything talks to the network: the injected faults may target
+    # the very RPC/storage calls that bootstrap this executor (fetching
+    # the frozen config, registration) — env, not conf, carries the spec.
+    from tony_tpu import faults
+
+    faults.install_from_env()
     signal.signal(signal.SIGTERM, _forward_signal)
     signal.signal(signal.SIGINT, _forward_signal)
     executor = TaskExecutor()

@@ -1,0 +1,281 @@
+"""Deterministic fault-injection harness: rehearse infra failure on purpose.
+
+The reference proved its robustness story with env-hook faults compiled
+into production code (``Constants.java:116-121``: AM crash, worker
+termination, heartbeat misses, completion delay) — deterministic enough
+to drive an E2E matrix (``TestTonyE2E.java``). This module generalizes
+that idea into one conf-driven, seeded subsystem with injection sites
+threaded through every layer that talks to unreliable infrastructure:
+
+========================  =====================================================
+site                      where it fires
+========================  =====================================================
+``rpc.connect``           RpcClient._connect, before the TCP connect
+``rpc.send``              RpcClient.call, before a request frame is sent
+``heartbeat``             Heartbeater loop (a firing skips that heartbeat)
+``executor.spawn``        backend launch_task, before the process spawn
+``storage.put``           Store.put_file via the retrying wrapper
+``storage.get``           Store.get_file via the retrying wrapper
+``checkpoint.save``       CheckpointManager.save, before the orbax call
+========================  =====================================================
+
+Spec grammar (the value of ``tony.fault.<site>`` conf keys, or one
+``;``-separated assignment list in the ``TONY_FAULTS`` env var):
+
+- ``first:N``   — fire on the first N calls of the site (per process)
+- ``at:K``      — fire on call K only (1-based)
+- ``every:N``   — fire on every Nth call
+- ``p:X``       — fire with probability X, from a per-site RNG seeded
+  with (seed, site) — the sequence of decisions is identical for a given
+  seed, machine-independent
+- ``session:S`` — additional filter: only fire when this process's
+  ``TONY_SESSION_ID`` is S (lets a fault hit epoch 0 and spare the retry)
+
+Tokens combine with ``,``: ``p:0.5,session:0``. Example conf:
+
+    tony.fault.seed = 7
+    tony.fault.rpc-send = first:2
+    tony.fault.storage-get = p:0.3,session:0
+
+Plumbing: the coordinator installs from its conf and forwards the same
+spec to every executor via the ``TONY_FAULTS`` env var (executors must be
+able to inject into the storage fetch of the very config that carries the
+keys); the client installs from conf at submit for its staging I/O.
+
+Zero overhead when disabled: ``fire(site)`` is a module-global None check
+— no dict lookups, no RNG, nothing to configure away in production.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import random
+import threading
+from typing import Dict, List, Optional, Tuple
+
+log = logging.getLogger(__name__)
+
+#: env var carrying the serialized spec into executor/user processes
+FAULTS_ENV = "TONY_FAULTS"
+
+#: the canonical site names (kept in lockstep with the conf keys in
+#: tony_tpu/conf/keys.py: ``tony.fault.<site with . -> ->``)
+SITES = ("rpc.connect", "rpc.send", "heartbeat", "executor.spawn",
+         "storage.put", "storage.get", "checkpoint.save")
+
+
+class InjectedFault(ConnectionError):
+    """Raised by injection sites that simulate transport/IO failure.
+
+    Subclasses ConnectionError (hence OSError) on purpose: the production
+    retry paths — RPC reconnect, storage transfer retry — must treat an
+    injected fault EXACTLY like a real reset, with no fault-harness
+    special-casing in the code under test.
+    """
+
+    def __init__(self, site: str, call_no: int):
+        super().__init__(f"injected fault at {site} (call #{call_no})")
+        self.site = site
+        self.call_no = call_no
+
+
+class _SiteRule:
+    """Parsed spec + deterministic per-site decision state."""
+
+    def __init__(self, site: str, spec: str, seed: int):
+        self.site = site
+        self.spec = spec
+        self.first = 0
+        self.at = 0
+        self.every = 0
+        self.p = 0.0
+        self.session: Optional[int] = None
+        for token in spec.split(","):
+            token = token.strip()
+            if not token:
+                continue
+            key, sep, value = token.replace("=", ":").partition(":")
+            if not sep:
+                raise ValueError(
+                    f"fault spec token {token!r} for {site!r} needs "
+                    f"key:value (one of first/at/every/p/session)")
+            key = key.strip().lower()
+            value = value.strip()
+            try:
+                if key == "first":
+                    self.first = int(value)
+                elif key == "at":
+                    self.at = int(value)
+                elif key == "every":
+                    self.every = int(value)
+                elif key == "p":
+                    self.p = float(value)
+                elif key == "session":
+                    self.session = int(value)
+                else:
+                    raise ValueError(f"unknown fault spec key {key!r}")
+            except ValueError as e:
+                raise ValueError(
+                    f"bad fault spec {spec!r} for {site!r}: {e}") from e
+        # Per-site RNG seeded by (seed, site): decision sequences are
+        # reproducible and independent across sites.
+        self._rng = random.Random(f"{seed}:{site}")
+        self._calls = 0
+        self._lock = threading.Lock()
+
+    def decide(self) -> Tuple[bool, int]:
+        """(fire?, call number) — one deterministic decision per call."""
+        with self._lock:
+            self._calls += 1
+            n = self._calls
+            # Draw EVERY call so the p-sequence depends only on the call
+            # index, not on which other tokens matched before it.
+            draw = self._rng.random()
+        if self.session is not None:
+            env_session = int(os.environ.get("TONY_SESSION_ID", "0") or 0)
+            if env_session != self.session:
+                return False, n
+        if self.first and n <= self.first:
+            return True, n
+        if self.at and n == self.at:
+            return True, n
+        if self.every and n % self.every == 0:
+            return True, n
+        if self.p and draw < self.p:
+            return True, n
+        return False, n
+
+
+class FaultInjector:
+    def __init__(self, rules: Dict[str, str], seed: int = 0):
+        unknown = set(rules) - set(SITES)
+        if unknown:
+            raise ValueError(
+                f"unknown fault site(s) {sorted(unknown)}; known: "
+                f"{list(SITES)}")
+        self.seed = seed
+        self.rules = {site: _SiteRule(site, spec, seed)
+                      for site, spec in rules.items() if spec}
+
+    def fire(self, site: str) -> bool:
+        rule = self.rules.get(site)
+        if rule is None:
+            return False
+        fired, call_no = rule.decide()
+        if fired:
+            log.warning("FAULT INJECTED at %s (call #%d, spec %r)",
+                        site, call_no, rule.spec)
+        return fired
+
+    def check(self, site: str) -> None:
+        """Raise InjectedFault when the site fires (transport-style sites)."""
+        rule = self.rules.get(site)
+        if rule is None:
+            return
+        fired, call_no = rule.decide()
+        if fired:
+            log.warning("FAULT INJECTED at %s (call #%d, spec %r)",
+                        site, call_no, rule.spec)
+            raise InjectedFault(site, call_no)
+
+    def to_env_value(self) -> str:
+        """Serialize for the TONY_FAULTS env passthrough."""
+        parts = [f"seed={self.seed}"]
+        parts += [f"{site}={rule.spec}"
+                  for site, rule in sorted(self.rules.items())]
+        return ";".join(parts)
+
+
+#: THE hot-path switch. None = disabled = zero overhead beyond one global
+#: read; production code never pays for the harness it isn't using.
+_active: Optional[FaultInjector] = None
+
+
+def active() -> Optional[FaultInjector]:
+    return _active
+
+
+def fire(site: str) -> bool:
+    """Did the site fire? (bool-style sites: heartbeat skip)."""
+    inj = _active
+    return inj is not None and inj.fire(site)
+
+
+def check(site: str) -> None:
+    """Raise InjectedFault if the site fires (exception-style sites)."""
+    inj = _active
+    if inj is not None:
+        inj.check(site)
+
+
+def install(injector: Optional[FaultInjector]) -> None:
+    global _active
+    _active = injector
+    if injector is not None and injector.rules:
+        from tony_tpu import retry as _retry
+
+        # Seeded faults deserve seeded backoff jitter: the full schedule
+        # of a rehearsed failure is then reproducible end to end.
+        _retry.seed_default_rng(injector.seed)
+        log.warning("fault injection ACTIVE: %s",
+                    injector.to_env_value())
+
+
+def uninstall() -> None:
+    install(None)
+
+
+def parse_spec(spec: str, default_seed: int = 0) -> "FaultInjector":
+    """Parse the serialized ``site=spec;site=spec;seed=N`` form."""
+    rules: Dict[str, str] = {}
+    seed = default_seed
+    for part in spec.split(";"):
+        part = part.strip()
+        if not part:
+            continue
+        key, sep, value = part.partition("=")
+        if not sep:
+            raise ValueError(f"bad TONY_FAULTS entry {part!r} "
+                             f"(need site=spec)")
+        key = key.strip()
+        if key == "seed":
+            seed = int(value)
+        else:
+            rules[key] = value.strip()
+    return FaultInjector(rules, seed=seed)
+
+
+def install_from_env() -> bool:
+    """Executor/user-process path: TONY_FAULTS beats everything (it must —
+    the faults may target the storage fetch of the config itself)."""
+    spec = os.environ.get(FAULTS_ENV, "")
+    if not spec:
+        return False
+    install(parse_spec(spec))
+    return True
+
+
+def install_from_conf(conf) -> bool:
+    """Coordinator/client path: read ``tony.fault.*`` keys. Returns True
+    iff any site is configured (callers then export TONY_FAULTS)."""
+    from tony_tpu.conf import keys as K
+
+    rules: Dict[str, str] = {}
+    for site in SITES:
+        spec = str(conf.get(K.fault_key(site), "") or "")
+        if spec:
+            rules[site] = spec
+    if not rules:
+        return False
+    install(FaultInjector(rules, seed=conf.get_int(K.FAULT_SEED, 0)))
+    return True
+
+
+def env_passthrough() -> Dict[str, str]:
+    """Env vars a supervisor exports so child processes inherit the active
+    injection config (empty when disabled)."""
+    inj = _active
+    if inj is None or not inj.rules:
+        return {}
+    return {FAULTS_ENV: inj.to_env_value()}

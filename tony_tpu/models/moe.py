@@ -35,6 +35,7 @@ import flax.linen as nn
 import jax
 import jax.numpy as jnp
 
+from tony_tpu import compat
 from tony_tpu.models.transformer import (Attention, RMSNorm,
                                          TransformerConfig)
 
@@ -135,8 +136,7 @@ class MoEMLP(nn.Module):
         w_up = w("up", (e, d, cfg.mlp_dim), ("expert", "embed", "mlp"))
         w_down = w("down", (e, cfg.mlp_dim, d), ("expert", "mlp", "embed"))
 
-        mesh = jax.sharding.get_abstract_mesh()
-        n_ep = mesh.shape.get(EP_AXIS, 1) if mesh.axis_types else 1
+        n_ep = compat.mesh_axis_size(EP_AXIS)
         if n_ep > 1:
             from jax.sharding import PartitionSpec as P
 
@@ -144,9 +144,9 @@ class MoEMLP(nn.Module):
                 raise ValueError(
                     f"tokens ({t}) and experts ({e}) must divide the ep "
                     f"axis ({n_ep})")
-            out = jax.shard_map(
+            out = compat.partial_shard_map(
                 functools.partial(_routed_ffn_group, cfg, n_ep=n_ep),
-                axis_names={EP_AXIS},
+                EP_AXIS,
                 in_specs=(P(EP_AXIS), P(EP_AXIS), P(EP_AXIS), P(EP_AXIS),
                           P(EP_AXIS)),
                 out_specs=P(EP_AXIS),
@@ -261,7 +261,7 @@ def dryrun_ep_step(devices, ep: int) -> float:
     # set_mesh binds the abstract mesh MoEMLP reads to pick the ep path;
     # without it n_ep resolves to 1 and the dry run would only validate the
     # replicated fallback (advisor finding, round 2).
-    with jax.set_mesh(mesh):
+    with compat.set_mesh(mesh):
         compiled = jax.jit(step).lower(state).compile()
         hlo = compiled.as_text()
         assert "all-to-all" in hlo, \

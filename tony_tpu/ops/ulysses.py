@@ -16,8 +16,8 @@ from typing import Optional
 
 import jax
 from jax.sharding import Mesh, PartitionSpec as P
-from jax import shard_map
 
+from tony_tpu.compat import shard_map
 from tony_tpu.ops.attention import DEFAULT_BLOCK, flash_attention
 
 

@@ -56,9 +56,10 @@ from typing import Any, Dict, Optional
 import flax.linen as nn
 import jax
 import jax.numpy as jnp
-from jax import lax, shard_map
+from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from tony_tpu.compat import shard_map
 from tony_tpu.models.transformer import (Block, TransformerConfig,
                                          causal_lm_loss)
 

@@ -18,6 +18,7 @@ import optax
 from flax import struct
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from tony_tpu import compat
 from tony_tpu.parallel.mesh import batch_sharding
 from tony_tpu.parallel.sharding import DEFAULT_RULES, param_shardings
 
@@ -71,7 +72,7 @@ def init_sharded_state(
     def init_fn(rng):
         return nn.meta.unbox(boxed_init(rng))
 
-    with jax.set_mesh(mesh), nn.logical_axis_rules(list(rules)):
+    with compat.set_mesh(mesh), nn.logical_axis_rules(list(rules)):
         state = jax.jit(init_fn, out_shardings=state_sh)(rng)
     return state, state_sh
 
@@ -112,7 +113,7 @@ def jit_train_step(
         donate_argnums=(0,) if donate else ())
 
     def wrapped(state, batch, rng):
-        with jax.set_mesh(mesh):
+        with compat.set_mesh(mesh):
             return jitted(state, batch, rng)
 
     return wrapped

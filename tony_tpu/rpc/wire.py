@@ -56,6 +56,9 @@ from typing import Any, Dict, Optional, Tuple
 
 import msgpack
 
+from tony_tpu import faults
+from tony_tpu.retry import RetryPolicy
+
 log = logging.getLogger(__name__)
 
 
@@ -298,9 +301,14 @@ class RpcServer:
 class RpcClient:
     """Persistent-connection client with bounded reconnect retries.
 
-    Reference retry policy: up to 10 attempts, 2 s fixed sleep
-    (``ApplicationRpcClient.java:66-76``); configurable here because tests
-    want fast failure.
+    Reference retry policy: up to 10 attempts, 2 s FIXED sleep
+    (``ApplicationRpcClient.java:66-76``) — which synchronizes a whole
+    gang's reconnect storms onto the coordinator at the exact moment it
+    is least able to serve them. Here the budget is the same shape
+    (``max_retries`` attempts; ``retry_sleep_s`` caps any one sleep) but
+    delays ramp exponentially with full jitter (tony_tpu/retry.py), so N
+    executors retrying the same outage spread over the window instead of
+    arriving in lockstep. Tests keep fast failure via small values.
     """
 
     def __init__(self, host: str, port: int, token: Optional[str] = None,
@@ -312,6 +320,10 @@ class RpcClient:
         self._tls = tls
         self._max_retries = max_retries
         self._retry_sleep_s = retry_sleep_s
+        self._retry_policy = RetryPolicy(
+            max_attempts=max(1, max_retries),
+            base_delay_s=max(retry_sleep_s / 4.0, 0.001),
+            max_delay_s=max(retry_sleep_s, 0.001))
         self._connect_timeout_s = connect_timeout_s
         self._sock: Optional[socket.socket] = None
         self._nonce: bytes = b""
@@ -321,6 +333,7 @@ class RpcClient:
         self._lock = threading.Lock()
 
     def _connect(self) -> socket.socket:
+        faults.check("rpc.connect")
         sock = socket.create_connection(self._addr,
                                         timeout=self._connect_timeout_s)
         sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
@@ -370,6 +383,10 @@ class RpcClient:
                 try:
                     if self._sock is None:
                         self._sock = self._connect()
+                    # A dropped frame surfaces as a connection error and
+                    # rides the same reconnect+backoff path a real reset
+                    # takes (tony_tpu/faults.py site table).
+                    faults.check("rpc.send")
                     self._id += 1
                     req = {"id": self._id, "method": method, "args": args}
                     extra = {"cn": self._client_nonce} \
@@ -403,7 +420,7 @@ class RpcClient:
                     last_err = e
                     self._close_locked()
                     if attempt < self._max_retries - 1:
-                        time.sleep(self._retry_sleep_s)
+                        time.sleep(self._retry_policy.delay_s(attempt))
         raise RpcError(
             f"rpc {method} to {self._addr} failed after "
             f"{self._max_retries} attempts: {last_err}")

@@ -51,6 +51,15 @@ class JaxRuntime(Runtime):
                 not in os.environ:
             env[constants.JAX_COMPILATION_CACHE_DIR] = \
                 os.path.expanduser(cache_dir)
+        if len(flat) > 1 and os.environ.get(
+                "JAX_PLATFORMS", "").strip().lower() == "cpu":
+            # Multi-process CPU gangs (the virtual-mesh test substrate)
+            # need an explicit cross-process collectives backend on jax
+            # versions where the CPU default is "none" — without it every
+            # sharded jit fails with "Multiprocess computations aren't
+            # implemented on the CPU backend". Harmless where gloo is
+            # already the default; user env wins.
+            env.setdefault("JAX_CPU_COLLECTIVES_IMPLEMENTATION", "gloo")
         return env
 
 

@@ -53,6 +53,8 @@ from urllib import error as urlerror
 from urllib import request as urlrequest
 from urllib.parse import quote, unquote, urlparse
 
+from tony_tpu import faults
+from tony_tpu.retry import RetryPolicy, call_with_retry
 from tony_tpu.utils.gcp import GcpBearer
 
 STORAGE_TOKEN_ENV = "TONY_STORAGE_TOKEN"
@@ -74,16 +76,25 @@ def credential_from_env() -> Optional[str]:
 
 
 def get_store(url: str, credential: Optional[str] = None) -> "Store":
-    """Factory: dispatch on scheme (see module docstring)."""
+    """Factory: dispatch on scheme (see module docstring). With fault
+    injection active (tony_tpu/faults.py), the store is wrapped so the
+    ``storage.put``/``storage.get`` sites fire and injected transients are
+    absorbed by the shared retry policy — exactly the path a real GCS
+    503 burst takes through GcsStore's own bounded retry."""
     scheme = urlparse(url).scheme if is_url(url) else ""
     if scheme in ("", "file"):
-        return LocalFsStore()
-    if scheme == "gs":
+        store: Store = LocalFsStore()
+    elif scheme == "gs":
         cred = credential or credential_from_env()
         if os.environ.get(FAKE_GCS_ROOT_ENV):
-            return FakeGcsStore(credential=cred)
-        return GcsStore(credential=cred)
-    raise ValueError(f"no store for scheme {scheme!r} (url {url!r})")
+            store = FakeGcsStore(credential=cred)
+        else:
+            store = GcsStore(credential=cred)
+    else:
+        raise ValueError(f"no store for scheme {scheme!r} (url {url!r})")
+    if faults.active() is not None:
+        return RetryingStore(store)
+    return store
 
 
 class Store(abc.ABC):
@@ -135,6 +146,63 @@ class Store(abc.ABC):
                 raise ValueError(
                     f"object key {rel!r} escapes destination {local_dir!r}")
             self.get_file(full, dest)
+
+
+#: transfer-level retry for injected/transient faults above any store
+#: implementation (the GcsStore additionally retries at the HTTP layer)
+STORE_RETRY = RetryPolicy(max_attempts=5, base_delay_s=0.2, max_delay_s=5.0)
+
+
+class RetryingStore(Store):
+    """Fault-site + retry wrapper over any Store (installed by
+    ``get_store`` when fault injection is active).
+
+    ``storage.put``/``storage.get`` injections surface here as
+    ConnectionError and are absorbed by the shared full-jitter policy;
+    real transient transport errors from the inner store ride the same
+    path. Genuinely terminal errors (missing object, rejected credential,
+    malformed URL) propagate immediately. ``put_tree``/``get_tree`` are
+    the base-class per-file loops, so every file of a tree transfer gets
+    the same protection."""
+
+    def __init__(self, inner: Store, policy: RetryPolicy = STORE_RETRY):
+        self.inner = inner
+        self.policy = policy
+
+    def _retrying(self, what: str, fn):
+        return call_with_retry(
+            fn, self.policy,
+            retry_on=(OSError, HTTPException),
+            give_up_on=(FileNotFoundError, StoreAuthError, ValueError),
+            what=what)
+
+    def put_file(self, local_path: str, url: str) -> None:
+        def attempt():
+            faults.check("storage.put")
+            self.inner.put_file(local_path, url)
+        self._retrying(f"put {url}", attempt)
+
+    def get_file(self, url: str, local_path: str) -> None:
+        def attempt():
+            faults.check("storage.get")
+            self.inner.get_file(url, local_path)
+        self._retrying(f"get {url}", attempt)
+
+    def exists(self, url: str) -> bool:
+        return self.inner.exists(url)
+
+    def isdir(self, url: str) -> bool:
+        return self.inner.isdir(url)
+
+    def list(self, url: str) -> List[str]:
+        return self.inner.list(url)
+
+    def _keys_under(self, url: str):
+        return self.inner._keys_under(url)
+
+    def __getattr__(self, name: str):
+        # Store-specific extras (LocalFsStore.open, endpoints, ...)
+        return getattr(self.inner, name)
 
 
 class LocalFsStore(Store):
@@ -246,6 +314,12 @@ class GcsStore(Store):
         self._auth = GcpBearer(credential)
         self.retries = retries
         self.backoff_s = backoff_s
+        # Exponential backoff with FULL JITTER (tony_tpu/retry.py): a
+        # whole gang hitting the same 429/503 burst must de-correlate its
+        # retries, not re-synchronize on a fixed doubling schedule.
+        self._policy = RetryPolicy(max_attempts=retries + 1,
+                                   base_delay_s=backoff_s,
+                                   max_delay_s=max(backoff_s * 8, 30.0))
 
     # -- auth ----------------------------------------------------------
     def _bearer(self) -> Optional[str]:
@@ -268,7 +342,6 @@ class GcsStore(Store):
         With ``stream_to`` the body is copied straight to that path instead
         of buffered (multi-GB bundle/checkpoint downloads must not live in
         memory)."""
-        delay = self.backoff_s
         refreshed_auth = False
         attempt = 0
         # `attempt` counts RETRYABLE failures only; the single-shot auth
@@ -319,9 +392,8 @@ class GcsStore(Store):
             if attempt >= self.retries:
                 raise IOError(f"GCS {method} {url} failed after "
                               f"{self.retries + 1} attempts: {last}")
+            time.sleep(self._policy.delay_s(attempt))
             attempt += 1
-            time.sleep(delay)
-            delay *= 2
 
     def _obj_url(self, bucket: str, key: str, media: bool = False) -> str:
         if not key:
