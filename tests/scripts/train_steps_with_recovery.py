@@ -1,0 +1,35 @@
+"""Fixed-step training stand-in for the coordinator crash-recovery e2e.
+
+Runs TONY_TEST_TOTAL_STEPS deterministic "steps" (sleep + arithmetic),
+appending each step number to TONY_TEST_STEP_FILE as it completes, then
+writes "<steps> <loss>" to TONY_TEST_RESULT. The loss is a pure function
+of the step count, so an interrupted-coordinator run and an uninterrupted
+run are bit-identical iff the USER PROCESS was never disturbed — which is
+exactly the recovery contract under test (the coordinator dies and comes
+back; training never notices).
+"""
+
+import os
+import sys
+import time
+
+
+def main() -> int:
+    total = int(os.environ.get("TONY_TEST_TOTAL_STEPS", "30"))
+    dt = float(os.environ.get("TONY_TEST_STEP_SECONDS", "0.25"))
+    idx = os.environ.get("TASK_INDEX", "0")      # per-task files in a gang
+    step_file = os.environ["TONY_TEST_STEP_FILE"] + "." + idx
+    result_file = os.environ["TONY_TEST_RESULT"] + "." + idx
+    loss = 100.0
+    for step in range(1, total + 1):
+        time.sleep(dt)
+        loss = loss / (1.0 + 0.1 * step)      # deterministic decay
+        with open(step_file, "a") as f:
+            f.write(f"{step}\n")
+    with open(result_file, "w") as f:
+        f.write(f"{total} {loss:.12g}\n")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

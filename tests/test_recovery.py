@@ -1,0 +1,555 @@
+"""Fast deterministic unit suite for coordinator crash recovery: the
+write-ahead session journal (tony_tpu/coordinator/journal.py), generation
+fencing + per-call timeouts in the RPC wire (tony_tpu/rpc/wire.py), the
+executor's coordinator-loss/orphan state machine, and the two new fault
+sites. Select with ``pytest -m faults``.
+"""
+
+import json
+import os
+import socket
+import threading
+import time
+
+import pytest
+
+from tony_tpu import faults
+from tony_tpu.conf.config import TonyTpuConfig
+from tony_tpu.conf import keys as K
+from tony_tpu.coordinator import journal
+from tony_tpu.rpc.wire import (FencedError, RpcClient, RpcError, RpcServer,
+                               RpcTimeout, StaleGenerationError)
+
+pytestmark = pytest.mark.faults
+
+
+@pytest.fixture(autouse=True)
+def _clean_injector():
+    faults.uninstall()
+    yield
+    faults.uninstall()
+
+
+# ---------------------------------------------------------------------------
+# Journal: append + replay
+# ---------------------------------------------------------------------------
+def _journal(tmp_path):
+    return journal.SessionJournal(str(tmp_path / "j.jsonl"))
+
+
+def test_journal_roundtrip_folds_current_epoch_state(tmp_path):
+    j = _journal(tmp_path)
+    j.generation(1)
+    j.app("app_1", 1234, "alice")
+    j.epoch(0, 0, 0)
+    j.job_scheduled("worker", 0)
+    j.task("worker:0", "SCHEDULED", 0)
+    j.register("worker:0", "hostA", 4242, 0)
+    j.task("worker:1", "SCHEDULED", 0)
+    j.task("worker:1", "FAILED", 0, exit_code=1, domain="USER_ERROR")
+    j.close()
+    st = journal.replay(j.path)
+    assert st.generation == 1
+    assert (st.app_id, st.started_ms, st.user) == ("app_1", 1234, "alice")
+    assert st.session_id == 0
+    assert st.scheduled_jobs == {"worker"}
+    t0 = st.tasks["worker:0"]
+    assert (t0.status, t0.host, t0.port, t0.registered) \
+        == ("RUNNING", "hostA", 4242, True)
+    t1 = st.tasks["worker:1"]
+    assert (t1.status, t1.exit_code, t1.domain) == ("FAILED", 1, "USER_ERROR")
+
+
+def test_journal_replay_missing_file_is_a_clear_error(tmp_path):
+    with pytest.raises(journal.JournalError):
+        journal.replay(str(tmp_path / "nope.jsonl"))
+
+
+def test_journal_replay_empty_file(tmp_path):
+    p = tmp_path / "empty.jsonl"
+    p.write_bytes(b"")
+    st = journal.replay(str(p))
+    assert st.records == 0 and st.generation == 0 and not st.torn_tail
+
+
+def test_journal_torn_last_record_degrades_to_prefix(tmp_path):
+    j = _journal(tmp_path)
+    j.generation(3)
+    j.epoch(1, 1, 0)
+    j.register("worker:0", "h", 1, 1)
+    j.close()
+    # Simulate the crash window: a record written but cut mid-JSON.
+    with open(j.path, "ab") as f:
+        f.write(b'{"t": "task", "task": "worker:0", "sta')
+    st = journal.replay(j.path)
+    assert st.torn_tail
+    assert st.records == 3
+    assert st.session_id == 1 and st.infra_retries_used == 1
+    assert st.tasks["worker:0"].registered
+
+
+def test_journal_torn_complete_line_garbage_also_prefix(tmp_path):
+    j = _journal(tmp_path)
+    j.generation(1)
+    j.epoch(0, 0, 0)
+    j.close()
+    with open(j.path, "ab") as f:
+        f.write(b"\x00\xff not json at all\n")
+    st = journal.replay(j.path)
+    assert st.torn_tail and st.records == 2 and st.generation == 1
+
+
+def test_journal_replay_to_epoch_n_supersedes_earlier_epochs(tmp_path):
+    """An epoch record is a state barrier: epoch-0 registrations and
+    completions must not leak into the epoch-1 task matrix, but the
+    budget counters carried on the record must."""
+    j = _journal(tmp_path)
+    j.generation(1)
+    j.epoch(0, 0, 0)
+    j.job_scheduled("worker", 0)
+    j.register("worker:0", "old-host", 1111, 0)
+    j.task("worker:0", "FAILED", 0, exit_code=1, domain="INFRA_TRANSIENT")
+    j.epoch(1, 1, 0)
+    j.job_scheduled("worker", 1)
+    j.register("worker:0", "new-host", 2222, 1)
+    # Stale records from slow epoch-0 reporters arriving after the reset:
+    j.task("worker:0", "KILLED", 0, exit_code=137)
+    j.close()
+    st = journal.replay(j.path)
+    assert st.session_id == 1
+    assert st.infra_retries_used == 1
+    t = st.tasks["worker:0"]
+    assert (t.status, t.host, t.port) == ("RUNNING", "new-host", 2222)
+
+
+# ---------------------------------------------------------------------------
+# Wire: generation fencing
+# ---------------------------------------------------------------------------
+class _Svc:
+    def __init__(self):
+        self.calls = 0
+
+    def ping(self):
+        self.calls += 1
+        return "pong"
+
+    def fenced(self):
+        raise FencedError("stale session epoch 0; coordinator is at 1")
+
+
+def _server(generation=0, on_superseded=None, svc=None):
+    srv = RpcServer(svc or _Svc(), generation=generation,
+                    on_superseded=on_superseded)
+    srv.start()
+    return srv
+
+
+def test_stale_client_generation_is_rejected_terminally():
+    """Acceptance: an executor holding a NEWER generation token than the
+    server (i.e. the server is a pre-recovery zombie) gets a terminal
+    StaleGenerationError from the hello — no retries are burned."""
+    srv = _server(generation=2)
+    try:
+        host, port = srv.address
+        c = RpcClient(host, port, generation=3, max_retries=5,
+                      retry_sleep_s=0.01)
+        t0 = time.monotonic()
+        with pytest.raises(StaleGenerationError):
+            c.call("ping")
+        assert time.monotonic() - t0 < 1.0, \
+            "fencing must not ride the retry/backoff path"
+        c.close()
+    finally:
+        srv.stop()
+
+
+def test_client_adopts_newer_server_generation():
+    srv = _server(generation=5)
+    try:
+        host, port = srv.address
+        c = RpcClient(host, port, generation=1)
+        assert c.call("ping") == "pong"
+        assert c.generation == 5, "client must adopt the successor's gen"
+        c.close()
+    finally:
+        srv.stop()
+
+
+def test_server_rejects_stale_request_generation_raw_frame():
+    """Server-side fence, exercised at the wire level: a frame stamped
+    with an older generation than the server's must be refused before
+    dispatch (the request never reaches the service)."""
+    import msgpack
+
+    from tony_tpu.rpc import wire
+
+    svc = _Svc()
+    srv = _server(generation=4, svc=svc)
+    try:
+        host, port = srv.address
+        s = socket.create_connection((host, port), timeout=5)
+        s.settimeout(5)
+        hello = wire._recv_frame(s)
+        assert hello["g"] == 4
+        wire._send_frame(
+            s, {"p": msgpack.packb(
+                {"id": 1, "method": "ping", "args": {}, "gen": 2},
+                use_bin_type=True)})
+        resp = wire._recv_frame(s)
+        inner = msgpack.unpackb(resp["p"], raw=False)
+        assert not inner["ok"]
+        assert inner["error"].startswith("StaleGenerationError")
+        assert svc.calls == 0, "fenced frame must not reach the service"
+        s.close()
+    finally:
+        srv.stop()
+
+
+def test_client_side_fence_beats_server_dispatch():
+    """A client holding a NEWER generation never even sends a frame to
+    the zombie server — the hello (g=2 < 7) fences client-side."""
+    svc = _Svc()
+    srv = _server(generation=2, svc=svc)
+    try:
+        host, port = srv.address
+        c = RpcClient(host, port, generation=7, max_retries=1)
+        with pytest.raises(StaleGenerationError):
+            c.call("ping")
+        c.close()
+    finally:
+        srv.stop()
+    assert svc.calls == 0
+
+
+def test_server_superseded_callback_via_raw_frame():
+    from tony_tpu.rpc import wire
+    import msgpack
+
+    seen = []
+    srv = _server(generation=2, on_superseded=seen.append)
+    try:
+        host, port = srv.address
+        s = socket.create_connection((host, port), timeout=5)
+        s.settimeout(5)
+        wire._recv_frame(s)      # hello
+        wire._send_frame(s, {"p": msgpack.packb(
+            {"id": 1, "method": "ping", "args": {}, "gen": 9},
+            use_bin_type=True)})
+        resp = msgpack.unpackb(wire._recv_frame(s)["p"], raw=False)
+        assert resp["error"].startswith("StaleGenerationError")
+        assert seen == [9], "server must learn it was superseded"
+        s.close()
+    finally:
+        srv.stop()
+
+
+def test_fenced_error_from_service_is_terminal_not_retried():
+    svc = _Svc()
+    srv = _server(svc=svc)
+    try:
+        host, port = srv.address
+        c = RpcClient(host, port, max_retries=5, retry_sleep_s=0.01)
+        t0 = time.monotonic()
+        with pytest.raises(FencedError):
+            c.call("fenced")
+        assert time.monotonic() - t0 < 1.0
+        c.close()
+    finally:
+        srv.stop()
+
+
+# ---------------------------------------------------------------------------
+# Wire: per-call timeouts (the wedged-coordinator shape)
+# ---------------------------------------------------------------------------
+def test_wedged_server_surfaces_rpc_timeout_as_infra_transient():
+    class Wedged:
+        def stall(self):
+            time.sleep(30)
+
+    srv = RpcServer(Wedged())
+    srv.start()
+    try:
+        host, port = srv.address
+        c = RpcClient(host, port, max_retries=2, retry_sleep_s=0.01,
+                      call_timeout_s=0.3)
+        t0 = time.monotonic()
+        with pytest.raises(RpcTimeout) as ei:
+            c.call("stall")
+        elapsed = time.monotonic() - t0
+        assert elapsed < 5.0, f"hung for {elapsed:.1f}s despite timeouts"
+        assert ei.value.failure_domain == "INFRA_TRANSIENT"
+        assert "INFRA_TRANSIENT" in str(ei.value)
+        c.close()
+    finally:
+        srv.stop()
+
+
+def test_call_without_timeout_unchanged_fast_path():
+    srv = _server()
+    try:
+        host, port = srv.address
+        c = RpcClient(host, port)
+        assert c.call("ping") == "pong"
+        c.close()
+    finally:
+        srv.stop()
+
+
+# ---------------------------------------------------------------------------
+# Executor: coordinator-loss → reconnect → orphan state machine
+# ---------------------------------------------------------------------------
+class _FakeClient:
+    """call() fails `fail` times, then succeeds forever."""
+
+    def __init__(self, fail=0, exc=ConnectionError("down")):
+        self.fail = fail
+        self.exc = exc
+        self.calls = 0
+
+    def call(self, method, **kw):
+        self.calls += 1
+        if self.fail:
+            self.fail -= 1
+            raise self.exc
+        return True
+
+    def close(self):
+        pass
+
+
+def _wait(predicate, timeout=5.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if predicate():
+            return True
+        time.sleep(0.01)
+    return False
+
+
+def test_heartbeater_reconnects_after_loss_threshold():
+    from tony_tpu.executor.executor import Heartbeater
+
+    dead = _FakeClient(fail=10 ** 6)
+    fresh = _FakeClient()
+    reconnects = []
+
+    def reconnect():
+        reconnects.append(1)
+        if len(reconnects) < 3:
+            raise ConnectionError("still down")
+        return fresh
+
+    hb = Heartbeater(dead, "worker:0", 0.01, session_id=0,
+                     loss_threshold=3, reconnect=reconnect,
+                     orphan_deadline_s=30.0,
+                     on_orphaned=lambda r: pytest.fail(f"orphaned: {r}"))
+    hb.start()
+    assert _wait(lambda: fresh.calls > 2), \
+        "heartbeats never resumed on the reconnected client"
+    assert dead.calls == 3, "must flip to reconnect mode AT the threshold"
+    assert len(reconnects) == 3
+    hb.stop()
+    hb.join(timeout=5)
+
+
+def test_heartbeater_orphan_deadline_expires():
+    from tony_tpu.executor.executor import Heartbeater
+
+    orphaned = []
+    hb = Heartbeater(_FakeClient(fail=10 ** 6), "worker:0", 0.01,
+                     loss_threshold=2,
+                     reconnect=lambda: (_ for _ in ()).throw(
+                         ConnectionError("nothing listening")),
+                     orphan_deadline_s=0.2,
+                     on_orphaned=orphaned.append)
+    hb.start()
+    assert _wait(lambda: orphaned)
+    hb.join(timeout=5)
+    assert "orphan deadline" in orphaned[0]
+
+
+def test_heartbeater_fenced_heartbeat_orphans_immediately():
+    from tony_tpu.executor.executor import Heartbeater
+
+    orphaned = []
+    hb = Heartbeater(
+        _FakeClient(fail=10 ** 6,
+                    exc=FencedError("stale session epoch 0")),
+        "worker:0", 0.01, loss_threshold=5,
+        reconnect=lambda: pytest.fail("must not try to reconnect"),
+        orphan_deadline_s=30.0, on_orphaned=orphaned.append)
+    hb.start()
+    assert _wait(lambda: orphaned)
+    hb.join(timeout=5)
+    assert "fenced" in orphaned[0]
+
+
+def test_heartbeater_fenced_reregistration_orphans():
+    from tony_tpu.executor.executor import Heartbeater
+
+    orphaned = []
+    hb = Heartbeater(
+        _FakeClient(fail=10 ** 6), "worker:0", 0.01, loss_threshold=1,
+        reconnect=lambda: (_ for _ in ()).throw(
+            FencedError("superseded epoch")),
+        orphan_deadline_s=30.0, on_orphaned=orphaned.append)
+    hb.start()
+    assert _wait(lambda: orphaned)
+    hb.join(timeout=5)
+    assert "fenced during re-registration" in orphaned[0]
+
+
+def test_heartbeater_reconnect_rides_executor_reregister_fault_site():
+    """The executor.reregister site drops reconnect attempts exactly like
+    a transport reset; the loop must absorb the injected burst and still
+    re-register (the unit-level twin of the e2e recovery fault drill)."""
+    from tony_tpu.executor.executor import Heartbeater
+
+    faults.install(faults.FaultInjector({"executor.reregister": "first:2"}))
+    fresh = _FakeClient()
+    attempts = []
+
+    def reconnect():
+        attempts.append(1)
+        faults.check("executor.reregister")   # production wiring mirror
+        return fresh
+
+    hb = Heartbeater(_FakeClient(fail=10 ** 6), "worker:0", 0.01,
+                     loss_threshold=1, reconnect=reconnect,
+                     orphan_deadline_s=30.0,
+                     on_orphaned=lambda r: pytest.fail(f"orphaned: {r}"))
+    hb.start()
+    assert _wait(lambda: fresh.calls > 0)
+    hb.stop()
+    hb.join(timeout=5)
+    assert len(attempts) == 3, "two injected drops, then success"
+
+
+# ---------------------------------------------------------------------------
+# Fault sites: registration + conf plumbing
+# ---------------------------------------------------------------------------
+def test_new_fault_sites_are_registered_and_conf_drivable():
+    assert "coordinator.crash" in faults.SITES
+    assert "executor.reregister" in faults.SITES
+    conf = TonyTpuConfig()
+    conf.set(K.FAULT_COORDINATOR_CRASH, "at:1")
+    conf.set(K.FAULT_EXECUTOR_REREGISTER, "first:1")
+    assert faults.install_from_conf(conf) is True
+    assert faults.fire("coordinator.crash") is True
+    assert faults.fire("coordinator.crash") is False
+    with pytest.raises(faults.InjectedFault):
+        faults.check("executor.reregister")
+
+
+# ---------------------------------------------------------------------------
+# Coordinator-level: epoch fencing + journal round-trip through recovery
+# ---------------------------------------------------------------------------
+def _coord(tmp_path, recover=False, sub="a"):
+    from tony_tpu.cluster.local import LocalProcessBackend
+    from tony_tpu.coordinator.coordinator import Coordinator
+
+    conf = TonyTpuConfig()
+    conf.set("tony.worker.instances", 2)
+    conf.set("tony.worker.command", "true")
+    backend = LocalProcessBackend(str(tmp_path / f"work-{sub}"))
+    return Coordinator(conf, "app_rec", backend,
+                       str(tmp_path / "history"), user="t",
+                       recover=recover)
+
+
+def _close(coord):
+    coord.journal.close()
+    coord.rpc._server.server_close()
+
+
+def test_coordinator_rejects_stale_epoch_registration(tmp_path):
+    coord = _coord(tmp_path)
+    try:
+        with pytest.raises(FencedError):
+            coord.register_worker_spec("worker:0", "h", 1, session_id=3)
+        with pytest.raises(FencedError):
+            coord.heartbeat("worker:0", session_id=1)
+        with pytest.raises(FencedError):
+            coord.register_execution_result("worker:0", 0, session_id=7)
+        # current-epoch and unknown-epoch callers pass
+        coord.register_worker_spec("worker:0", "h", 1, session_id=0)
+        assert coord.heartbeat("worker:0", session_id=-1) is True
+    finally:
+        _close(coord)
+
+
+def test_coordinator_recovery_rebuilds_session_from_journal(tmp_path):
+    c1 = _coord(tmp_path, sub="a")
+    c1.journal.epoch(0, 0, 0)           # what _start_session would write
+    c1.session.mark_job_scheduled("worker")
+    c1.journal.job_scheduled("worker", 0)
+    c1.register_worker_spec("worker:0", "hostA", 111, session_id=0)
+    c1.register_worker_spec("worker:1", "hostB", 222, session_id=0)
+    c1.register_execution_result("worker:1", 0, session_id=0)
+    _close(c1)                          # crash: no teardown records
+
+    c2 = _coord(tmp_path, recover=True, sub="b")
+    try:
+        assert c2.generation == c1.generation + 1
+        assert c2.session.session_id == 0
+        t0 = c2.session.get_task("worker:0")
+        # Survivor: RUNNING, last-known host kept, but must RE-register.
+        assert t0.status.value == "RUNNING"
+        assert (t0.host, t0.port) == ("hostA", 111)
+        assert not t0.registered
+        # Finished-before-crash: terminal state restored verbatim,
+        # still counted by the barrier.
+        t1 = c2.session.get_task("worker:1")
+        assert t1.status.value == "SUCCEEDED" and t1.registered
+        assert not c2.session.all_registered()
+        # The re-registration path is plain register_worker_spec.
+        c2.register_worker_spec("worker:0", "hostA", 111, session_id=0)
+        assert c2.session.all_registered()
+    finally:
+        _close(c2)
+
+
+def test_coordinator_recovery_with_torn_journal_tail(tmp_path):
+    c1 = _coord(tmp_path, sub="a")
+    c1.journal.epoch(0, 0, 0)
+    c1.register_worker_spec("worker:0", "h", 1, session_id=0)
+    path = c1.journal_path
+    _close(c1)
+    with open(path, "ab") as f:
+        f.write(b'{"t": "task", "task": "worke')     # the crash window
+    c2 = _coord(tmp_path, recover=True, sub="b")
+    try:
+        assert c2._recover_state.torn_tail
+        assert c2.session.get_task("worker:0").status.value == "RUNNING"
+    finally:
+        _close(c2)
+
+
+# ---------------------------------------------------------------------------
+# Satellites: ports fallback, torn event stream
+# ---------------------------------------------------------------------------
+def test_reserved_port_reuse_falls_back_without_so_reuseport(monkeypatch,
+                                                             caplog):
+    from tony_tpu.executor.ports import ReservedPort
+
+    monkeypatch.delattr(socket, "SO_REUSEPORT", raising=False)
+    with caplog.at_level("WARNING", logger="tony_tpu.executor.ports"):
+        p = ReservedPort(reuse=True)
+    try:
+        assert p.port > 0
+        assert p.reuse is False, "must degrade to the ephemeral strategy"
+        assert any("SO_REUSEPORT" in r.message for r in caplog.records)
+    finally:
+        p.release()
+
+
+def test_read_events_tolerates_torn_tail(tmp_path):
+    from tony_tpu.events.events import Event, EventType, read_events
+
+    p = tmp_path / "x.jhist.jsonl"
+    with open(p, "w") as f:
+        f.write(Event(EventType.TASK_STARTED, {"task": "worker:0"})
+                .to_json() + "\n")
+        f.write('{"type": "TASK_FIN')            # torn by a crash
+    evs = read_events(str(p))
+    assert len(evs) == 1 and evs[0].type == EventType.TASK_STARTED

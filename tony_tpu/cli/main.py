@@ -110,6 +110,51 @@ def _cmd_kill(args: argparse.Namespace) -> int:
     return 0
 
 
+def _cmd_recover(args: argparse.Namespace) -> int:
+    """Restart a crashed coordinator in-place with --recover: replay the
+    job's write-ahead session journal, re-adopt the surviving executors,
+    and block until the job finishes (the operator-facing face of
+    coordinator crash recovery — docs/operations.md). Runs the
+    coordinator IN this process so its exit code is the job's."""
+    job_dir = os.path.join(_default_workdir(args.workdir), "jobs",
+                           args.app_id)
+    from tony_tpu import constants
+    from tony_tpu.conf.config import TonyTpuConfig
+
+    frozen = os.path.join(job_dir, constants.FINAL_CONFIG_FILE)
+    if not os.path.exists(frozen):
+        print(f"no frozen config for {args.app_id} under {job_dir} "
+              f"(wrong --workdir?)", file=sys.stderr)
+        return 1
+    conf = TonyTpuConfig.load_final(frozen)
+    history_root = args.history_root \
+        or str(conf.get(K.HISTORY_LOCATION, "") or "") \
+        or os.path.join(_default_workdir(args.workdir), "history")
+    # Refuse cleanly when there is nothing to replay — better than the
+    # coordinator failing after it already rebound the address file.
+    journal_path = os.path.join(history_root,
+                                constants.HISTORY_INTERMEDIATE,
+                                args.app_id, constants.JOURNAL_FILE)
+    if not os.path.exists(journal_path):
+        print(f"no session journal at {journal_path} — the job was not "
+              f"run with tony.coordinator.journal-enabled, or it already "
+              f"finished (check `tony-tpu status {args.app_id}`)",
+              file=sys.stderr)
+        return 1
+    from tony_tpu.coordinator.__main__ import main as coordinator_main
+
+    print(f"recovering {args.app_id} from {journal_path}")
+    return coordinator_main([
+        "--conf", frozen,
+        "--app-id", args.app_id,
+        "--history-root", history_root,
+        "--workdir", os.path.join(job_dir, "tasks"),
+        "--addr-file", os.path.join(job_dir, "coordinator.addr"),
+        "--user", os.environ.get("USER", "unknown"),
+        "--recover",
+    ])
+
+
 def _coordinator_rpc(app_id: str, workdir: Optional[str]):
     """RpcClient for a RUNNING job's coordinator, from the job dir's
     address file (how kill/status reach a job after the submitting
@@ -148,6 +193,9 @@ def _cmd_status(args: argparse.Namespace) -> int:
                   f"(retries left: {report['retries_left']}, "
                   f"preemption retries left: "
                   f"{report.get('preemption_retries_left', '?')})")
+            if report.get("recovered"):
+                print(f"recovered: yes (coordinator generation "
+                      f"{report.get('generation', '?')})")
             if report.get("failure_reason"):
                 print(f"reason:   {report['failure_reason']}")
             if report.get("failure_domain"):
@@ -443,6 +491,19 @@ def build_parser() -> argparse.ArgumentParser:
     k.add_argument("--workdir", help="client workdir the job was "
                                      "submitted from (default ~/.tony-tpu)")
     k.set_defaults(fn=_cmd_kill)
+
+    rc = sub.add_parser(
+        "recover",
+        help="restart a crashed coordinator from its session journal and "
+             "re-adopt the surviving executors (blocks until the job "
+             "finishes)")
+    rc.add_argument("app_id")
+    rc.add_argument("--workdir", help="client workdir the job was "
+                                      "submitted from (default ~/.tony-tpu)")
+    rc.add_argument("--history-root",
+                    help="override tony.history.location from the frozen "
+                         "config")
+    rc.set_defaults(fn=_cmd_recover)
 
     st = sub.add_parser("status",
                         help="live report for a running job (falls back "

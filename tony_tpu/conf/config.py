@@ -301,10 +301,14 @@ class TonyTpuConfig:
     def freeze(self, path: str) -> str:
         """Write the frozen final config artifact (``tony-final.json``),
         the single source of truth shipped to coordinator and executors
-        (reference ``tony-final.xml``, Constants.java:139)."""
-        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
-        with open(path, "w", encoding="utf-8") as f:
-            json.dump(self._conf, f, indent=2, sort_keys=True)
+        (reference ``tony-final.xml``, Constants.java:139). Atomic +
+        fsync'd (utils/durable.py): executors fetch this file while the
+        coordinator may crash and be recovered at any moment — a torn
+        config is a gang-wide poison pill."""
+        from tony_tpu.utils.durable import atomic_write
+
+        atomic_write(path, json.dumps(self._conf, indent=2,
+                                      sort_keys=True).encode("utf-8"))
         return path
 
     @classmethod

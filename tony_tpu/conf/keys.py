@@ -264,6 +264,40 @@ TASK_REUSE_PORT = _key(
 TASK_PORT_FILE = _key(
     "tony.task.port-file", "", str,
     "Optional file the executor writes its reserved rendezvous port to.")
+TASK_COORDINATOR_LOSS_HEARTBEATS = _key(
+    "tony.task.coordinator-loss-heartbeats", 3, int,
+    "Consecutive FAILED heartbeat calls before the executor flips from "
+    "heartbeating to reconnect mode (re-resolve the coordinator address, "
+    "re-register with the existing task_id/port). 0 disables "
+    "coordinator-loss detection (an executor then just logs failed "
+    "beats, the pre-recovery behaviour).")
+TASK_ORPHAN_DEADLINE_S = _key(
+    "tony.task.orphan-deadline-s", 120, int,
+    "How long an executor keeps the user process alive while it cannot "
+    "reach ANY coordinator. A coordinator restart inside this window is "
+    "invisible to training (the executor re-registers and carries on); "
+    "past it the executor concludes it is orphaned, delivers the "
+    "TERM-grace-KILL ladder to the user process group, and exits — no "
+    "headless gang may keep burning TPU time forever.")
+
+# --- rpc ------------------------------------------------------------------
+RPC_CALL_TIMEOUT_S = _key(
+    "tony.rpc.call-timeout-s", 10.0, float,
+    "Per-call send/recv deadline on executor control-plane RPCs. A "
+    "WEDGED coordinator (accepts connections, never answers) then "
+    "surfaces as an INFRA_TRANSIENT RpcTimeout instead of hanging the "
+    "heartbeat thread forever — which is what lets coordinator-loss "
+    "detection fire at all. 0 disables (unbounded waits).")
+RPC_MAX_RETRIES = _key(
+    "tony.rpc.max-retries", 10, int,
+    "Transport-level reconnect budget per executor RPC call (reference "
+    "10 fixed-sleep attempts, ApplicationRpcClient.java:66-76; here with "
+    "exponential full-jitter backoff). Recovery tests lower it so "
+    "coordinator-loss detection fires in seconds, not minutes.")
+RPC_RETRY_SLEEP_S = _key(
+    "tony.rpc.retry-sleep-s", 2.0, float,
+    "Cap on any one transport retry sleep (the backoff envelope's "
+    "max delay; base is a quarter of it).")
 
 # --- coordinator ----------------------------------------------------------
 COORDINATOR_MONITOR_INTERVAL_MS = _key(
@@ -280,6 +314,22 @@ COORDINATOR_STOP_GRACE_S = _key(
     "tony.coordinator.stop-grace-s", 15, int,
     "Grace period when stopping running tasks "
     "(reference ApplicationMaster.java:694-711).")
+COORDINATOR_JOURNAL_ENABLED = _key(
+    "tony.coordinator.journal-enabled", True, bool,
+    "Write-ahead session journal (session.journal.jsonl in the job "
+    "history dir): every task state transition, registration, epoch "
+    "reset and failure verdict is appended fsync'd, so a crashed "
+    "coordinator can be restarted with --recover and resume the SAME "
+    "epoch instead of losing the job (the YARN "
+    "keepContainersAcrossApplicationAttempts analogue). Appends are "
+    "control-plane-rate (per task transition, not per step); disable "
+    "only on filesystems where fsync is pathological.")
+COORDINATOR_REREGISTRATION_GRACE_S = _key(
+    "tony.coordinator.reregistration-grace-s", 60, int,
+    "Recovery grace window: how long a coordinator started with "
+    "--recover waits for the surviving executors to re-register their "
+    "existing task_id/host/port before declaring the gang lost "
+    "(INFRA_TRANSIENT, normal retry-epoch machinery).")
 
 # --- client ---------------------------------------------------------------
 CLIENT_POLL_INTERVAL_MS = _key(
@@ -384,6 +434,17 @@ FAULT_STORAGE_GET = _key(
 FAULT_CHECKPOINT_SAVE = _key(
     "tony.fault.checkpoint-save", "", str,
     "Fail CheckpointManager.save before the write starts.")
+FAULT_COORDINATOR_CRASH = _key(
+    "tony.fault.coordinator-crash", "", str,
+    "Hard-kill the coordinator process (os._exit, no teardown — the "
+    "SIGKILL shape) from inside its monitor loop when the spec fires; "
+    "the call counter is monitor iterations. Drives the journal + "
+    "--recover path from the deterministic harness.")
+FAULT_EXECUTOR_REREGISTER = _key(
+    "tony.fault.executor-reregister", "", str,
+    "Drop an executor's re-registration attempt during coordinator-loss "
+    "reconnect (raises like a transport reset; the reconnect loop "
+    "retries until the orphan deadline).")
 
 # --- portal ---------------------------------------------------------------
 PORTAL_PORT = _key(
@@ -475,7 +536,7 @@ _JOB_KEY_RE: Pattern[str] = re.compile(
 
 _RESERVED_NON_JOB_SEGMENTS = {
     "application", "task", "coordinator", "client", "history", "tpu", "portal",
-    "keep-failed-task-dirs", "internal", "fault",
+    "keep-failed-task-dirs", "internal", "fault", "rpc",
 }
 
 

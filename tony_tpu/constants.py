@@ -21,6 +21,15 @@ APP_ID = "TONY_APP_ID"                # application id
 COORDINATOR_HOST = "TONY_COORDINATOR_HOST"
 COORDINATOR_PORT = "TONY_COORDINATOR_PORT"
 METRICS_PORT = "TONY_METRICS_PORT"    # metrics RPC port on the coordinator
+# Coordinator generation this executor was launched under (crash-recovery
+# fencing, rpc/wire.py): adopted upward on reconnect, rejected downward.
+COORDINATOR_GENERATION = "TONY_COORDINATOR_GENERATION"
+# Path to the coordinator's address file (host/port/token JSON). Executors
+# re-resolve the coordinator from it after a restart (the recovered
+# coordinator binds a fresh ephemeral port and rewrites the file); only
+# meaningful where the path is reachable (same host / shared fs) — absent
+# or unreadable, reconnects retry the launch-time address.
+COORDINATOR_ADDR_FILE = "TONY_COORDINATOR_ADDR_FILE"
 # File the user process's telemetry reporter writes device stats to; the
 # TaskMonitor tails it (set by the executor; see tony_tpu/telemetry.py).
 METRICS_FILE = "TONY_METRICS_FILE"
@@ -89,6 +98,9 @@ DRIVER_JOB_NAME = "driver"
 # HistoryFileUtils.java:12-31 jhist naming).
 # ---------------------------------------------------------------------------
 FINAL_CONFIG_FILE = "tony-final.json"
+# Write-ahead session journal, next to the history stream in the job dir
+# (coordinator/journal.py — the crash-recovery source of truth).
+JOURNAL_FILE = "session.journal.jsonl"
 EVENTS_SUFFIX = ".jhist.jsonl"
 INPROGRESS_SUFFIX = ".jhist.jsonl.inprogress"
 HISTORY_INTERMEDIATE = "intermediate"

@@ -98,12 +98,22 @@ def main(argv=None) -> int:
     p.add_argument("--addr-file", required=True,
                    help="file to write 'host port token' for the client")
     p.add_argument("--user", default="")
+    p.add_argument("--recover", action="store_true",
+                   help="replay the job's write-ahead session journal and "
+                        "resume the surviving gang at its current epoch "
+                        "instead of launching a fresh one (coordinator "
+                        "crash recovery; see docs/operations.md)")
     args = p.parse_args(argv)
 
     conf = TonyTpuConfig.load_final(args.conf)
     backend = _make_backend(conf, args.workdir)
-    coord = Coordinator(conf, args.app_id, backend, args.history_root,
-                        user=args.user)
+    try:
+        coord = Coordinator(conf, args.app_id, backend, args.history_root,
+                            user=args.user, recover=args.recover,
+                            addr_file=args.addr_file)
+    except Exception as e:  # noqa: BLE001 — e.g. JournalError on --recover
+        logging.getLogger(__name__).error("coordinator startup failed: %s", e)
+        return constants.EXIT_FAILURE
     host, port = "", 0
 
     # Start RPC before writing the address file so the client never dials a

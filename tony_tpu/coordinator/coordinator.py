@@ -33,12 +33,14 @@ from tony_tpu import constants, faults
 from tony_tpu.cluster.base import Backend, TaskLaunchSpec
 from tony_tpu.conf.config import TonyTpuConfig
 from tony_tpu.conf import keys as K
+from tony_tpu.coordinator import journal
+from tony_tpu.coordinator.journal import SessionJournal
 from tony_tpu.coordinator.scheduler import GangScheduler
 from tony_tpu.coordinator.session import (FailureDomain, Session,
                                           SessionStatus, Task, TaskStatus)
 from tony_tpu.events.events import Event, EventHandler, EventType
 from tony_tpu.events import history
-from tony_tpu.rpc.wire import RpcServer
+from tony_tpu.rpc.wire import FencedError, RpcServer
 
 log = logging.getLogger(__name__)
 
@@ -65,22 +67,26 @@ class _RpcService:
     def get_cluster_spec(self, task_id: str) -> Optional[dict]:
         return self._c.session.get_cluster_spec()
 
-    def register_worker_spec(self, task_id: str, host: str,
-                             port: int) -> Optional[dict]:
-        return self._c.register_worker_spec(task_id, host, port)
+    def register_worker_spec(self, task_id: str, host: str, port: int,
+                             session_id: int = -1) -> Optional[dict]:
+        return self._c.register_worker_spec(task_id, host, port,
+                                            session_id=session_id)
 
     def register_tensorboard_url(self, task_id: str, url: str) -> bool:
         return self._c.register_tensorboard_url(task_id, url)
 
-    def register_execution_result(self, task_id: str, exit_code: int) -> int:
-        return self._c.register_execution_result(task_id, exit_code)
+    def register_execution_result(self, task_id: str, exit_code: int,
+                                  session_id: int = -1) -> int:
+        return self._c.register_execution_result(task_id, exit_code,
+                                                 session_id=session_id)
 
     def finish_application(self) -> str:
         self._c.client_signalled_finish.set()
         return self._c.final_status.value
 
-    def task_executor_heartbeat(self, task_id: str) -> bool:
-        return self._c.heartbeat(task_id)
+    def task_executor_heartbeat(self, task_id: str,
+                                session_id: int = -1) -> bool:
+        return self._c.heartbeat(task_id, session_id=session_id)
 
     def get_application_report(self) -> dict:
         return self._c.application_report()
@@ -102,13 +108,47 @@ class _RpcService:
 class Coordinator:
     def __init__(self, conf: TonyTpuConfig, app_id: str, backend: Backend,
                  history_root: str, user: str = "",
-                 rpc_token: Optional[str] = None):
+                 rpc_token: Optional[str] = None,
+                 recover: bool = False, addr_file: str = ""):
         self.conf = conf
         self.app_id = app_id
         self.backend = backend
         self.user = user or os.environ.get("USER", "unknown")
         self.history_root = history_root
-        self.session = Session(conf, session_id=0)
+        # Where this coordinator's host/port/token lands (written by
+        # __main__/the client); exported to executors so they can
+        # RE-resolve a restarted coordinator (new ephemeral port).
+        self.addr_file = addr_file
+        job_dir = history.intermediate_dir(history_root, app_id)
+        self.job_dir = job_dir
+        self.journal_path = os.path.join(job_dir, constants.JOURNAL_FILE)
+        # --- crash recovery: replay the write-ahead journal BEFORE any
+        # other state exists — the fencing generation must be known before
+        # the RPC server is created, and the original started_ms before
+        # the event stream reattaches to its in-progress file.
+        self._recover_state: Optional[journal.ReplayState] = None
+        if recover:
+            self._recover_state = journal.replay(self.journal_path)
+            if self._recover_state.torn_tail:
+                log.warning("journal had a torn tail; recovered from the "
+                            "%d-record prefix",
+                            self._recover_state.records)
+        st = self._recover_state
+        # Generations are monotonic across coordinator lives: 1 for a
+        # fresh job, last-journaled + 1 on every recovery. Carried in
+        # every RPC frame (rpc/wire.py) — the split-brain fence.
+        self.generation = (st.generation + 1) if st else 1
+        self.session = Session(conf, session_id=st.session_id if st else 0)
+        if st is not None:
+            for job_name in sorted(st.scheduled_jobs):
+                self.session.mark_job_scheduled(job_name)
+            for task_id, tr in st.tasks.items():
+                self.session.restore_task(
+                    task_id, TaskStatus(tr.status),
+                    host=tr.host, port=tr.port, exit_code=tr.exit_code,
+                    domain=(FailureDomain(tr.domain) if tr.domain
+                            else None),
+                    registered=tr.registered)
         self.scheduler: Optional[GangScheduler] = None
         self.metrics_store: Dict[str, dict] = {}
         self.tb_url: str = ""
@@ -116,7 +156,14 @@ class Coordinator:
         self.final_status = SessionStatus.RUNNING
         self._stop_requested = threading.Event()
         self._stop_reason = ""
-        self._started_ms = int(time.time() * 1000)
+        # Recovery keeps the ORIGINAL start time: the history filename
+        # grammar embeds it, and the recovered coordinator must reattach
+        # to (and eventually finalize) the first life's in-progress file.
+        self._started_ms = (st.started_ms if st and st.started_ms
+                            else int(time.time() * 1000))
+        # While True, the monitor runs the re-registration grace window
+        # instead of the first-rendezvous registration timeout.
+        self._reregistration_grace = st is not None
         # Per-domain retry budgets (coordinator/session.py FailureDomain):
         # INFRA_TRANSIENT draws on retry-count; PREEMPTION draws on its
         # own free budget first (expected churn must not exhaust the
@@ -127,9 +174,9 @@ class Coordinator:
             K.APPLICATION_PREEMPTION_RETRY_COUNT, 3)
         self._retry_user_errors = conf.get_bool(
             K.APPLICATION_RETRY_USER_ERRORS)
-        self._infra_retries_used = 0
-        self._preempt_retries_used = 0
-        self._attempt = 0
+        self._infra_retries_used = st.infra_retries_used if st else 0
+        self._preempt_retries_used = st.preempt_retries_used if st else 0
+        self._attempt = st.session_id if st else 0
         # Deterministic fault injection (tony.fault.*): install for this
         # process; _task_env forwards the same spec to every executor.
         faults.install_from_conf(conf)
@@ -153,19 +200,53 @@ class Coordinator:
             _RpcService(self),
             host=str(conf.get(K.COORDINATOR_HOST_KEY)),
             port=conf.get_int(K.COORDINATOR_PORT_KEY, 0),
-            token=rpc_token, tls=tls)
+            token=rpc_token, tls=tls,
+            generation=self.generation,
+            on_superseded=self._on_superseded)
 
-        job_dir = history.intermediate_dir(history_root, app_id)
-        self.job_dir = job_dir
         self.events = EventHandler(
             job_dir, history.in_progress_name(app_id, self._started_ms,
                                               self.user))
+        # Write-ahead journal (crash recovery): opened for append in both
+        # lives; the generation bump is the first record of each life so
+        # even an immediately-recrashed coordinator leaves a fence trail.
+        self.journal = SessionJournal(
+            self.journal_path,
+            enabled=conf.get_bool(K.COORDINATOR_JOURNAL_ENABLED, True))
+        self.journal.generation(self.generation)
+        if st is None:
+            self.journal.app(app_id, self._started_ms, self.user)
 
         hb_interval = conf.get_int(K.TASK_HEARTBEAT_INTERVAL_MS, 1000)
         max_missed = conf.get_int(K.TASK_MAX_MISSED_HEARTBEATS, 25)
         # Reference expiry formula: hbInterval * max(3, maxMisses)
         # (ApplicationMaster.java:205).
         self._hb_expiry_s = hb_interval * max(3, max_missed) / 1000.0
+
+    # ------------------------------------------------------------------
+    # Fencing
+    # ------------------------------------------------------------------
+    def _on_superseded(self, newer_generation: int) -> None:
+        """A frame proved a successor coordinator exists (rpc/wire.py
+        server-side generation check): THIS process is the zombie half of
+        a split brain and must stand down without touching the gang —
+        the successor owns it now."""
+        log.error("superseded by coordinator generation %d (we are %d); "
+                  "standing down", newer_generation, self.generation)
+        self.request_stop(
+            f"superseded by coordinator generation {newer_generation}")
+
+    def _check_epoch(self, task_id: str, session_id) -> None:
+        """Reject RPCs from a stale retry epoch. An executor surviving
+        from a pre-reset session must not refresh the NEW epoch's task
+        liveness or corrupt its results; the FencedError is terminal on
+        the executor side (it kills its user process and exits).
+        session_id < 0 = caller doesn't know (accepted — compat)."""
+        sid = int(session_id if session_id is not None else -1)
+        if sid >= 0 and sid != self.session.session_id:
+            raise FencedError(
+                f"task {task_id} belongs to session epoch {sid}; the "
+                f"coordinator is at epoch {self.session.session_id}")
 
     # ------------------------------------------------------------------
     # Launching
@@ -186,8 +267,13 @@ class Coordinator:
             constants.COORDINATOR_HOST: host,
             constants.COORDINATOR_PORT: str(port),
             constants.METRICS_PORT: str(port),
+            constants.COORDINATOR_GENERATION: str(self.generation),
             constants.TASK_COMMAND: job.command,
         }
+        if self.addr_file:
+            # Lets the executor RE-resolve a restarted coordinator (it
+            # rewrites this file with its fresh ephemeral port).
+            env[constants.COORDINATOR_ADDR_FILE] = self.addr_file
         if self.rpc_token:
             env["TONY_RPC_TOKEN"] = self.rpc_token
         ckpt_dir = str(self.conf.get(K.APPLICATION_CHECKPOINT_DIR, "") or "")
@@ -245,10 +331,18 @@ class Coordinator:
         # peers (reference adds numExpectedTasks at schedule time,
         # ``TonySession.addNumExpectedTask`` :197).
         self.session.mark_job_scheduled(job_name)
+        self.journal.job_scheduled(job_name, self.session.session_id)
         for i in range(job.instances):
             task = self.session.get_task(f"{job_name}:{i}")
             if task is None or task.status != TaskStatus.NEW:
                 continue
+            # Write-ahead: journal the SCHEDULED transition before the
+            # backend spawn. A crash in between recovers a task the
+            # journal says was launched but that never registers — the
+            # re-registration grace expires into a normal retry epoch,
+            # never a duplicate launch over a live executor.
+            self.journal.task(task.task_id, TaskStatus.SCHEDULED.value,
+                              self.session.session_id)
             spec = TaskLaunchSpec(
                 task_id=task.task_id, job_name=job_name, index=i,
                 command=job.command, env=self._task_env(task),
@@ -276,12 +370,22 @@ class Coordinator:
     # ------------------------------------------------------------------
     # RPC-surface behaviour
     # ------------------------------------------------------------------
-    def register_worker_spec(self, task_id: str, host: str,
-                             port: int) -> Optional[dict]:
+    def register_worker_spec(self, task_id: str, host: str, port: int,
+                             session_id: int = -1) -> Optional[dict]:
         """Gang barrier: record the spec, return the full cluster spec only
-        once ALL tasks registered (reference ApplicationMaster.java:841-889)."""
+        once ALL tasks registered (reference ApplicationMaster.java:841-889).
+        Serves initial registration AND post-recovery re-registration —
+        the latter is the same call with the executor's existing
+        task_id/host/port, fenced by session epoch."""
+        self._check_epoch(task_id, session_id)
         ok = self.session.register_worker(task_id, host, port)
         if ok:
+            # Write-ahead: the registration must be on disk before the
+            # executor can observe it succeeded (a crash after the reply
+            # but before the append would resurrect an unregistered task
+            # whose executor believes it is registered).
+            self.journal.register(task_id, host, int(port),
+                                  self.session.session_id)
             with self._hb_lock:
                 self._last_hb[task_id] = time.monotonic()
             self._maybe_test_worker_termination(task_id)
@@ -311,16 +415,19 @@ class Coordinator:
         self.tb_url = url
         return True
 
-    def register_execution_result(self, task_id: str, exit_code: int) -> int:
+    def register_execution_result(self, task_id: str, exit_code: int,
+                                  session_id: int = -1) -> int:
         """Executor self-report; unregisters from the liveness monitor so a
         completed task can't be deemed dead (reference design note
         ``ApplicationMaster.java:891-919``)."""
+        self._check_epoch(task_id, session_id)
         with self._hb_lock:
             self._last_hb.pop(task_id, None)
         self._process_completion(task_id, exit_code)
         return 0
 
-    def heartbeat(self, task_id: str) -> bool:
+    def heartbeat(self, task_id: str, session_id: int = -1) -> bool:
+        self._check_epoch(task_id, session_id)
         with self._hb_lock:
             if task_id in self._last_hb:
                 self._last_hb[task_id] = time.monotonic()
@@ -384,6 +491,8 @@ class Coordinator:
             "failure_domain": domain.value if domain else "",
             "session_id": self.session.session_id,
             "attempt": self._attempt,
+            "generation": self.generation,
+            "recovered": self._recover_state is not None,
             "retries_left": retries_left,
             "preemption_retries_left": preempt_left,
             "tb_url": self.tb_url,
@@ -409,6 +518,10 @@ class Coordinator:
         self.session.on_task_completed(
             task_id, exit_code,
             domain_hint=self.backend.completion_domain(task_id))
+        self.journal.task(
+            task_id, t.status.value, self.session.session_id,
+            exit_code=exit_code,
+            domain=t.failure_domain.value if t.failure_domain else "")
         logs = self.backend.task_log_paths(task_id)
         self.events.emit(Event(EventType.TASK_FINISHED, {
             "task": task_id, "exit_code": exit_code,
@@ -424,6 +537,8 @@ class Coordinator:
                     for i in range(job.instances)]
             if all(x is not None and x.status == TaskStatus.SUCCEEDED
                    for x in done):
+                self.journal.job_completed(t.job_name,
+                                           self.session.session_id)
                 self.scheduler.register_job_completed(t.job_name)
             elif t.status in (TaskStatus.FAILED, TaskStatus.KILLED) and \
                     not self.scheduler.dependency_check_passed(t.job_name):
@@ -464,6 +579,10 @@ class Coordinator:
             self.session.on_task_completed(
                 task_id, constants.EXIT_KILLED,
                 domain_hint=FailureDomain.INFRA_TRANSIENT.value)
+            self.journal.task(
+                task_id, t.status.value, self.session.session_id,
+                exit_code=constants.EXIT_KILLED,
+                domain=FailureDomain.INFRA_TRANSIENT.value)
             # The kill's eventual backend completion is a no-op (task
             # already terminal), so THIS is the only place the task's
             # TASK_FINISHED — with its liveness-expiry domain — can be
@@ -485,10 +604,12 @@ class Coordinator:
         (reference ``ApplicationMaster.run`` :312 + retry loop :337-371)."""
         self.rpc.start()
         self.events.start()
-        self.events.emit(Event(EventType.APPLICATION_INITED, {
-            "app_id": self.app_id, "user": self.user,
-            "conf": {k: v for k, v in self.conf.as_dict().items()
-                     if not k.startswith("_")}}))
+        recovered = self._recover_state is not None
+        if not recovered:
+            self.events.emit(Event(EventType.APPLICATION_INITED, {
+                "app_id": self.app_id, "user": self.user,
+                "conf": {k: v for k, v in self.conf.as_dict().items()
+                         if not k.startswith("_")}}))
         self._final_conf_path = self.conf.freeze(
             os.path.join(self.job_dir, constants.FINAL_CONFIG_FILE))
 
@@ -500,16 +621,23 @@ class Coordinator:
             self.rpc.stop()
             raise CoordinatorCrash("TEST_COORDINATOR_CRASH requested")
 
-        attempt = 0
+        # On recovery the loop resumes AT the journaled epoch: the first
+        # iteration re-adopts the surviving gang instead of launching one,
+        # and any later retry epochs continue the same numbering.
+        attempt = self._attempt
+        first = True
         retry_domain: Optional[FailureDomain] = None
         try:
             local_cmd = str(self.conf.get(K.COORDINATOR_COMMAND, "") or "")
             single_node = not self.session.tasks
-            if local_cmd and (single_node or self.conf.get_bool(
-                    K.APPLICATION_ENABLE_PREPROCESS)):
+            if local_cmd and not recovered and (
+                    single_node or self.conf.get_bool(
+                        K.APPLICATION_ENABLE_PREPROCESS)):
                 # Preprocess / single-node path: run the command in the
                 # coordinator (reference ``doPreprocessingJob`` :714-766 —
-                # short-circuit the job if it fails).
+                # short-circuit the job if it fails). Not re-run on
+                # recovery: a completed prepare stage's effects are on
+                # disk, and re-running it mid-job is never safe to assume.
                 code = self._do_local_job(local_cmd, register_tb=single_node)
                 if code != 0:
                     self.session.fail(
@@ -519,13 +647,20 @@ class Coordinator:
                     self.session.status = SessionStatus.SUCCEEDED
                     return self.final_status
             while True:
-                self._start_session(attempt, retry_domain)
+                if first and recovered:
+                    self._resume_session()
+                else:
+                    self._start_session(attempt, retry_domain)
+                first = False
                 status = self._monitor()
                 if status == SessionStatus.SUCCEEDED \
                         or self._stop_requested.is_set():
                     break
                 retry_domain = (self.session.failure_domain
                                 or FailureDomain.INFRA_TRANSIENT)
+                self.journal.verdict(
+                    self.session.session_id, retry_domain.value,
+                    self.session.failure_reason or "")
                 if not self._retry_available(retry_domain):
                     if retry_domain == FailureDomain.USER_ERROR \
                             and not self._retry_user_errors:
@@ -644,7 +779,44 @@ class Coordinator:
             # see (old FAILED session, exhausted budget) and un-mask the
             # transient FAILED on the last permitted retry.
             self._consume_retry(retried_domain)
+        # The epoch record is the journal's per-epoch state barrier:
+        # replay folds registrations/transitions only from the LAST epoch
+        # record forward, with the budget counters as consumed so far.
+        self.journal.epoch(attempt, self._infra_retries_used,
+                           self._preempt_retries_used)
+        self._reregistration_grace = False
         self.scheduler = GangScheduler(self.conf, self._launch_job)
+        self._schedule_start = time.monotonic()
+        self.scheduler.schedule_ready()
+
+    def _resume_session(self) -> None:
+        """Recovery twin of _start_session: the journaled epoch's session
+        was rebuilt in __init__; re-adopt the surviving gang instead of
+        launching one. Executors re-register through the ordinary
+        register_worker_spec path (their processes never stopped), under
+        the re-registration grace window instead of the first-rendezvous
+        timeout; jobtypes whose launch never hit the journal go through
+        schedule_ready as usual."""
+        st = self._recover_state
+        live = [t for t in self.session.all_tasks()
+                if not t.status.terminal and t.job_name
+                in self.session.scheduled_jobs]
+        log.warning(
+            "recovery: generation %d resumes session epoch %d — %d task(s) "
+            "awaiting re-registration (%ds grace), budgets used: "
+            "transient %d/%d, preemption %d/%d",
+            self.generation, self.session.session_id, len(live),
+            self.conf.get_int(K.COORDINATOR_REREGISTRATION_GRACE_S, 60),
+            self._infra_retries_used, self._retries_total,
+            self._preempt_retries_used, self._preempt_retries_total)
+        self.events.emit(Event(EventType.COORDINATOR_RECOVERED, {
+            "app_id": self.app_id, "generation": self.generation,
+            "session_id": self.session.session_id,
+            "journal_records": st.records if st else 0,
+            "awaiting_reregistration": [t.task_id for t in live]}))
+        self._reregistration_grace = True
+        self.scheduler = GangScheduler(self.conf, self._launch_job)
+        self.scheduler.restore(st.scheduled_jobs, st.completed_jobs)
         self._schedule_start = time.monotonic()
         self.scheduler.schedule_ready()
 
@@ -654,7 +826,21 @@ class Coordinator:
                                      500) / 1000.0
         timeout_s = self.conf.get_int(K.APPLICATION_TIMEOUT_S, 0)
         reg_timeout_s = self.conf.get_int(K.TASK_REGISTRATION_TIMEOUT_S, 900)
+        regrace_s = self.conf.get_int(K.COORDINATOR_REREGISTRATION_GRACE_S,
+                                      60)
         while True:
+            if faults.fire("coordinator.crash"):
+                # The SIGKILL shape: no teardown, no history finalize, no
+                # gang kill — exactly what --recover must survive. The
+                # call counter is monitor iterations, so `at:K` places
+                # the crash deterministically mid-job.
+                log.critical("FAULT coordinator.crash: hard-exiting with "
+                             "no teardown (os._exit)")
+                os._exit(137)
+            if self._reregistration_grace and self.session.all_registered():
+                log.info("recovery: all surviving tasks re-registered; "
+                         "resuming normal monitoring")
+                self._reregistration_grace = False
             if self._stop_requested.is_set():
                 self.session.fail(self._stop_reason or "stop requested")
                 # TERM with the FULL configured grace (reference
@@ -672,16 +858,24 @@ class Coordinator:
                 self.session.fail(f"application timed out after {timeout_s}s",
                                   FailureDomain.USER_ERROR)
                 return self.session.status
-            if not self.session.all_registered() and reg_timeout_s and \
+            reg_window = regrace_s if self._reregistration_grace \
+                else reg_timeout_s
+            if not self.session.all_registered() and reg_window and \
                     self.session.num_expected > 0 \
                     and (time.monotonic() - self._schedule_start
-                         > reg_timeout_s):
+                         > reg_window):
                 # Gang rendezvous timed out (reference registration timeout
                 # kills stuck allocations, ApplicationMaster.java:791-888).
+                # In recovery this is the re-registration grace expiring:
+                # the gang did not survive the coordinator outage after
+                # all — fall through to the ordinary retry machinery.
+                what = ("re-registration grace (recovery)"
+                        if self._reregistration_grace
+                        else "registration timeout")
                 self.session.fail(
-                    f"registration timeout: {self.session.num_registered}/"
+                    f"{what}: {self.session.num_registered}/"
                     f"{self.session.num_expected} tasks registered within "
-                    f"{reg_timeout_s}s", FailureDomain.INFRA_TRANSIENT)
+                    f"{reg_window}s", FailureDomain.INFRA_TRANSIENT)
                 return self.session.status
             for task_id, exit_code in self.backend.poll_completions():
                 self._process_completion(task_id, exit_code)
@@ -778,5 +972,6 @@ class Coordinator:
         self.events.stop(history.final_name(
             self.app_id, self._started_ms, int(time.time() * 1000), self.user,
             self.final_status.value))
+        self.journal.close()
         self.backend.stop()
         self.rpc.stop()

@@ -96,6 +96,17 @@ class GangScheduler:
             launched.append(name)
         return launched
 
+    def restore(self, scheduled, completed) -> None:
+        """Install journal-replayed DAG progress (coordinator crash
+        recovery): jobtypes already handed to the backend must not be
+        launched again over their surviving executors, and completed
+        dependencies must keep their dependents unlocked. A later
+        ``schedule_ready`` then launches exactly the jobtypes the crash
+        interrupted before their launch record hit the journal."""
+        with self._lock:
+            self._scheduled |= {j for j in scheduled if j in self.jobs}
+            self._completed |= {j for j in completed if j in self.jobs}
+
     def register_job_completed(self, job_name: str) -> List[str]:
         """All tasks of `job_name` finished successfully → unlock dependents
         (reference ``registerDependencyCompleted`` :118-140)."""

@@ -280,6 +280,35 @@ class Session:
                 self._fail(f"task {task_id} failed ({tag}) and "
                            f"fail-on-worker-failure is enabled", domain)
 
+    def restore_task(self, task_id: str, status: TaskStatus,
+                     host: str = "", port: int = 0,
+                     exit_code: Optional[int] = None,
+                     domain: Optional[FailureDomain] = None,
+                     registered: bool = False) -> None:
+        """Install journal-replayed state for one task (coordinator crash
+        recovery, coordinator/journal.py). Terminal states are restored
+        verbatim; live states come back as RUNNING with
+        ``registered=False`` — the task's last-known host/port are kept
+        for the report, but the executor must RE-register inside the
+        recovery grace window before it counts toward the barrier again
+        (its process survived the coordinator; its liveness did not
+        survive the restart)."""
+        with self._lock:
+            t = self.tasks.get(task_id)
+            if t is None:
+                return
+            t.host, t.port = host, int(port)
+            if status.terminal:
+                t.status = status
+                t.exit_code = exit_code
+                t.failure_domain = domain
+                # A task that finished before the crash keeps its
+                # registered-ness: the barrier must not wait on it.
+                t.registered = registered
+            elif status in (TaskStatus.SCHEDULED, TaskStatus.RUNNING):
+                t.status = TaskStatus.RUNNING
+                t.registered = False
+
     def mark_killed(self, task_id: str, reason: str = "") -> None:
         with self._lock:
             t = self.tasks.get(task_id)

@@ -29,6 +29,11 @@ class EventType(str, enum.Enum):
     APPLICATION_FINISHED = "APPLICATION_FINISHED"
     TASK_STARTED = "TASK_STARTED"
     TASK_FINISHED = "TASK_FINISHED"
+    # A coordinator restarted with --recover re-adopted this job mid-run
+    # (coordinator/journal.py); payload carries the new generation and
+    # the tasks awaiting re-registration. No reference analogue — the AM
+    # restart was invisible in jhist; operators asked why a job "paused".
+    COORDINATOR_RECOVERED = "COORDINATOR_RECOVERED"
 
 
 @dataclasses.dataclass
@@ -73,40 +78,68 @@ class EventHandler:
         self._queue.put(event)
 
     def _drain(self) -> None:
+        from tony_tpu.utils.durable import fsync_file
+
         with open(self._path, "a", encoding="utf-8") as f:
+            dirty = False
             while True:
                 try:
                     ev = self._queue.get(timeout=0.2)
                 except queue.Empty:
                     if self._stopped.is_set():
                         break
-                    f.flush()
+                    if dirty:
+                        # Durability on the idle edge, not per event: a
+                        # coordinator crash then loses at most the burst
+                        # in flight, and readers tolerate a torn tail
+                        # (read_events) — same contract as the journal.
+                        fsync_file(f)
+                        dirty = False
                     continue
                 if ev is None:
                     break
                 f.write(ev.to_json() + "\n")
-            f.flush()
+                dirty = True
+            fsync_file(f)
 
     def stop(self, final_name: str) -> str:
         """Flush remaining events and rename in-progress → final
-        (reference EventHandler.java:126-135)."""
+        (reference EventHandler.java:126-135). The rename is made durable
+        (dir fsync) — a finalized-then-vanished history file would read
+        as a still-running job forever."""
+        from tony_tpu.utils.durable import durable_replace
+
         self._stopped.set()
         self._queue.put(None)
         if self._thread:
             self._thread.join(timeout=10)
         final_path = os.path.join(self._job_dir, final_name)
         if os.path.exists(self._path):
-            os.replace(self._path, final_path)
+            durable_replace(self._path, final_path)
         return final_path
 
 
 def read_events(path: str) -> List[Event]:
     """Decode an event file back into Events (reference
-    ``ParserUtils.parseEvents`` :258-287)."""
+    ``ParserUtils.parseEvents`` :258-287).
+
+    Torn-tail tolerant: a coordinator crash can leave a partially
+    written final line (the window between write and fsync). Decoding
+    stops at the first bad line with a warning — the portal and CLI must
+    render the crashed job's history, not traceback over it."""
+    import logging
+
     out: List[Event] = []
     with open(path, "r", encoding="utf-8") as f:
         for line in f:
             line = line.strip()
-            if line:
+            if not line:
+                continue
+            try:
                 out.append(Event.from_json(line))
+            except (ValueError, KeyError):
+                logging.getLogger(__name__).warning(
+                    "torn/undecodable event record in %s after %d good "
+                    "ones — returning the prefix", path, len(out))
+                break
     return out

@@ -189,8 +189,9 @@ class HistoryFileMover:
                         killed = final_name(m.group(1), int(m.group(2)),
                                             int(time.time() * 1000),
                                             m.group(3), "KILLED")
-                        os.replace(os.path.join(job_dir, f),
-                                   os.path.join(job_dir, killed))
+                        from tony_tpu.utils.durable import durable_replace
+                        durable_replace(os.path.join(job_dir, f),
+                                        os.path.join(job_dir, killed))
                         hist = os.path.join(job_dir, killed)
                 if hist is None:
                     continue

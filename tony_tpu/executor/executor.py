@@ -13,6 +13,7 @@ Fault hooks honoured: TEST_NUM_HB_MISS (skip first N heartbeats, reference
 
 from __future__ import annotations
 
+import json
 import logging
 import os
 import signal
@@ -20,14 +21,14 @@ import socket
 import sys
 import threading
 import time
-from typing import Dict, Optional
+from typing import Callable, Dict, Optional
 
 from tony_tpu import constants
 from tony_tpu.conf.config import TonyTpuConfig
 from tony_tpu.conf import keys as K
 from tony_tpu.executor.monitor import TaskMonitor
 from tony_tpu.executor.ports import ReservedPort
-from tony_tpu.rpc.wire import RpcClient
+from tony_tpu.rpc.wire import FencedError, RpcClient
 from tony_tpu.runtimes.base import TaskIdentity, get_runtime
 from tony_tpu.utils import proc as procutil
 
@@ -69,13 +70,34 @@ def _forward_signal(signum, frame) -> None:
 
 
 class Heartbeater(threading.Thread):
-    """Reference ``TaskExecutor`` heartbeat thread :330-370."""
+    """Reference ``TaskExecutor`` heartbeat thread :330-370, extended with
+    coordinator-loss detection (crash recovery): after ``loss_threshold``
+    CONSECUTIVE failed beats the thread flips to reconnect mode —
+    re-resolve the coordinator, re-register the existing task identity,
+    resume beating — and only if no coordinator answers within
+    ``orphan_deadline_s`` does it declare the executor orphaned
+    (``on_orphaned`` kills the user process: a headless gang must not
+    burn TPU time forever). A FAST coordinator restart is therefore
+    invisible to the user process. A FencedError at any point means a
+    LIVE coordinator rejected this executor as stale (old generation or
+    old session epoch) — orphaned immediately, no deadline."""
 
-    def __init__(self, client: RpcClient, task_id: str, interval_s: float):
+    def __init__(self, client: RpcClient, task_id: str, interval_s: float,
+                 session_id: int = -1,
+                 loss_threshold: int = 0,
+                 reconnect: Optional[Callable[[], RpcClient]] = None,
+                 orphan_deadline_s: float = 120.0,
+                 on_orphaned: Optional[Callable[[str], None]] = None):
         super().__init__(name="tony-heartbeater", daemon=True)
         self._client = client
         self._task_id = task_id
+        self._session_id = session_id
         self._interval_s = interval_s
+        self._loss_threshold = loss_threshold
+        self._reconnect = reconnect
+        self._orphan_deadline_s = orphan_deadline_s
+        self._on_orphaned = on_orphaned
+        self._misses = 0
         # _stop_evt, not _stop: threading.Thread has a private _stop()
         # method; shadowing it with an Event breaks Thread.join().
         self._stop_evt = threading.Event()
@@ -97,9 +119,52 @@ class Heartbeater(threading.Thread):
                 continue
             try:
                 self._client.call("task_executor_heartbeat",
-                                  task_id=self._task_id)
+                                  task_id=self._task_id,
+                                  session_id=self._session_id)
+                self._misses = 0
+            except FencedError as e:
+                self._orphan(f"fenced by a live coordinator: {e}")
+                return
             except Exception as e:  # noqa: BLE001
-                log.warning("heartbeat failed: %s", e)
+                self._misses += 1
+                log.warning("heartbeat failed (%d consecutive): %s",
+                            self._misses, e)
+                if self._loss_threshold and self._reconnect is not None \
+                        and self._misses >= self._loss_threshold:
+                    if not self._reenter():
+                        return
+
+    def _reenter(self) -> bool:
+        """Coordinator-loss mode: keep trying to re-resolve + re-register
+        until success, normal stop, fencing, or the orphan deadline."""
+        log.error("coordinator unreachable after %d heartbeats — entering "
+                  "reconnect mode (orphan deadline %.0fs)",
+                  self._misses, self._orphan_deadline_s)
+        deadline = time.monotonic() + self._orphan_deadline_s
+        while not self._stop_evt.is_set():
+            try:
+                self._client = self._reconnect()
+                self._misses = 0
+                log.warning("re-registered %s with the coordinator; "
+                            "resuming heartbeats", self._task_id)
+                return True
+            except FencedError as e:
+                self._orphan(f"fenced during re-registration: {e}")
+                return False
+            except Exception as e:  # noqa: BLE001
+                log.warning("re-registration attempt failed: %s", e)
+            if time.monotonic() >= deadline:
+                self._orphan(
+                    f"no coordinator within the {self._orphan_deadline_s:.0f}s"
+                    f" orphan deadline")
+                return False
+            if self._stop_evt.wait(min(self._interval_s, 2.0)):
+                return False       # normal stop while reconnecting
+        return False
+
+    def _orphan(self, reason: str) -> None:
+        if self._on_orphaned is not None and not self._stop_evt.is_set():
+            self._on_orphaned(reason)
 
     def stop(self) -> None:
         self._stop_evt.set()
@@ -136,10 +201,26 @@ class TaskExecutor:
         if tls_cert:
             from tony_tpu.rpc.wire import client_tls_context
             tls = client_tls_context(tls_cert)
-        self.client = RpcClient(
-            self.coordinator_host, self.coordinator_port,
-            token=e.get("TONY_RPC_TOKEN") or None,
-            max_retries=10, retry_sleep_s=2.0, tls=tls)
+        self._rpc_token = e.get("TONY_RPC_TOKEN") or None
+        self._tls = tls
+        # Crash-recovery contract: the launch-time coordinator generation
+        # fences every frame (adopted upward on reconnect, stale rejected),
+        # and the address file is how a RESTARTED coordinator — fresh
+        # ephemeral port — is re-resolved.
+        self.generation = int(
+            e.get(constants.COORDINATOR_GENERATION, "0") or 0)
+        self.coordinator_addr_file = e.get(constants.COORDINATOR_ADDR_FILE,
+                                           "")
+        self._rpc_max_retries = self.conf.get_int(K.RPC_MAX_RETRIES, 10)
+        self._rpc_retry_sleep_s = float(
+            self.conf.get(K.RPC_RETRY_SLEEP_S, 2.0) or 2.0)
+        # Per-call deadline so a WEDGED coordinator can't park the
+        # heartbeat thread forever (the precondition for loss detection).
+        self._rpc_call_timeout_s = float(
+            self.conf.get(K.RPC_CALL_TIMEOUT_S, 10.0) or 0) or None
+        self.client = self._make_client(self.coordinator_host,
+                                        self.coordinator_port)
+        self._orphaned_reason: Optional[str] = None
         self.hostname = e.get("TONY_ADVERTISED_HOST") or socket.gethostname()
         try:
             socket.getaddrinfo(self.hostname, None)
@@ -148,16 +229,88 @@ class TaskExecutor:
         self.rendezvous_port: Optional[ReservedPort] = None
         self.tb_port: Optional[ReservedPort] = None
 
+    # -- coordinator link (crash recovery) -------------------------------
+    def _make_client(self, host: str, port: int) -> RpcClient:
+        return RpcClient(
+            host, port, token=self._rpc_token,
+            max_retries=self._rpc_max_retries,
+            retry_sleep_s=self._rpc_retry_sleep_s,
+            tls=self._tls, generation=self.generation,
+            call_timeout_s=self._rpc_call_timeout_s)
+
+    def _resolve_coordinator(self) -> None:
+        """Re-read the coordinator address file, if one is reachable from
+        this host: a recovered coordinator binds a fresh ephemeral port
+        and rewrites the file. Unreadable/absent → keep the last known
+        address (a coordinator restarted on a fixed host:port needs no
+        file)."""
+        if not self.coordinator_addr_file:
+            return
+        try:
+            with open(self.coordinator_addr_file, encoding="utf-8") as f:
+                addr = json.load(f)
+            self.coordinator_host = addr["host"]
+            self.coordinator_port = int(addr["port"])
+            self._rpc_token = addr.get("token") or None
+        except (OSError, ValueError, KeyError) as e:
+            log.debug("could not re-resolve coordinator from %s: %s",
+                      self.coordinator_addr_file, e)
+
+    def _reconnect_coordinator(self) -> RpcClient:
+        """One reconnect attempt for the Heartbeater's loss mode:
+        re-resolve the address, dial with a SHORT budget (the outer loop
+        owns pacing), and re-register the existing task identity so the
+        recovered coordinator re-adopts this task without touching the
+        user process. Raises on failure; FencedError means a live
+        coordinator ruled this executor stale — terminal."""
+        from tony_tpu import faults
+
+        faults.check("executor.reregister")
+        self._resolve_coordinator()
+        client = RpcClient(
+            self.coordinator_host, self.coordinator_port,
+            token=self._rpc_token, max_retries=1, retry_sleep_s=0.1,
+            connect_timeout_s=5.0, tls=self._tls,
+            generation=self.generation,
+            call_timeout_s=self._rpc_call_timeout_s)
+        try:
+            client.call("register_worker_spec", task_id=self.task_id,
+                        host=self.hostname,
+                        port=self.rendezvous_port.port
+                        if self.rendezvous_port else 0,
+                        session_id=self.session_id)
+        except BaseException:
+            client.close()
+            raise
+        # Adopt the successor's generation for all future frames.
+        self.generation = max(self.generation, client.generation)
+        old, self.client = self.client, client
+        old.close()
+        return client
+
+    def _orphan_teardown(self, reason: str) -> None:
+        """No coordinator will ever hear from us again (deadline expired)
+        or a live one fenced us out as stale: deliver the TERM-grace-KILL
+        ladder to the user process group and let run() unwind. Without
+        this, a lost coordinator leaves headless executors training into
+        the void indefinitely."""
+        self._orphaned_reason = reason
+        log.error("executor orphaned (%s); stopping user process", reason)
+        p = _user_proc[0] if _user_proc else None
+        if p is not None and p.poll() is None:
+            grace = float(os.environ.get(constants.TASK_KILL_GRACE_ENV,
+                                         "5") or 5)
+            procutil.kill_process_groups([p.pid], grace_s=grace)
+
     # -- setup ----------------------------------------------------------
     def setup_ports(self) -> None:
         """Reserve the rendezvous port (+ TensorBoard port if chief);
         reference ``TaskExecutor.setupPorts`` :83-95."""
         reuse = self.conf.get_bool(K.TASK_REUSE_PORT) or \
             os.environ.get("TF_GRPC_REUSE_PORT", "").lower() == "true"
-        try:
-            self.rendezvous_port = ReservedPort(reuse=reuse)
-        except OSError:
-            self.rendezvous_port = ReservedPort(reuse=False)
+        # Missing SO_REUSEPORT degrades to the ephemeral strategy inside
+        # ReservedPort itself (with a warning), so no fallback here.
+        self.rendezvous_port = ReservedPort(reuse=reuse)
         if self.is_chief:
             self.tb_port = ReservedPort(reuse=False)
             try:
@@ -191,7 +344,12 @@ class TaskExecutor:
             try:
                 return self.client.call(
                     "register_worker_spec", task_id=self.task_id,
-                    host=self.hostname, port=self.rendezvous_port.port)
+                    host=self.hostname, port=self.rendezvous_port.port,
+                    session_id=self.session_id)
+            except FencedError:
+                # A live coordinator ruled this executor stale (old
+                # generation/epoch): polling cannot fix that — abort.
+                raise
             except Exception as e:  # noqa: BLE001
                 log.warning("register_worker_spec failed: %s", e)
                 return None
@@ -250,7 +408,14 @@ class TaskExecutor:
         self.setup_ports()
         hb = Heartbeater(
             self.client, self.task_id,
-            self.conf.get_int(K.TASK_HEARTBEAT_INTERVAL_MS, 1000) / 1000.0)
+            self.conf.get_int(K.TASK_HEARTBEAT_INTERVAL_MS, 1000) / 1000.0,
+            session_id=self.session_id,
+            loss_threshold=self.conf.get_int(
+                K.TASK_COORDINATOR_LOSS_HEARTBEATS, 3),
+            reconnect=self._reconnect_coordinator,
+            orphan_deadline_s=float(
+                self.conf.get_int(K.TASK_ORPHAN_DEADLINE_S, 120)),
+            on_orphaned=self._orphan_teardown)
         hb.start()
         metrics_file = os.path.join(os.getcwd(), "user-metrics.json")
         monitor = TaskMonitor(
@@ -261,7 +426,11 @@ class TaskExecutor:
                                          5000) / 1000.0,
             metrics_file=metrics_file)
 
-        cluster_spec = self.register_and_get_cluster_spec()
+        try:
+            cluster_spec = self.register_and_get_cluster_spec()
+        except FencedError as e:
+            log.error("registration fenced for %s: %s", self.task_id, e)
+            return constants.EXIT_KILLED
         if cluster_spec is None:
             log.error("registration barrier timed out for %s", self.task_id)
             return constants.EXIT_FAILURE
@@ -351,14 +520,56 @@ class TaskExecutor:
         log.info("user process for %s exited with %d", self.task_id, exit_code)
         self._maybe_upload_profile()
 
-        try:
-            self.client.call("register_execution_result",
-                             task_id=self.task_id, exit_code=exit_code)
-        except Exception as e:  # noqa: BLE001
-            log.warning("failed to report execution result: %s", e)
+        if self._orphaned_reason is not None:
+            # The user process was stopped BY the orphan/fencing teardown:
+            # there is no coordinator that wants this result (dead, or a
+            # successor that fenced us out of a newer epoch). Reporting
+            # the exit would be wrong on top of useless — a stale result
+            # landing in a recovered session is exactly what the epoch
+            # fence exists to stop.
+            hb.stop()
+            log.error("exiting as orphaned executor: %s",
+                      self._orphaned_reason)
+            return constants.EXIT_KILLED
         hb.stop()
+        self._report_result_with_recovery(exit_code)
         self._maybe_skew_sleep()
         return exit_code
+
+    def _report_result_with_recovery(self, exit_code: int) -> None:
+        """Deliver the exit code, surviving a coordinator outage. A task
+        that FINISHES while the coordinator is down would otherwise
+        discard its result after one failed call — and the recovered
+        coordinator, finding nobody to re-adopt, would burn a retry epoch
+        re-running work that already completed (caught live in the
+        recovery drill). Same contract as the heartbeat loop: re-resolve
+        + retry inside the orphan deadline; a FencedError (stale epoch
+        after a reset, or a superseding generation) is terminal — that
+        result belongs to a world that no longer exists."""
+        deadline = time.monotonic() + float(
+            self.conf.get_int(K.TASK_ORPHAN_DEADLINE_S, 120))
+        while True:
+            try:
+                self.client.call("register_execution_result",
+                                 task_id=self.task_id, exit_code=exit_code,
+                                 session_id=self.session_id)
+                return
+            except FencedError as e:
+                log.warning("result for %s fenced by a live coordinator: "
+                            "%s", self.task_id, e)
+                return
+            except Exception as e:  # noqa: BLE001
+                if time.monotonic() >= deadline:
+                    log.warning("failed to report execution result within "
+                                "the orphan deadline: %s", e)
+                    return
+                log.info("result report failed (%s); re-resolving the "
+                         "coordinator and retrying", e)
+                time.sleep(1.0)
+                self._resolve_coordinator()
+                old, self.client = self.client, self._make_client(
+                    self.coordinator_host, self.coordinator_port)
+                old.close()
 
     def _maybe_upload_profile(self) -> None:
         """Remote-store jobs: ship the chief's captured traces home (the

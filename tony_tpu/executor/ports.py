@@ -17,8 +17,11 @@ port over. Two strategies:
 
 from __future__ import annotations
 
+import logging
 import socket
 from typing import Optional
+
+log = logging.getLogger(__name__)
 
 
 class ReservedPort:
@@ -27,9 +30,22 @@ class ReservedPort:
         self._sock: Optional[socket.socket] = socket.socket(
             socket.AF_INET, socket.SOCK_STREAM)
         if reuse:
-            if not hasattr(socket, "SO_REUSEPORT"):
-                raise OSError("SO_REUSEPORT not supported on this platform")
-            self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEPORT, 1)
+            # SO_REUSEPORT is a per-platform/per-kernel nicety, and the
+            # reusable strategy is an OPTIMIZATION (no release-before-exec
+            # race window). Where it's missing, degrade to the ephemeral
+            # strategy with a warning instead of failing the executor —
+            # the reference behaves the same by only offering ReusablePort
+            # where the helper works (ReusablePort.java:151-236).
+            try:
+                if not hasattr(socket, "SO_REUSEPORT"):
+                    raise OSError(
+                        "SO_REUSEPORT not supported on this platform")
+                self._sock.setsockopt(socket.SOL_SOCKET,
+                                      socket.SO_REUSEPORT, 1)
+            except OSError as e:
+                log.warning("SO_REUSEPORT unavailable (%s); falling back "
+                            "to the ephemeral port strategy", e)
+                self.reuse = False
         self._sock.bind(("", 0))
         self._sock.listen(1)
         self.port: int = self._sock.getsockname()[1]

@@ -17,6 +17,10 @@ site                      where it fires
 ``storage.put``           Store.put_file via the retrying wrapper
 ``storage.get``           Store.get_file via the retrying wrapper
 ``checkpoint.save``       CheckpointManager.save, before the orbax call
+``coordinator.crash``     Coordinator._monitor loop: hard os._exit(137) —
+                          the SIGKILL shape that --recover must survive
+``executor.reregister``   executor reconnect: drops a re-registration
+                          attempt during coordinator-loss recovery
 ========================  =====================================================
 
 Spec grammar (the value of ``tony.fault.<site>`` conf keys, or one
@@ -62,7 +66,8 @@ FAULTS_ENV = "TONY_FAULTS"
 #: the canonical site names (kept in lockstep with the conf keys in
 #: tony_tpu/conf/keys.py: ``tony.fault.<site with . -> ->``)
 SITES = ("rpc.connect", "rpc.send", "heartbeat", "executor.spawn",
-         "storage.put", "storage.get", "checkpoint.save")
+         "storage.put", "storage.get", "checkpoint.save",
+         "coordinator.crash", "executor.reregister")
 
 
 class InjectedFault(ConnectionError):

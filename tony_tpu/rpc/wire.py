@@ -32,12 +32,23 @@ channel ``rpc/MetricsRpc.java``). Differences, on purpose:
 
 Wire format: 4-byte big-endian length, then a msgpack map per frame.
 - hello (server → client, once per connection):
-    {"tony-rpc": 3, "nonce": bytes, "auth": bool}
+    {"tony-rpc": 3, "nonce": bytes, "auth": bool[, "g": int]}
 - signed frame: {"p": <inner msgpack bytes>, "m": <hmac>}; unsigned: {"p"}
   (the client's FIRST frame additionally carries {"cn": bytes}, its
   connection nonce; all MACs use server_nonce + client_nonce)
-- inner request:  {"id": int, "method": str, "args": {...}}
-- inner response: {"id": int, "ok": bool, "result"| "error"}
+- inner request:  {"id": int, "method": str, "args": {...}[, "gen": int]}
+- inner response: {"id": int, "ok": bool, "result"| "error"[, "g": int]}
+
+Generation fencing (coordinator crash recovery): a recovered coordinator
+starts with a bumped, journal-persisted generation and stamps it into the
+hello and every response ("g"); fenced clients stamp theirs into every
+request ("gen"). Either side seeing a LOWER generation than its own is
+talking to a zombie from before a recovery — the split-brain case — and
+rejects with StaleGenerationError, which is terminal (never retried: a
+stale peer does not become fresh by retrying). Seeing a HIGHER generation
+means a legitimate successor coordinator took over: clients adopt it
+(monotonically) and carry on — that is the executor re-registration path.
+Generation 0 on either side means unfenced and skips all checks.
 """
 
 from __future__ import annotations
@@ -96,6 +107,27 @@ class RpcError(RuntimeError):
 
 class AuthError(RpcError):
     pass
+
+
+class RpcTimeout(RpcError):
+    """A per-call send/recv deadline expired: the peer is up enough to
+    hold the TCP connection but not answering — the WEDGED-coordinator
+    shape, distinct from connection-refused. Classified INFRA_TRANSIENT
+    (``failure_domain``) so supervisors treat it like any other transient
+    infra failure rather than a user error."""
+
+    failure_domain = "INFRA_TRANSIENT"
+
+
+class FencedError(RpcError):
+    """Terminal fencing rejection: the peer belongs to a superseded
+    coordinator generation or a stale session epoch. Never retried —
+    retrying cannot make a zombie fresh; the holder must tear itself
+    down (executors: kill the user process and exit)."""
+
+
+class StaleGenerationError(FencedError):
+    """Generation fence specifically (see module docstring)."""
 
 
 def _send_frame(sock: socket.socket, obj: Any) -> None:
@@ -166,10 +198,19 @@ class RpcServer:
 
     def __init__(self, service: Any, host: str = "127.0.0.1", port: int = 0,
                  token: Optional[str] = None,
-                 tls: Optional[ssl.SSLContext] = None):
+                 tls: Optional[ssl.SSLContext] = None,
+                 generation: int = 0,
+                 on_superseded: Optional[Any] = None):
         self._service = service
         self._token = token or None     # "" = unauthenticated, like None
         self._tls = tls
+        # Coordinator generation this server speaks for (0 = unfenced).
+        # Fixed for the server's lifetime: a recovery is a NEW process.
+        self._generation = int(generation)
+        # Called (once per observation, with the newer generation) when a
+        # request proves a SUCCESSOR coordinator exists — this server is
+        # the zombie side of a split brain and should stand down.
+        self._on_superseded = on_superseded
         outer = self
 
         class Handler(socketserver.BaseRequestHandler):
@@ -188,9 +229,12 @@ class RpcServer:
                                   self.client_address, e)
                         return
                 nonce = os.urandom(16)
+                hello = {"tony-rpc": 3, "nonce": nonce,
+                         "auth": outer._token is not None}
+                if outer._generation:
+                    hello["g"] = outer._generation
                 try:
-                    _send_frame(sock, {"tony-rpc": 3, "nonce": nonce,
-                                       "auth": outer._token is not None})
+                    _send_frame(sock, hello)
                 except OSError:
                     return
                 last_id = 0
@@ -231,15 +275,45 @@ class RpcServer:
                     except (RpcError, ConnectionError, OSError):
                         return
                     rid = req.get("id", 0) if isinstance(req, dict) else 0
+                    req_gen = int(req.get("gen", 0) or 0) \
+                        if isinstance(req, dict) else 0
                     if outer._token is not None and rid <= last_id:
                         # Replay of a captured frame (MAC valid, id seen):
                         # the nonce pins frames to this connection, the id
                         # ordering pins them to one use.
                         resp = {"id": rid, "ok": False,
                                 "error": "AuthError: replayed request id"}
+                    elif outer._generation and req_gen \
+                            and req_gen < outer._generation:
+                        # Frame from before a coordinator recovery: fence
+                        # it out before it can touch any state. Terminal
+                        # for the sender (client never retries this).
+                        resp = {"id": rid, "ok": False,
+                                "error": f"StaleGenerationError: frame "
+                                         f"from generation {req_gen}; "
+                                         f"coordinator is at generation "
+                                         f"{outer._generation}"}
+                    elif outer._generation and req_gen \
+                            and req_gen > outer._generation:
+                        # The sender has seen a NEWER coordinator: WE are
+                        # the stale side of the split brain. Refuse the
+                        # frame and tell the owner to stand down.
+                        resp = {"id": rid, "ok": False,
+                                "error": f"StaleGenerationError: this "
+                                         f"coordinator (generation "
+                                         f"{outer._generation}) was "
+                                         f"superseded by generation "
+                                         f"{req_gen}"}
+                        if outer._on_superseded is not None:
+                            try:
+                                outer._on_superseded(req_gen)
+                            except Exception:  # noqa: BLE001
+                                log.exception("on_superseded callback")
                     else:
                         last_id = max(last_id, rid)
                         resp = outer._dispatch(req)
+                    if outer._generation:
+                        resp["g"] = outer._generation
                     try:
                         _send_signed(sock, resp, outer._token, nonce,
                                      _TO_CLIENT)
@@ -314,10 +388,20 @@ class RpcClient:
     def __init__(self, host: str, port: int, token: Optional[str] = None,
                  max_retries: int = 10, retry_sleep_s: float = 2.0,
                  connect_timeout_s: float = 10.0,
-                 tls: Optional[ssl.SSLContext] = None):
+                 tls: Optional[ssl.SSLContext] = None,
+                 generation: int = 0,
+                 call_timeout_s: Optional[float] = None):
         self._addr = (host, port)
         self._token = token or None     # "" = unauthenticated, like None
         self._tls = tls
+        # Lowest coordinator generation this client will talk to (0 =
+        # unfenced). Adopted UPWARD from server hellos/responses — a
+        # successor coordinator is legitimate; a lower one is a zombie.
+        self._generation = int(generation)
+        # Per-call send/recv deadline. Without it a wedged (accepted the
+        # connection, never answers) coordinator parks the caller forever
+        # — the executor heartbeat thread being the critical victim.
+        self._call_timeout_s = call_timeout_s or None
         self._max_retries = max_retries
         self._retry_sleep_s = retry_sleep_s
         self._retry_policy = RetryPolicy(
@@ -352,10 +436,13 @@ class RpcClient:
         except (OSError, RpcError):
             sock.close()
             raise
-        sock.settimeout(None)
+        # Armed for every subsequent send/recv on this connection: a
+        # wedged peer surfaces as socket.timeout → RpcTimeout, not a hang.
+        sock.settimeout(self._call_timeout_s)
         if not isinstance(hello, dict) or "nonce" not in hello:
             sock.close()
             raise RpcError("peer is not a tony-rpc server (no hello)")
+        self._check_peer_generation(int(hello.get("g", 0) or 0), sock)
         if self._token is not None and hello.get("tony-rpc") != 3:
             # A v2 server verifies MACs over its nonce alone; our dual-nonce
             # MACs would fail there with a misleading "bad frame MAC". Name
@@ -376,6 +463,28 @@ class RpcClient:
         self._id = 0
         return sock
 
+    def _check_peer_generation(self, peer_gen: int,
+                               sock: Optional[socket.socket] = None) -> None:
+        """Fence or adopt: a LOWER peer generation is a zombie coordinator
+        (terminal StaleGenerationError); a higher one is a legitimate
+        successor and is adopted monotonically. No-op when either side is
+        unfenced (generation 0)."""
+        if not peer_gen or not self._generation:
+            return
+        if peer_gen < self._generation:
+            if sock is not None:
+                sock.close()
+            raise StaleGenerationError(
+                f"peer at {self._addr} speaks for coordinator generation "
+                f"{peer_gen}; generation {self._generation} has already "
+                f"been observed — refusing the stale coordinator")
+        self._generation = max(self._generation, peer_gen)
+
+    @property
+    def generation(self) -> int:
+        """Highest coordinator generation observed (0 = unfenced)."""
+        return self._generation
+
     def call(self, method: str, **args: Any) -> Any:
         last_err: Optional[Exception] = None
         with self._lock:
@@ -389,6 +498,8 @@ class RpcClient:
                     faults.check("rpc.send")
                     self._id += 1
                     req = {"id": self._id, "method": method, "args": args}
+                    if self._generation:
+                        req["gen"] = self._generation
                     extra = {"cn": self._client_nonce} \
                         if self._token and self._hello_pending else None
                     _send_signed(self._sock, req, self._token, self._nonce,
@@ -407,13 +518,22 @@ class RpcClient:
                         raise AuthError(
                             f"response id {resp.get('id')} does not match "
                             f"request {self._id} (replayed response?)")
+                    self._check_peer_generation(
+                        int(resp.get("g", 0) or 0)
+                        if isinstance(resp, dict) else 0)
                     if not resp.get("ok"):
                         err = resp.get("error", "unknown rpc error")
                         if err.startswith("AuthError"):
                             raise AuthError(err)
+                        if err.startswith("StaleGenerationError"):
+                            raise StaleGenerationError(err)
+                        if err.startswith("FencedError"):
+                            raise FencedError(err)
                         raise RpcError(err)
                     return resp.get("result")
-                except AuthError:
+                except (AuthError, FencedError):
+                    # Both are terminal verdicts about THIS peer/process
+                    # pair — retrying cannot change either.
                     self._close_locked()
                     raise
                 except (ConnectionError, OSError) as e:
@@ -421,6 +541,12 @@ class RpcClient:
                     self._close_locked()
                     if attempt < self._max_retries - 1:
                         time.sleep(self._retry_policy.delay_s(attempt))
+        if isinstance(last_err, socket.timeout):
+            raise RpcTimeout(
+                f"rpc {method} to {self._addr} timed out after "
+                f"{self._max_retries} attempts of {self._call_timeout_s}s "
+                f"each [INFRA_TRANSIENT]: the peer holds the connection "
+                f"but does not answer")
         raise RpcError(
             f"rpc {method} to {self._addr} failed after "
             f"{self._max_retries} attempts: {last_err}")

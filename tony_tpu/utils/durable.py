@@ -1,0 +1,119 @@
+"""Crash-durable file primitives shared by the session journal, the event
+stream, and the frozen-config artifact.
+
+A coordinator can be SIGKILLed between any two instructions (that is the
+whole premise of ``--recover``), so every write that recovery or the
+history portal later depends on must be one of exactly two shapes:
+
+- **atomic replace**: write a temp file in the SAME directory, fsync it,
+  ``os.replace`` over the target, fsync the directory — a reader sees
+  either the old bytes or the new bytes, never a torn mix
+  (``atomic_write``/``durable_replace``);
+- **append-only log**: appended records are fsync'd before the caller
+  proceeds, and the READER tolerates a torn final record (the crash
+  window between ``write`` and ``fsync``) by degrading to
+  replay-of-prefix (``AppendLog``; readers: journal.replay,
+  events.read_events).
+
+POSIX note: ``os.replace`` is atomic on the same filesystem but the
+RENAME itself is only durable once the parent directory is fsync'd —
+skipping that step is how "the rename happened but vanished after the
+power cut" bugs are born.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+from typing import IO, Optional
+
+log = logging.getLogger(__name__)
+
+
+def fsync_dir(path: str) -> None:
+    """fsync a DIRECTORY so renames/creates inside it survive a crash.
+    Best-effort: some filesystems (and all of Windows) refuse O_RDONLY
+    on directories — durability then degrades to the OS's own schedule,
+    which is still no worse than the pre-helper behaviour."""
+    try:
+        fd = os.open(path, os.O_RDONLY)
+    except OSError:
+        return
+    try:
+        os.fsync(fd)
+    except OSError:
+        pass
+    finally:
+        os.close(fd)
+
+
+def atomic_write(path: str, data: bytes) -> None:
+    """Atomically (re)place ``path`` with ``data``: temp file in the same
+    directory → write → flush+fsync → rename → directory fsync."""
+    d = os.path.dirname(os.path.abspath(path))
+    os.makedirs(d, exist_ok=True)
+    tmp = os.path.join(d, f".{os.path.basename(path)}.tmp.{os.getpid()}")
+    fd = os.open(tmp, os.O_WRONLY | os.O_CREAT | os.O_TRUNC, 0o644)
+    try:
+        with os.fdopen(fd, "wb") as f:
+            f.write(data)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+    except BaseException:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise
+    fsync_dir(d)
+
+
+def durable_replace(src: str, dst: str) -> None:
+    """``os.replace`` + directory fsync (same-directory renames like the
+    in-progress → final history file flip)."""
+    os.replace(src, dst)
+    fsync_dir(os.path.dirname(os.path.abspath(dst)))
+
+
+def fsync_file(f: IO) -> None:
+    """flush + fsync an open file object; best-effort on exotic streams
+    without a real descriptor (StringIO in tests)."""
+    try:
+        f.flush()
+        os.fsync(f.fileno())
+    except (OSError, ValueError, AttributeError):
+        pass
+
+
+class AppendLog:
+    """fsync-per-append log file (the write-ahead journal's substrate).
+
+    Every ``append`` returns only after the record is flushed AND
+    fsync'd: a crash immediately after a state transition must find that
+    transition on disk — otherwise replay resurrects pre-transition
+    state and the recovered coordinator disagrees with the executors
+    that already observed the transition over RPC.
+    """
+
+    def __init__(self, path: str):
+        self.path = path
+        d = os.path.dirname(os.path.abspath(path))
+        os.makedirs(d, exist_ok=True)
+        existed = os.path.exists(path)
+        self._f: Optional[IO] = open(path, "ab")
+        if not existed:
+            # The file CREATION itself must survive a crash too.
+            fsync_dir(d)
+
+    def append(self, record: bytes) -> None:
+        if self._f is None:
+            raise ValueError(f"append log {self.path} is closed")
+        self._f.write(record)
+        fsync_file(self._f)
+
+    def close(self) -> None:
+        if self._f is not None:
+            fsync_file(self._f)
+            self._f.close()
+            self._f = None
