@@ -39,6 +39,13 @@ def live_pids_with_env(needle: str) -> List[Tuple[int, str]]:
     return out
 
 
+def job_env_marker(app_id: str) -> str:
+    """The canonical per-job environment needle for orphan scans: every
+    process execed on behalf of a job — executors AND the user trees they
+    supervise — carries TONY_APP_ID in its environment."""
+    return f"TONY_APP_ID={app_id}"
+
+
 def assert_no_orphans(needle: str, timeout_s: float = 8.0) -> None:
     """Poll until no process with ``needle`` in its environment survives;
     fail listing the survivors. The poll window absorbs normal teardown

@@ -1,0 +1,17 @@
+"""Hang-drill script: loops telemetry-instrumented steps until the
+PUBLISHED counter reaches the target. Under the ``user.hang`` fault
+(e.g. ``after:3``) recordings past the first N are dropped, so the
+counter freezes while the process keeps spinning — heartbeats alive,
+progress frozen: the exact shape the coordinator's progress-based hang
+detection must catch, stack-dump, and kill. Without the fault (the retry
+epoch) it records every step and exits 0."""
+import os
+import time
+
+import tony_tpu  # noqa: F401  (starts the reporter + arms TONY_FAULTS)
+from tony_tpu import telemetry
+
+target = int(os.environ.get("TONY_TEST_STEPS", "8"))
+while telemetry.step_stats().get("steps_completed", 0) < target:
+    with telemetry.step():
+        time.sleep(0.05)

@@ -1,0 +1,14 @@
+"""Straggler-drill script: a fixed number of telemetry-instrumented
+steps. The ``user.slow_step`` fault (``amt:X,task:<job>:<idx>``) stretches
+ONE gang member's steps, skewing its rate below the gang median — the
+shape straggler policing must flag (and, with restart enabled, kill into
+a retry epoch)."""
+import os
+import time
+
+import tony_tpu  # noqa: F401  (starts the reporter + arms TONY_FAULTS)
+from tony_tpu import telemetry
+
+for _ in range(int(os.environ.get("TONY_TEST_STEPS", "100"))):
+    with telemetry.step():
+        time.sleep(0.02)

@@ -97,6 +97,13 @@ def test_e2e_missed_heartbeats_fail_job(tmp_path, monkeypatch):
     assert code == constants.EXIT_FAILURE
     assert rec.finished[0] == "FAILED"
     assert "dead" in (rec.finished[1].get("failure_reason") or "")
+    # The deemed-dead TASK_FINISHED carries the postmortem context that
+    # distinguishes "executor vanished" (stale heartbeat age) from
+    # "executor alive, user hung" (the TASK_HUNG path).
+    evs = _finished_events(tmp_path, rec.app_id)
+    fin = [e for e in evs if e.type == "TASK_FINISHED"][0].payload
+    assert fin["last_heartbeat_age_s"] > 0.6, fin  # past the hb expiry
+    assert "progress" in fin
 
 
 def test_e2e_skewed_straggler_still_passes(tmp_path, monkeypatch):
@@ -396,6 +403,116 @@ def test_e2e_preemption_retries_free_of_the_retry_budget(tmp_path,
     domains = [e.payload.get("failure_domain") for e in evs
                if e.type == "TASK_FINISHED"]
     assert "PREEMPTION" in domains, domains
+
+
+def test_e2e_injected_hang_detected_dumped_and_retried(tmp_path):
+    """The progress-liveness drill (coordinator/liveness.py): epoch 0's
+    user process keeps running AND heartbeating but its step counter
+    freezes (user.hang after:3, session:0) — the old heartbeat monitor
+    would never notice. The coordinator must declare TASK_HUNG within the
+    progress deadline, get an all-thread stack dump into the task log via
+    the executor's dump signal, kill the task into an INFRA_TRANSIENT
+    retry, and the fault-free epoch 1 completes — with no process leaked
+    from the hang-kill."""
+    conf = make_conf(tmp_path, "hang_after_steps.py", workers=1, extra={
+        K.TASK_HEARTBEAT_INTERVAL_MS: 100,
+        K.TASK_PROGRESS_TIMEOUT_S: 3,
+        K.TASK_PROGRESS_WARMUP_S: 60,
+        K.TASK_HANG_DUMP_GRACE_S: 1,
+        K.APPLICATION_RETRY_COUNT: 1,
+    })
+    # The reporter must publish the step counter faster than the
+    # progress deadline samples it.
+    conf.set(K.EXECUTION_ENV, "TONY_TELEMETRY_INTERVAL_S=0.2")
+    conf.set(K.fault_key("user.hang"), "after:3,session:0")
+    client, rec, code = submit(conf, tmp_path)
+    assert code == 0, _dump_task_logs(client)
+    assert rec.finished[0] == "SUCCEEDED"
+    assert rec.finished[1].get("session_id") == 1, "retry epoch expected"
+    evs = _finished_events(tmp_path, rec.app_id)
+    hung = [e for e in evs if e.type == "TASK_HUNG"]
+    assert hung, "no TASK_HUNG event"
+    assert hung[0].payload["task"] == "worker:0"
+    assert hung[0].payload["steps"] == 3
+    assert hung[0].payload["stalled_s"] >= 3
+    # The hang-kill TASK_FINISHED: INFRA_TRANSIENT, with the postmortem
+    # context (last heartbeat age ~fresh — the executor was ALIVE — plus
+    # the progress snapshot and the captured stack dump).
+    kills = [e for e in evs if e.type == "TASK_FINISHED"
+             and e.payload.get("failure_domain") == "INFRA_TRANSIENT"]
+    assert kills, "no INFRA_TRANSIENT task finish"
+    kill = kills[0].payload
+    assert kill["exit_code"] == constants.EXIT_KILLED
+    assert kill["last_heartbeat_age_s"] < 5.0, \
+        "heartbeats were alive — this must not look like a vanished executor"
+    assert kill["progress"].get("state") == "hung"
+    assert "hang_after_steps" in kill.get("stack_dump_excerpt", ""), \
+        f"no stack dump captured: {kill.get('stack_dump_excerpt')!r}"
+    # The dump also landed in the task's own stderr log.
+    stderr_logs = [p for p in kill.get("logs", [])
+                   if p.endswith("stderr.log")]
+    assert stderr_logs
+    with open(stderr_logs[0], encoding="utf-8", errors="replace") as f:
+        assert "most recent call first" in f.read()
+    # Kill-chain contract: the hang kill reaped the user process group.
+    from procwatch import assert_no_orphans, job_env_marker
+
+    assert_no_orphans(job_env_marker(rec.app_id))
+
+
+def test_e2e_injected_straggler_flagged_and_restarted(tmp_path):
+    """Gang straggler policing drill: worker:1's steps are stretched
+    (user.slow_step amt, task-filtered) so its rate falls below half the
+    gang median; TASK_STRAGGLER fires with rate vs median, and — restart
+    policing enabled — the task is proactively killed into an
+    INFRA_TRANSIENT retry whose fault-free epoch completes."""
+    conf = make_conf(tmp_path, "steps_for.py", workers=2, extra={
+        K.TASK_HEARTBEAT_INTERVAL_MS: 100,
+        K.TASK_STRAGGLER_FRACTION: 0.5,
+        K.TASK_STRAGGLER_WINDOW_S: 1,
+        K.TASK_STRAGGLER_RESTART: True,
+        K.APPLICATION_RETRY_COUNT: 1,
+    })
+    conf.set(K.EXECUTION_ENV,
+             "TONY_TELEMETRY_INTERVAL_S=0.2,TONY_TEST_STEPS=150")
+    conf.set(K.fault_key("user.slow_step"),
+             "every:1,amt:0.25,task:worker:1,session:0")
+    client, rec, code = submit(conf, tmp_path)
+    assert code == 0, _dump_task_logs(client)
+    assert rec.finished[0] == "SUCCEEDED"
+    assert rec.finished[1].get("session_id") == 1, "retry epoch expected"
+    evs = _finished_events(tmp_path, rec.app_id)
+    strag = [e for e in evs if e.type == "TASK_STRAGGLER"]
+    assert strag, "no TASK_STRAGGLER event"
+    p = strag[0].payload
+    assert p["task"] == "worker:1"
+    assert p["rate_steps_per_s"] < 0.5 * p["median_steps_per_s"]
+    from procwatch import assert_no_orphans, job_env_marker
+
+    assert_no_orphans(job_env_marker(rec.app_id))
+
+
+def test_e2e_uninstrumented_task_keeps_heartbeat_liveness(tmp_path):
+    """Graceful degradation: progress liveness configured with a TIGHT
+    deadline, but the user script has no telemetry instrumentation — the
+    task must run to completion on heartbeat-only liveness (zero false
+    hang kills), with the one-time TASK_PROGRESS_UNINSTRUMENTED warning
+    in the event stream."""
+    conf = make_conf(tmp_path, "sleep_5.py", workers=1, extra={
+        K.TASK_HEARTBEAT_INTERVAL_MS: 100,
+        K.TASK_PROGRESS_TIMEOUT_S: 1,
+        K.TASK_PROGRESS_WARMUP_S: 1,
+    })
+    client, rec, code = submit(conf, tmp_path)
+    assert code == 0, _dump_task_logs(client)
+    assert rec.finished[0] == "SUCCEEDED"
+    assert rec.finished[1].get("session_id") == 0, \
+        "a false hang kill burned a retry epoch"
+    evs = _finished_events(tmp_path, rec.app_id)
+    assert not [e for e in evs if e.type == "TASK_HUNG"]
+    warn = [e for e in evs if e.type == "TASK_PROGRESS_UNINSTRUMENTED"]
+    assert len(warn) == 1, "exactly one degradation warning expected"
+    assert warn[0].payload["task"] == "worker:0"
 
 
 def test_e2e_preempted_epoch_with_torn_checkpoint_resumes_verified(

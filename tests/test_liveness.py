@@ -1,0 +1,453 @@
+"""Fast deterministic unit suite for progress-based liveness
+(tony_tpu/coordinator/liveness.py): warmup grace, progress-deadline
+expiry and the staged hung→dump→kill machine, degraded heartbeat-only
+mode, straggler median math at 1- and 2-task gang widths, journal replay
+of progress state, and the new user.hang / user.slow_step fault sites.
+Select with ``pytest -m faults``.
+"""
+
+import time
+
+import pytest
+
+from tony_tpu import faults, telemetry
+from tony_tpu.conf.config import TonyTpuConfig
+from tony_tpu.conf import keys as K
+from tony_tpu.coordinator import journal, liveness
+from tony_tpu.coordinator.liveness import ProgressTracker
+
+pytestmark = pytest.mark.faults
+
+
+@pytest.fixture(autouse=True)
+def _clean_injector():
+    faults.uninstall()
+    yield
+    faults.uninstall()
+
+
+class Clock:
+    def __init__(self, t: float = 1000.0):
+        self.t = t
+
+    def __call__(self) -> float:
+        return self.t
+
+    def tick(self, dt: float) -> float:
+        self.t += dt
+        return self.t
+
+
+def make_tracker(clock, **conf_kv):
+    conf = TonyTpuConfig()
+    defaults = {
+        K.TASK_PROGRESS_TIMEOUT_S: 10,
+        K.TASK_PROGRESS_WARMUP_S: 20,
+        K.TASK_HANG_DUMP_GRACE_S: 3,
+        K.TASK_STRAGGLER_WINDOW_S: 4,
+    }
+    defaults.update(conf_kv)
+    for k, v in defaults.items():
+        conf.set(k, v)
+    return ProgressTracker(conf, now_fn=clock)
+
+
+def kinds(actions):
+    return [a.kind for a in actions]
+
+
+# ---------------------------------------------------------------------------
+# Warmup grace + degraded heartbeat-only mode
+# ---------------------------------------------------------------------------
+def test_warmup_no_steps_never_hangs_warns_once():
+    """A task that never reports a step counter is NEVER subject to the
+    progress deadline — it degrades to heartbeat-only liveness with a
+    one-time warning after the warmup window."""
+    clock = Clock()
+    tr = make_tracker(clock)
+    tr.track("worker:0", "worker")
+    clock.tick(19)                      # inside warmup
+    assert tr.poll() == []
+    clock.tick(2)                       # past warmup, WAY past timeout
+    acts = tr.poll()
+    assert kinds(acts) == [liveness.WARN_UNINSTRUMENTED]
+    assert acts[0].task_id == "worker:0"
+    clock.tick(500)                     # never warns twice, never kills
+    assert tr.poll() == []
+    assert tr.snapshot("worker:0") == {"state": "heartbeat-only"}
+
+
+def test_degraded_mode_with_none_beacons():
+    """Explicit None beacons (executor sees no steps_completed) keep the
+    task unarmed: warn once, never a false kill."""
+    clock = Clock()
+    tr = make_tracker(clock)
+    tr.track("worker:0", "worker")
+    for _ in range(10):
+        assert tr.observe("worker:0", None) is False
+        clock.tick(5)
+    acts = tr.poll()
+    assert kinds(acts) == [liveness.WARN_UNINSTRUMENTED]
+    clock.tick(100)
+    assert tr.poll() == []
+
+
+def test_warmup_longer_than_timeout_no_false_positive():
+    """Compile/restore time beyond the progress deadline must not trip
+    detection: the deadline only arms at the FIRST reported step."""
+    clock = Clock()
+    tr = make_tracker(clock, **{K.TASK_PROGRESS_WARMUP_S: 100})
+    tr.track("worker:0", "worker")
+    clock.tick(50)                      # 5× the timeout, still compiling
+    assert tr.poll() == []
+    tr.observe("worker:0", {"steps": 1, "age_s": 0})
+    clock.tick(9)
+    assert tr.poll() == []              # armed, inside deadline
+    clock.tick(2)
+    assert kinds(tr.poll()) == [liveness.HUNG]
+
+
+# ---------------------------------------------------------------------------
+# Hang state machine: declare → dump directive → grace → kill
+# ---------------------------------------------------------------------------
+def test_progress_deadline_expiry_staged_hang_then_kill():
+    clock = Clock()
+    tr = make_tracker(clock)
+    tr.track("worker:0", "worker")
+    tr.observe("worker:0", {"steps": 5, "age_s": 0})
+    clock.tick(10.5)                    # stalled past the 10 s deadline
+    acts = tr.poll()
+    assert kinds(acts) == [liveness.HUNG]
+    assert acts[0].info["steps"] == 5
+    assert acts[0].info["stalled_s"] == pytest.approx(10.5)
+    # The dump directive is handed out exactly once.
+    assert tr.should_dump("worker:0") is True
+    assert tr.should_dump("worker:0") is False
+    clock.tick(2)                       # inside the dump grace
+    assert tr.poll() == []
+    clock.tick(1.5)                     # grace elapsed → kill
+    acts = tr.poll()
+    assert kinds(acts) == [liveness.HANG_KILL]
+    assert acts[0].info["dump_delivered"] is True
+    # Terminal for the tracker: no further actions, ever.
+    clock.tick(100)
+    assert tr.poll() == []
+
+
+def test_advance_during_dump_grace_cancels_the_verdict():
+    clock = Clock()
+    tr = make_tracker(clock)
+    tr.track("worker:0", "worker")
+    tr.observe("worker:0", {"steps": 5, "age_s": 0})
+    clock.tick(11)
+    assert kinds(tr.poll()) == [liveness.HUNG]
+    clock.tick(1)
+    tr.observe("worker:0", {"steps": 6, "age_s": 0})  # progress resumed
+    clock.tick(10)                      # well past the old grace
+    assert tr.poll() == []              # verdict cancelled
+    assert tr.snapshot("worker:0")["state"] == "ok"
+    clock.tick(1)                       # but a NEW stall re-declares
+    assert kinds(tr.poll()) == [liveness.HUNG]
+
+
+def test_counter_reset_downward_counts_as_advance():
+    """A user process restarted inside the task resets the counter to a
+    LOWER value — that is a live task, not a stall."""
+    clock = Clock()
+    tr = make_tracker(clock)
+    tr.track("worker:0", "worker")
+    tr.observe("worker:0", {"steps": 50, "age_s": 0})
+    clock.tick(9)
+    tr.observe("worker:0", {"steps": 2, "age_s": 0})
+    clock.tick(9)
+    assert tr.poll() == []
+
+
+def test_executor_age_backdates_sparse_advances():
+    """When beacons are sparse, the executor's own stall age refines the
+    advance time: steps that moved 1 s after the previous beacon, then
+    froze, must be measured from the real advance, not beacon arrival."""
+    clock = Clock()
+    tr = make_tracker(clock)
+    tr.track("worker:0", "worker")
+    tr.observe("worker:0", {"steps": 5, "age_s": 0})
+    clock.tick(9)
+    # Advance arrived, but the executor says it happened 8 s ago.
+    tr.observe("worker:0", {"steps": 6, "age_s": 8})
+    clock.tick(2.5)                     # 10.5 s since the REAL advance
+    assert kinds(tr.poll()) == [liveness.HUNG]
+
+
+def test_disabled_timeout_never_hangs():
+    clock = Clock()
+    tr = make_tracker(clock, **{K.TASK_PROGRESS_TIMEOUT_S: 0,
+                                K.TASK_STRAGGLER_FRACTION: 0.0})
+    tr.track("worker:0", "worker")
+    tr.observe("worker:0", {"steps": 5, "age_s": 0})
+    clock.tick(10_000)
+    assert tr.poll() == []
+    # Beacons still feed the status surfaces.
+    assert tr.snapshot("worker:0")["steps"] == 5
+
+
+def test_forget_and_reset_drop_all_state():
+    clock = Clock()
+    tr = make_tracker(clock)
+    tr.track("worker:0", "worker")
+    tr.observe("worker:0", {"steps": 5, "age_s": 0})
+    tr.forget("worker:0")
+    clock.tick(100)
+    assert tr.poll() == []
+    assert tr.snapshot("worker:0") is None
+    tr.track("worker:1", "worker")
+    tr.reset()
+    clock.tick(100)
+    assert tr.poll() == []
+
+
+# ---------------------------------------------------------------------------
+# Recovery: journal-seeded deadlines resume instead of instantly expiring
+# ---------------------------------------------------------------------------
+def test_recovery_steps_hint_rearms_with_fresh_deadline():
+    clock = Clock()
+    tr = make_tracker(clock)
+    # Re-registration after --recover: the journalled counter seeds the
+    # tracker. The outage itself (however long) must not expire the
+    # deadline...
+    tr.track("worker:0", "worker", steps_hint=42)
+    snap = tr.snapshot("worker:0")
+    assert snap["steps"] == 42 and snap["state"] == "ok"
+    clock.tick(9)
+    assert tr.poll() == []
+    # ...but a hang that SPANS the crash is still caught one full
+    # timeout after re-adoption (armed from the journal, no warmup).
+    clock.tick(2)
+    assert kinds(tr.poll()) == [liveness.HUNG]
+
+
+def test_recovery_huge_reported_age_does_not_erase_grace():
+    """The first post-recovery beacon may carry a stall age spanning the
+    whole outage; backdating must never move the deadline EARLIER than
+    the re-adoption grace."""
+    clock = Clock()
+    tr = make_tracker(clock)
+    tr.track("worker:0", "worker", steps_hint=42)
+    tr.observe("worker:0", {"steps": 42, "age_s": 500})   # unchanged steps
+    clock.tick(5)
+    assert tr.poll() == []              # grace intact, not instantly hung
+
+
+def test_journal_progress_record_replay(tmp_path):
+    """REC_PROGRESS folds into the replayed task state (current epoch
+    only) — the --recover seed for progress deadlines."""
+    j = journal.SessionJournal(str(tmp_path / "j.jsonl"))
+    j.generation(1)
+    j.epoch(0, 0, 0)
+    j.register("worker:0", "h", 1, 0)
+    j.progress("worker:0", 17.0, 0)
+    j.progress("worker:0", 29.0, 0)
+    j.close()
+    st = journal.replay(j.path)
+    assert st.tasks["worker:0"].steps == 29.0
+    # An epoch reset supersedes progress like every other per-epoch state.
+    j2 = journal.SessionJournal(str(tmp_path / "j2.jsonl"))
+    j2.generation(1)
+    j2.epoch(0, 0, 0)
+    j2.progress("worker:0", 99.0, 0)
+    j2.epoch(1, 1, 0)
+    j2.register("worker:0", "h", 1, 1)
+    j2.close()
+    st2 = journal.replay(j2.path)
+    assert st2.tasks["worker:0"].steps == -1.0
+
+
+# ---------------------------------------------------------------------------
+# Straggler policing: median math, sustain window, restart gating
+# ---------------------------------------------------------------------------
+def _feed(tr, clock, rates, seconds, dt=0.5):
+    """Advance each task's counter at its rate for `seconds`, polling
+    like the monitor loop; returns all actions seen."""
+    acts = []
+    steps = {t: tr.snapshot(t).get("steps", 0.0) if tr.snapshot(t) else 0.0
+             for t in rates}
+    n = int(seconds / dt)
+    for _ in range(n):
+        clock.tick(dt)
+        for task, rate in rates.items():
+            steps[task] += rate * dt
+            tr.observe(task, {"steps": round(steps[task], 6), "age_s": 0})
+        acts.extend(tr.poll())
+    return acts
+
+
+def test_straggler_one_task_gang_never_flags():
+    """Median of a 1-task gang IS the task's own rate: below-fraction can
+    never hold, however slow (or frozen) the rate."""
+    clock = Clock()
+    tr = make_tracker(clock, **{K.TASK_STRAGGLER_FRACTION: 0.5,
+                                K.TASK_PROGRESS_TIMEOUT_S: 0})
+    tr.track("worker:0", "worker")
+    acts = _feed(tr, clock, {"worker:0": 0.01}, seconds=30)
+    assert acts == []
+
+
+def test_straggler_two_task_gang_flags_slow_member():
+    clock = Clock()
+    tr = make_tracker(clock, **{K.TASK_STRAGGLER_FRACTION: 0.5,
+                                K.TASK_PROGRESS_TIMEOUT_S: 0})
+    tr.track("worker:0", "worker")
+    tr.track("worker:1", "worker")
+    acts = _feed(tr, clock, {"worker:0": 10.0, "worker:1": 1.0},
+                 seconds=12)
+    assert kinds(acts) == [liveness.STRAGGLER]
+    a = acts[0]
+    assert a.task_id == "worker:1"
+    # 2-task median = mean(1, 10) = 5.5; the slow member sits below the
+    # 0.5 × median threshold.
+    assert a.info["median_steps_per_s"] == pytest.approx(5.5, rel=0.05)
+    assert a.info["rate_steps_per_s"] == pytest.approx(1.0, rel=0.05)
+    assert tr.snapshot("worker:1")["state"] == "straggler"
+    # Event once per episode: keep feeding, no duplicate.
+    acts = _feed(tr, clock, {"worker:0": 10.0, "worker:1": 1.0},
+                 seconds=8)
+    assert acts == []
+
+
+def test_straggler_momentary_dip_below_window_never_flags():
+    clock = Clock()
+    tr = make_tracker(clock, **{K.TASK_STRAGGLER_FRACTION: 0.5,
+                                K.TASK_PROGRESS_TIMEOUT_S: 0})
+    tr.track("worker:0", "worker")
+    tr.track("worker:1", "worker")
+    acts = _feed(tr, clock, {"worker:0": 10.0, "worker:1": 10.0},
+                 seconds=6)
+    # A dip shorter than the 4 s sustain window...
+    acts += _feed(tr, clock, {"worker:0": 10.0, "worker:1": 0.5},
+                  seconds=2)
+    # ...followed by recovery: no straggler event.
+    acts += _feed(tr, clock, {"worker:0": 10.0, "worker:1": 10.0},
+                  seconds=8)
+    assert acts == []
+
+
+def test_straggler_restart_gated_off_by_default():
+    clock = Clock()
+    tr = make_tracker(clock, **{K.TASK_STRAGGLER_FRACTION: 0.5,
+                                K.TASK_PROGRESS_TIMEOUT_S: 0})
+    tr.track("worker:0", "worker")
+    tr.track("worker:1", "worker")
+    acts = _feed(tr, clock, {"worker:0": 10.0, "worker:1": 1.0},
+                 seconds=12)
+    assert liveness.STRAGGLER_KILL not in kinds(acts)
+
+
+def test_straggler_restart_kills_when_enabled():
+    clock = Clock()
+    tr = make_tracker(clock, **{K.TASK_STRAGGLER_FRACTION: 0.5,
+                                K.TASK_PROGRESS_TIMEOUT_S: 0,
+                                K.TASK_STRAGGLER_RESTART: True})
+    tr.track("worker:0", "worker")
+    tr.track("worker:1", "worker")
+    acts = _feed(tr, clock, {"worker:0": 10.0, "worker:1": 1.0},
+                 seconds=12)
+    assert kinds(acts) == [liveness.STRAGGLER, liveness.STRAGGLER_KILL]
+    # Killed is terminal: the survivor's gang shrinks to width 1 and the
+    # job-level retry machinery (not this tracker) owns what happens next.
+    acts = _feed(tr, clock, {"worker:0": 10.0}, seconds=8)
+    assert acts == []
+
+
+def test_straggler_zero_rates_hold_the_line():
+    """All-zero rates (e.g. every member between evals): 0 < 0.5×0 is
+    False — nobody straggles."""
+    clock = Clock()
+    tr = make_tracker(clock, **{K.TASK_STRAGGLER_FRACTION: 0.5,
+                                K.TASK_PROGRESS_TIMEOUT_S: 0})
+    tr.track("worker:0", "worker")
+    tr.track("worker:1", "worker")
+    acts = _feed(tr, clock, {"worker:0": 0.0, "worker:1": 0.0},
+                 seconds=12)
+    assert acts == []
+
+
+def test_straggler_median_scoped_per_jobtype():
+    """Gangs are jobtypes: a slow ps-style jobtype must not be judged
+    against the workers' median."""
+    clock = Clock()
+    tr = make_tracker(clock, **{K.TASK_STRAGGLER_FRACTION: 0.5,
+                                K.TASK_PROGRESS_TIMEOUT_S: 0})
+    tr.track("worker:0", "worker")
+    tr.track("worker:1", "worker")
+    tr.track("side:0", "side")
+    acts = _feed(tr, clock, {"worker:0": 10.0, "worker:1": 9.0,
+                             "side:0": 0.1}, seconds=12)
+    assert acts == []
+
+
+# ---------------------------------------------------------------------------
+# Fault sites + spec grammar extensions (user.hang / user.slow_step)
+# ---------------------------------------------------------------------------
+def _reset_steps():
+    telemetry._steps.update(count=0, busy_s=0.0, flops=0.0, tokens=0.0,
+                            first_start=0.0, last_end=0.0)
+
+
+def test_fault_spec_after_token():
+    rule = faults._SiteRule("user.hang", "after:3", seed=0)
+    assert [rule.decide()[0] for _ in range(6)] == [
+        False, False, False, True, True, True]
+
+
+def test_fault_spec_amt_and_fire_amount():
+    inj = faults.FaultInjector({"user.slow_step": "every:2,amt:0.25"})
+    assert inj.fire_amount("user.slow_step") is None      # call 1
+    assert inj.fire_amount("user.slow_step") == 0.25      # call 2
+    assert inj.fire_amount("nope" if False else "user.hang") is None
+
+
+def test_fault_spec_task_filter(monkeypatch):
+    monkeypatch.setenv("TONY_TASK_ID", "worker:1")
+    rule = faults._SiteRule("user.slow_step", "every:1,task:worker:1",
+                            seed=0)
+    assert rule.decide()[0] is True
+    monkeypatch.setenv("TONY_TASK_ID", "worker:0")
+    assert rule.decide()[0] is False
+
+
+def test_user_hang_site_freezes_step_counter():
+    """user.hang drops recordings past after:N — the published counter
+    freezes while the loop keeps running."""
+    _reset_steps()
+    faults.install(faults.parse_spec("user.hang=after:2"))
+    for _ in range(5):
+        telemetry.step_done(time.monotonic())
+    assert telemetry.step_stats()["steps_completed"] == 2
+    _reset_steps()
+
+
+def test_user_slow_step_site_injects_delay():
+    _reset_steps()
+    faults.install(faults.parse_spec("user.slow_step=every:1,amt:0.05"))
+    t0 = time.monotonic()
+    for _ in range(3):
+        telemetry.step_done(time.monotonic())
+    assert time.monotonic() - t0 >= 0.15
+    assert telemetry.step_stats()["steps_completed"] == 3
+    _reset_steps()
+
+
+def test_step_stats_publish_without_jax_runtime(tmp_path, monkeypatch):
+    """The progress beacon's source: step counters reach the metrics file
+    even in a process that never imported jax (collect_device_stats used
+    to bail out entirely)."""
+    import sys
+    _reset_steps()
+    telemetry.step_done(time.monotonic())
+    stats = telemetry.collect_device_stats()
+    assert stats.get("steps_completed") == 1
+    if "jax" not in sys.modules:
+        assert "device_count" not in stats
+    path = str(tmp_path / "m.json")
+    assert telemetry.write_stats_once(path)
+    assert telemetry.read_stats(path)["steps_completed"] == 1
+    _reset_steps()

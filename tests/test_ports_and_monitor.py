@@ -1,7 +1,10 @@
-"""Port reservation (reference ``TestPortAllocation.java``) and task-metrics
-monitor (reference ``TestTaskMonitor.java``) tests."""
+"""Port reservation (reference ``TestPortAllocation.java``), task-metrics
+monitor (reference ``TestTaskMonitor.java``), and hung-task stack-dump
+handler registration (tony_tpu/telemetry.py install_stack_dump_handler)
+tests."""
 
 import os
+import signal
 import socket
 
 import pytest
@@ -51,3 +54,94 @@ def test_monitor_aggregation():
     assert second[mon.MAX_MEMORY_BYTES] >= first[mon.AVG_MEMORY_BYTES] > 0
     m.stop()  # pushes final metrics
     assert pushed and pushed[-1][0] == "worker:0"
+
+
+def test_monitor_passes_step_counter_through(tmp_path):
+    """The hang-detection step counter rides the metrics file into the
+    final TASK_FINISHED metrics too (STEPS_COMPLETED passthrough)."""
+    import json
+
+    path = str(tmp_path / "m.json")
+    with open(path, "w") as f:
+        json.dump({"steps_completed": 7.0, "steps_per_sec": 3.5}, f)
+    m = mon.TaskMonitor("worker:0", push=lambda t, d: None,
+                        metrics_file=path)
+    sample = m.sample_once()
+    assert sample[mon.STEPS_COMPLETED] == 7.0
+    assert sample[mon.STEPS_PER_SEC] == 3.5
+
+
+# ---------------------------------------------------------------------------
+# Hung-task diagnostics: faulthandler dump-signal registration
+# (tony_tpu/telemetry.install_stack_dump_handler; the executor exports
+# TONY_STACKDUMP_SIGNAL and delivers the signal on a hung verdict).
+# ---------------------------------------------------------------------------
+@pytest.fixture
+def _dump_signal_env(tmp_path, monkeypatch):
+    """Arm the env contract on SIGUSR2 (SIGUSR1 is the production default;
+    using the sibling keeps this suite independent of any other USR1
+    user), and restore handler state afterwards."""
+    import faulthandler
+
+    from tony_tpu import telemetry
+
+    signum = signal.SIGUSR2
+    monkeypatch.setenv("TONY_STACKDUMP_SIGNAL", str(int(signum)))
+    monkeypatch.setattr(telemetry, "_dump_registered", False)
+    prev = signal.getsignal(signum)
+    yield signum
+    try:
+        faulthandler.unregister(signum)
+    except (ValueError, OSError):
+        pass
+    signal.signal(signum, prev)
+
+
+def test_stack_dump_handler_registers_and_dumps(tmp_path, _dump_signal_env):
+    """The registered handler turns the dump signal into an all-thread
+    stack dump on the given stream — what lands in the task log when the
+    coordinator declares a task hung."""
+    from tony_tpu import telemetry
+
+    signum = _dump_signal_env
+    out = tmp_path / "dump.txt"
+    with open(out, "w") as stream:
+        assert telemetry.install_stack_dump_handler(stream=stream) is True
+        os.kill(os.getpid(), signum)
+        stream.flush()
+    text = out.read_text()
+    assert "thread 0x" in text.lower() and "most recent call first" in text
+    assert "test_stack_dump_handler_registers_and_dumps" in text
+
+
+def test_stack_dump_handler_detects_user_override_and_chains(
+        tmp_path, _dump_signal_env, caplog):
+    """A user script that already owns the signal is detected and warned,
+    not broken: the dump chains in front of the user handler and BOTH
+    run."""
+    import logging
+
+    from tony_tpu import telemetry
+
+    signum = _dump_signal_env
+    user_calls = []
+    signal.signal(signum, lambda s, f: user_calls.append(s))
+    out = tmp_path / "dump.txt"
+    with caplog.at_level(logging.WARNING, logger="tony_tpu.telemetry"):
+        with open(out, "w") as stream:
+            assert telemetry.install_stack_dump_handler(
+                stream=stream) is True
+            os.kill(os.getpid(), signum)
+            stream.flush()
+    assert any("already has a user handler" in r.message
+               for r in caplog.records), "override not detected/warned"
+    assert "most recent call first" in out.read_text()  # dump ran
+    assert user_calls == [signum]                       # user handler too
+
+
+def test_stack_dump_handler_noop_without_env(monkeypatch):
+    from tony_tpu import telemetry
+
+    monkeypatch.delenv("TONY_STACKDUMP_SIGNAL", raising=False)
+    monkeypatch.setattr(telemetry, "_dump_registered", False)
+    assert telemetry.install_stack_dump_handler() is False

@@ -35,6 +35,12 @@ from tony_tpu import telemetry as _telemetry  # noqa: E402
 
 _telemetry.maybe_start()
 
+# Inside a task (TONY_STACKDUMP_SIGNAL set by the executor) the same bare
+# import pre-registers the hung-task diagnostics handler: the coordinator's
+# progress liveness can then get an all-thread stack dump out of a wedged
+# user process before killing it; no-op everywhere else.
+_telemetry.install_stack_dump_handler()
+
 # Inside a task whose supervisor exported TONY_FAULTS, arm the fault
 # harness for this process too (user scripts' checkpoint/storage calls are
 # injection sites); no-op — one env read — everywhere else.

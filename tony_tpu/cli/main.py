@@ -204,7 +204,7 @@ def _cmd_status(args: argparse.Namespace) -> int:
                 print(f"tb_url:   {report['tb_url']}")
             for t in report.get("tasks", []):
                 print(f"  {t['name']}:{t['index']:<3} {t['status']:<10} "
-                      f"{t.get('host', '') or ''}")
+                      f"{t.get('host', '') or ''}{_fmt_progress(t)}")
             return 0
         except Exception as e:  # noqa: BLE001
             print(f"(coordinator unreachable: {e}; trying history)",
@@ -223,6 +223,27 @@ def _cmd_status(args: argparse.Namespace) -> int:
           f"{_default_workdir(args.workdir)}, no history under {root})",
           file=sys.stderr)
     return 1
+
+
+def _fmt_progress(task: dict) -> str:
+    """One-line progress-liveness suffix for a status row: step counter,
+    rate, stall age, and the hang/straggler verdicts (coordinator
+    application_report 'progress' field; absent for uninstrumented or
+    terminal tasks)."""
+    p = task.get("progress") or {}
+    if not p:
+        return ""
+    state = p.get("state", "")
+    if "steps" not in p:
+        return f"  [{state}]" if state else ""
+    out = f"  steps={p['steps']:g}"
+    if p.get("rate_steps_per_s") is not None:
+        out += f" ({p['rate_steps_per_s']:g}/s)"
+    if p.get("stalled_s", 0) and float(p["stalled_s"]) >= 1.0:
+        out += f" stalled {float(p['stalled_s']):.0f}s"
+    if state in ("hung", "straggler"):
+        out += f" {state.upper()}"
+    return out
 
 
 def _history_root(args: argparse.Namespace) -> str:

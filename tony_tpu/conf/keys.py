@@ -279,6 +279,53 @@ TASK_ORPHAN_DEADLINE_S = _key(
     "past it the executor concludes it is orphaned, delivers the "
     "TERM-grace-KILL ladder to the user process group, and exits — no "
     "headless gang may keep burning TPU time forever.")
+TASK_PROGRESS_TIMEOUT_S = _key(
+    "tony.task.progress-timeout-s", 0, int,
+    "Progress-based hang detection (coordinator/liveness.py): a task "
+    "whose step counter (telemetry.step() beacons riding heartbeats) "
+    "stops advancing for this long is declared HUNG — stack-dumped via "
+    "the executor's dump signal, then TERM-grace-KILLed into an "
+    "INFRA_TRANSIENT retry epoch. Warmup-aware: the deadline only arms "
+    "once a task has reported its FIRST step, so compile/restore time "
+    "never counts; tasks with no progress instrumentation keep "
+    "heartbeat-only liveness (one-time warning, never a false kill). "
+    "0 disables. Size it well above the longest legitimate gap between "
+    "steps (eval pauses, checkpoint saves).")
+TASK_PROGRESS_WARMUP_S = _key(
+    "tony.task.progress-warmup-s", 300, int,
+    "How long after registration a task may run without ever reporting "
+    "a step counter before the coordinator emits the one-time "
+    "TASK_PROGRESS_UNINSTRUMENTED warning and settles for heartbeat-only "
+    "liveness. Only a warning gate — an uninstrumented task is never "
+    "killed for lack of progress.")
+TASK_HANG_DUMP_GRACE_S = _key(
+    "tony.task.hang-dump-grace-s", 5, int,
+    "Diagnostics window between declaring a task HUNG and killing it: "
+    "the dump directive rides the next heartbeat response, the executor "
+    "signals the user process group, and the pre-registered faulthandler "
+    "dumps all-thread stacks into the task log. A step advance inside "
+    "the window cancels the verdict.")
+TASK_STRAGGLER_FRACTION = _key(
+    "tony.task.straggler-fraction", 0.0, float,
+    "Gang-level straggler policing (coordinator/liveness.py): a task "
+    "whose step rate stays below this fraction of its jobtype's median "
+    "rate for a sustained straggler-window-s emits TASK_STRAGGLER with "
+    "its rate vs. the median. 0 disables. A 1-task gang can never "
+    "straggle (its own rate is the median). Disable (or keep 0) for "
+    "intentionally asymmetric gangs — heterogeneous batch sizes, "
+    "pipeline stages with unequal work.")
+TASK_STRAGGLER_WINDOW_S = _key(
+    "tony.task.straggler-window-s", 60, int,
+    "Sliding window for straggler step-rate estimation AND the sustain "
+    "requirement: the below-fraction condition must hold continuously "
+    "this long before TASK_STRAGGLER fires (momentary dips — GC, a slow "
+    "batch — never flag).")
+TASK_STRAGGLER_RESTART = _key(
+    "tony.task.straggler-restart", False, bool,
+    "Proactive straggler restart (off by default): a flagged straggler "
+    "is killed into an INFRA_TRANSIENT retry epoch, on the theory that "
+    "a fresh process/host beats a gang crawling at the straggler's "
+    "pace. Leave off unless step rates are expected to be uniform.")
 
 # --- rpc ------------------------------------------------------------------
 RPC_CALL_TIMEOUT_S = _key(
@@ -404,8 +451,10 @@ FAULT_SEED = _key(
 
 
 def fault_key(site: str) -> str:
-    """Conf key for an injection site: 'rpc.send' → 'tony.fault.rpc-send'."""
-    return f"tony.fault.{site.replace('.', '-')}"
+    """Conf key for an injection site: 'rpc.send' → 'tony.fault.rpc-send',
+    'user.slow_step' → 'tony.fault.user-slow-step' (key names are
+    dash-only; site names keep their python-ish underscores)."""
+    return f"tony.fault.{site.replace('.', '-').replace('_', '-')}"
 
 
 # One registered key per injection site (tony_tpu/faults.py SITES); the
@@ -445,6 +494,19 @@ FAULT_EXECUTOR_REREGISTER = _key(
     "Drop an executor's re-registration attempt during coordinator-loss "
     "reconnect (raises like a transport reset; the reconnect loop "
     "retries until the orphan deadline).")
+FAULT_USER_HANG = _key(
+    "tony.fault.user-hang", "", str,
+    "Freeze the user process's PROGRESS while it keeps running (and its "
+    "executor keeps heartbeating): telemetry.step recordings that fire "
+    "this spec are silently dropped, so the step counter stops advancing "
+    "— the exact shape progress-based hang detection must catch. "
+    "'after:N' freezes everything past the first N steps.")
+FAULT_USER_SLOW_STEP = _key(
+    "tony.fault.user-slow-step", "", str,
+    "Skew one task's step rate: telemetry.step recordings that fire this "
+    "spec are delayed by 'amt:X' seconds, driving the task's rate below "
+    "the gang median — the straggler-policing drill. Combine with the "
+    "'task:<job>:<idx>' filter to slow a single gang member.")
 
 # --- portal ---------------------------------------------------------------
 PORTAL_PORT = _key(

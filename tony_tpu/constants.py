@@ -33,6 +33,18 @@ COORDINATOR_ADDR_FILE = "TONY_COORDINATOR_ADDR_FILE"
 # File the user process's telemetry reporter writes device stats to; the
 # TaskMonitor tails it (set by the executor; see tony_tpu/telemetry.py).
 METRICS_FILE = "TONY_METRICS_FILE"
+# Override for the telemetry reporter's write cadence in seconds (default
+# 3.0). Progress-liveness tests tighten it so the step counter publishes
+# faster than the configured progress deadline.
+TELEMETRY_INTERVAL_ENV = "TONY_TELEMETRY_INTERVAL_S"
+# Signal number the executor exports into the user environment for
+# hung-task diagnostics: `import tony_tpu` pre-registers a faulthandler
+# all-thread stack dump on it (telemetry.install_stack_dump_handler), and
+# the executor delivers it to the user process group when the coordinator
+# declares the task HUNG (progress frozen, heartbeats alive). Default
+# SIGUSR1; operators can pre-set it (tony.application.execution-env) to
+# move the dump off a signal the user script needs.
+STACKDUMP_SIGNAL = "TONY_STACKDUMP_SIGNAL"
 TASK_ID = "TONY_TASK_ID"              # "<jobtype>:<index>"
 TASK_COMMAND = "TONY_TASK_COMMAND"    # user command for this task
 EXECUTOR_CONF = "TONY_EXECUTOR_CONF"  # path to the frozen final config

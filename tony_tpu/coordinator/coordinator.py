@@ -33,8 +33,9 @@ from tony_tpu import constants, faults
 from tony_tpu.cluster.base import Backend, TaskLaunchSpec
 from tony_tpu.conf.config import TonyTpuConfig
 from tony_tpu.conf import keys as K
-from tony_tpu.coordinator import journal
+from tony_tpu.coordinator import journal, liveness
 from tony_tpu.coordinator.journal import SessionJournal
+from tony_tpu.coordinator.liveness import ProgressTracker
 from tony_tpu.coordinator.scheduler import GangScheduler
 from tony_tpu.coordinator.session import (FailureDomain, Session,
                                           SessionStatus, Task, TaskStatus)
@@ -84,9 +85,10 @@ class _RpcService:
         self._c.client_signalled_finish.set()
         return self._c.final_status.value
 
-    def task_executor_heartbeat(self, task_id: str,
-                                session_id: int = -1) -> bool:
-        return self._c.heartbeat(task_id, session_id=session_id)
+    def task_executor_heartbeat(self, task_id: str, session_id: int = -1,
+                                progress: Optional[dict] = None):
+        return self._c.heartbeat(task_id, session_id=session_id,
+                                 progress=progress)
 
     def get_application_report(self) -> dict:
         return self._c.application_report()
@@ -182,6 +184,17 @@ class Coordinator:
         faults.install_from_conf(conf)
         self._last_hb: Dict[str, float] = {}
         self._hb_lock = threading.Lock()
+        # Progress-based liveness on top of the heartbeat monitor
+        # (coordinator/liveness.py): executors piggyback step-counter
+        # beacons on heartbeats; this tracker turns frozen counters into
+        # hang verdicts and rate skew into straggler events. On recovery,
+        # journalled counters re-arm each task with a FRESH deadline as
+        # it re-registers — the outage must not expire deadlines.
+        self.progress = ProgressTracker(conf)
+        self._recovered_steps: Dict[str, float] = \
+            {tid: tr.steps for tid, tr in st.tasks.items()
+             if tr.steps >= 0} if st else {}
+        self._progress_journal_t: Dict[str, float] = {}
         self._schedule_start: float = 0.0
         self._worker_termination_done = False
         self._final_conf_path = ""
@@ -388,6 +401,12 @@ class Coordinator:
                                   self.session.session_id)
             with self._hb_lock:
                 self._last_hb[task_id] = time.monotonic()
+            # Progress tracking starts at registration; a post-recovery
+            # re-registration seeds the journalled step counter so the
+            # task comes back ARMED with a fresh deadline.
+            self.progress.track(
+                task_id, task_id.partition(":")[0],
+                steps_hint=self._recovered_steps.pop(task_id, None))
             self._maybe_test_worker_termination(task_id)
         return self.session.get_cluster_spec()
 
@@ -423,15 +442,42 @@ class Coordinator:
         self._check_epoch(task_id, session_id)
         with self._hb_lock:
             self._last_hb.pop(task_id, None)
+        self.progress.forget(task_id)
         self._process_completion(task_id, exit_code)
         return 0
 
-    def heartbeat(self, task_id: str, session_id: int = -1) -> bool:
+    def heartbeat(self, task_id: str, session_id: int = -1,
+                  progress: Optional[dict] = None):
+        """Liveness refresh + progress-beacon intake. The return value
+        doubles as the coordinator→executor directive channel: normally
+        True (wire-compatible with pre-progress executors), or a dict
+        carrying ``{"dump": True}`` exactly once after a hang verdict —
+        the executor then signals the user process group so its
+        pre-registered faulthandler dumps all-thread stacks."""
         self._check_epoch(task_id, session_id)
         with self._hb_lock:
             if task_id in self._last_hb:
                 self._last_hb[task_id] = time.monotonic()
+        if self.progress.observe(task_id, progress):
+            self._maybe_journal_progress(task_id)
+        if self.progress.should_dump(task_id):
+            return {"ok": True, "dump": True}
         return True
+
+    def _maybe_journal_progress(self, task_id: str) -> None:
+        """Journal an advanced step counter, throttled per task — the
+        recovery seed must not turn the fsync'd journal into a per-step
+        hot path."""
+        now = time.monotonic()
+        last = self._progress_journal_t.get(task_id, 0.0)
+        if now - last < liveness.PROGRESS_JOURNAL_MIN_INTERVAL_S:
+            return
+        self._progress_journal_t[task_id] = now
+        snap = self.progress.snapshot(task_id) or {}
+        steps = snap.get("steps")
+        if steps is not None:
+            self.journal.progress(task_id, float(steps),
+                                  self.session.session_id)
 
     def _retry_available(self, domain: Optional[FailureDomain]) -> bool:
         """Would the run loop retry a failure of this domain right now?
@@ -484,6 +530,16 @@ class Coordinator:
             # KILLED, not the transient FAILED (same YARN semantics as the
             # finally-block mapping).
             status = SessionStatus.KILLED
+        tasks = []
+        for t in self.session.all_tasks():
+            info = t.to_info()
+            # Live progress state for the status surfaces (CLI `status`,
+            # portal): steps, stall age, rate, and the hang/straggler
+            # verdicts — absent for terminal/untracked tasks.
+            snap = self.progress.snapshot(t.task_id)
+            if snap:
+                info["progress"] = snap
+            tasks.append(info)
         return {
             "app_id": self.app_id,
             "status": status.value,
@@ -496,7 +552,7 @@ class Coordinator:
             "retries_left": retries_left,
             "preemption_retries_left": preempt_left,
             "tb_url": self.tb_url,
-            "tasks": [t.to_info() for t in self.session.all_tasks()],
+            "tasks": tasks,
         }
 
     def request_stop(self, reason: str) -> None:
@@ -515,6 +571,7 @@ class Coordinator:
         t = self.session.get_task(task_id)
         if t is None or t.status.terminal:
             return
+        self.progress.forget(task_id)
         self.session.on_task_completed(
             task_id, exit_code,
             domain_hint=self.backend.completion_domain(task_id))
@@ -555,18 +612,25 @@ class Coordinator:
         """Liveness monitor (reference AbstractLivelinessMonitor usage
         :188-208; expiry → ``onTaskDeemedDead`` :1178-1185)."""
         now = time.monotonic()
-        expired: List[str] = []
+        expired: List[tuple] = []
         with self._hb_lock:
             for task_id, last in list(self._last_hb.items()):
                 if now - last > self._hb_expiry_s:
-                    expired.append(task_id)
+                    expired.append((task_id, now - last))
                     del self._last_hb[task_id]
-        for task_id in expired:
+        for task_id, hb_age_s in expired:
             t = self.session.get_task(task_id)
             if t is None or t.status.terminal:
                 continue
             log.error("task %s missed heartbeats for %.1fs — deemed dead",
                       task_id, self._hb_expiry_s)
+            # Postmortem context BEFORE the tracker forgets the task: the
+            # event must let an operator tell "executor vanished" (stale
+            # heartbeat age, any progress state) from "executor alive,
+            # user hung" (the TASK_HUNG path, which never comes through
+            # here).
+            progress_snap = self.progress.snapshot(task_id)
+            self.progress.forget(task_id)
             if t.handle is not None:
                 self.backend.kill_task(t.handle, grace_s=0.0)
             # Fail first so the recorded reason is the liveness expiry, not
@@ -592,9 +656,156 @@ class Coordinator:
                 "task": task_id, "exit_code": constants.EXIT_KILLED,
                 "status": t.status.value,
                 "failure_domain": FailureDomain.INFRA_TRANSIENT.value,
+                "last_heartbeat_age_s": round(hb_age_s, 3),
+                "progress": progress_snap or {},
                 "metrics": self.metrics_store.get(task_id, {}),
                 "logs": list(logs) if logs else [],
                 "session_id": self.session.session_id}))
+
+    def _check_progress(self) -> None:
+        """Progress-based liveness pass (coordinator/liveness.py): act on
+        the tracker's verdicts. Heartbeat expiry proves a DEAD executor;
+        this proves a LIVE executor whose user process stopped doing
+        work — hang (frozen step counter → diagnose → kill → retry) and
+        straggler (rate below the gang median → event, optional
+        restart)."""
+        for action in self.progress.poll():
+            t = self.session.get_task(action.task_id)
+            if t is None or t.status.terminal:
+                continue
+            payload = dict(action.info)
+            payload.update({"task": action.task_id,
+                            "session_id": self.session.session_id})
+            if action.kind == liveness.WARN_UNINSTRUMENTED:
+                log.warning(
+                    "task %s reported no step counter within the %ss "
+                    "warmup — progress liveness degrades to "
+                    "heartbeat-only for it (instrument the training "
+                    "loop with tony_tpu.telemetry.step())",
+                    action.task_id, action.info.get("warmup_s"))
+                self.events.emit(Event(
+                    EventType.TASK_PROGRESS_UNINSTRUMENTED, payload))
+            elif action.kind == liveness.HUNG:
+                log.error(
+                    "task %s HUNG: heartbeats alive but step counter "
+                    "frozen at %s for %.1fs (deadline %ss) — requesting "
+                    "a stack dump, kill follows in %ss",
+                    action.task_id, action.info.get("steps"),
+                    action.info.get("stalled_s", 0.0),
+                    action.info.get("timeout_s"),
+                    self.progress.dump_grace_s)
+                self.events.emit(Event(EventType.TASK_HUNG, payload))
+            elif action.kind == liveness.STRAGGLER:
+                log.warning(
+                    "task %s STRAGGLING: %.3f steps/s vs gang median "
+                    "%.3f (threshold %.0f%%) sustained %ss",
+                    action.task_id,
+                    action.info.get("rate_steps_per_s", 0.0),
+                    action.info.get("median_steps_per_s", 0.0),
+                    100 * float(action.info.get("fraction", 0.0)),
+                    action.info.get("window_s"))
+                self.events.emit(Event(EventType.TASK_STRAGGLER, payload))
+            elif action.kind == liveness.HANG_KILL:
+                self._kill_unhealthy_task(
+                    t, f"task {action.task_id} hung: heartbeats alive "
+                       f"but no step progress for "
+                       f"{action.info.get('stalled_s', 0.0):.0f}s "
+                       f"(progress deadline "
+                       f"{action.info.get('timeout_s')}s)",
+                    action.info, capture_dump=True)
+            elif action.kind == liveness.STRAGGLER_KILL:
+                self._kill_unhealthy_task(
+                    t, f"task {action.task_id} proactively restarted as "
+                       f"a straggler: "
+                       f"{action.info.get('rate_steps_per_s', 0.0):.3f} "
+                       f"steps/s vs gang median "
+                       f"{action.info.get('median_steps_per_s', 0.0):.3f}",
+                    action.info, capture_dump=False)
+
+    def _kill_unhealthy_task(self, t: Task, reason: str, info: dict,
+                             capture_dump: bool) -> None:
+        """Hang/straggler kill: TERM→grace→KILL the task and fail the
+        epoch INFRA_TRANSIENT into the ordinary retry machinery — a wedge
+        or skew is infra-shaped (fresh process, possibly fresh hardware,
+        usually clears it), never a deterministic user crash. Mirrors the
+        heartbeat-expiry kill, plus the captured diagnostics."""
+        task_id = t.task_id
+        hb_age_s = None
+        with self._hb_lock:
+            last = self._last_hb.pop(task_id, None)
+            if last is not None:
+                hb_age_s = time.monotonic() - last
+        progress_snap = self.progress.snapshot(task_id)
+        self.progress.forget(task_id)
+        dump_excerpt = self._stack_dump_excerpt(task_id) \
+            if capture_dump else ""
+        log.error("%s — killing into an INFRA_TRANSIENT retry", reason)
+        # Verdict BEFORE the kill: kill_task blocks through its grace
+        # window, and the dying executor reports its (TERM-shaped, 143)
+        # exit over RPC inside that window — processed first, it would
+        # re-label this deliberate restart as a chief PREEMPTION failure.
+        # With the task already terminal, the late report is a no-op.
+        self.session.fail(reason, FailureDomain.INFRA_TRANSIENT)
+        self.session.on_task_completed(
+            task_id, constants.EXIT_KILLED,
+            domain_hint=FailureDomain.INFRA_TRANSIENT.value)
+        self.journal.task(
+            task_id, t.status.value, self.session.session_id,
+            exit_code=constants.EXIT_KILLED,
+            domain=FailureDomain.INFRA_TRANSIENT.value)
+        if t.handle is not None:
+            # A wedged user process rarely honours TERM, but the grace
+            # window costs little and lets a merely-slow process flush
+            # its save-on-TERM handlers before the KILL lands.
+            self.backend.kill_task(
+                t.handle,
+                grace_s=min(self.conf.get_int(K.COORDINATOR_STOP_GRACE_S,
+                                              15), 5))
+        logs = self.backend.task_log_paths(task_id)
+        payload = {
+            "task": task_id, "exit_code": constants.EXIT_KILLED,
+            "status": t.status.value,
+            "failure_domain": FailureDomain.INFRA_TRANSIENT.value,
+            "reason": reason,
+            "progress": progress_snap or dict(info),
+            "metrics": self.metrics_store.get(task_id, {}),
+            "logs": list(logs) if logs else [],
+            "session_id": self.session.session_id}
+        if hb_age_s is not None:
+            payload["last_heartbeat_age_s"] = round(hb_age_s, 3)
+        if dump_excerpt:
+            payload["stack_dump_excerpt"] = dump_excerpt
+        self.events.emit(Event(EventType.TASK_FINISHED, payload))
+
+    def _stack_dump_excerpt(self, task_id: str,
+                            max_bytes: int = 4096) -> str:
+        """Best-effort: pull the faulthandler all-thread dump the executor
+        triggered out of the task's stderr log, so the event stream holds
+        the stacks even after task dirs are purged. Empty when the log is
+        unreachable (remote host) or the dump never landed (user signal
+        override, dump signal lost)."""
+        paths = self.backend.task_log_paths(task_id)
+        if not paths:
+            return ""
+        for path in reversed(paths):       # stderr is the usual home
+            try:
+                with open(path, "rb") as f:
+                    f.seek(0, os.SEEK_END)
+                    size = f.tell()
+                    f.seek(max(0, size - 64 * 1024))
+                    tail = f.read().decode("utf-8", "replace")
+            except OSError:
+                continue
+            # faulthandler dump markers (Python's own format); take the
+            # FIRST marker in the tail so the excerpt spans the whole
+            # dump, not just its final thread block.
+            idx = tail.find("Thread 0x")
+            cur = tail.find("Current thread 0x")
+            if idx < 0 or (0 <= cur < idx):
+                idx = cur
+            if idx >= 0:
+                return tail[idx:idx + max_bytes]
+        return ""
 
     # ------------------------------------------------------------------
     # Lifecycle
@@ -768,6 +979,10 @@ class Coordinator:
             self.session = Session(self.conf, session_id=attempt)
             with self._hb_lock:
                 self._last_hb.clear()
+            # Progress state belongs to the old gang; the new epoch's
+            # tasks re-arm from scratch (fresh warmup, fresh deadlines).
+            self.progress.reset()
+            self._progress_journal_t.clear()
             self._worker_termination_done = False
         # Bump the attempt only after the fresh session is installed: a
         # concurrent application_report must never see (old FAILED session,
@@ -880,6 +1095,7 @@ class Coordinator:
             for task_id, exit_code in self.backend.poll_completions():
                 self._process_completion(task_id, exit_code)
             self._check_heartbeats()
+            self._check_progress()
             if self.session.status != SessionStatus.RUNNING:
                 return self.session.status
             if self.session.training_finished():

@@ -45,6 +45,7 @@ REC_JOB_COMPLETED = "job_completed"
 REC_REGISTER = "register"         # executor registration (host/port)
 REC_TASK = "task"                 # task state transition
 REC_VERDICT = "verdict"           # failure-domain verdict for an epoch
+REC_PROGRESS = "progress"         # throttled task step-counter checkpoint
 
 
 class JournalError(RuntimeError):
@@ -61,6 +62,11 @@ class TaskRecord:
     registered: bool = False
     exit_code: Optional[int] = None
     domain: str = ""
+    # Last journalled step counter (-1 = none): seeds the recovered
+    # coordinator's progress tracker so hang deadlines RESUME (fresh
+    # clock, armed state) instead of instantly expiring across the
+    # outage (coordinator/liveness.py track(steps_hint=...)).
+    steps: float = -1.0
 
 
 @dataclasses.dataclass
@@ -136,6 +142,12 @@ class SessionJournal:
     def verdict(self, session_id: int, domain: str, reason: str) -> None:
         self.append({"t": REC_VERDICT, "session": session_id,
                      "domain": domain, "reason": reason})
+
+    def progress(self, task_id: str, steps: float, session_id: int) -> None:
+        """Throttled by the caller (liveness.PROGRESS_JOURNAL_MIN_INTERVAL_S)
+        — the journal is fsync'd and must stay control-plane-rate."""
+        self.append({"t": REC_PROGRESS, "task": task_id, "steps": steps,
+                     "session": session_id})
 
     def close(self) -> None:
         if self._log is not None:
@@ -233,6 +245,15 @@ def replay(path: str) -> ReplayState:
                 tr.exit_code = int(rec["exit"])
             if rec.get("domain"):
                 tr.domain = str(rec["domain"])
+        elif t == REC_PROGRESS:
+            if int(rec.get("session", 0) or 0) != state.session_id:
+                continue
+            tr = state.tasks.setdefault(str(rec.get("task", "")),
+                                        TaskRecord())
+            try:
+                tr.steps = float(rec.get("steps", -1.0))
+            except (TypeError, ValueError):
+                pass
         elif t == REC_VERDICT:
             pass                   # forensic record; no folded state
         else:

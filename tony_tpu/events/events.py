@@ -34,6 +34,20 @@ class EventType(str, enum.Enum):
     # the tasks awaiting re-registration. No reference analogue — the AM
     # restart was invisible in jhist; operators asked why a job "paused".
     COORDINATOR_RECOVERED = "COORDINATOR_RECOVERED"
+    # Progress-based liveness (coordinator/liveness.py; no reference
+    # analogue — TonY's liveness was heartbeat-only).
+    # A task's step counter stopped advancing past the progress deadline
+    # while its heartbeats kept arriving: the user process is wedged.
+    # Payload: steps, stalled_s, timeout_s; the subsequent TASK_FINISHED
+    # carries the captured stack-dump excerpt.
+    TASK_HUNG = "TASK_HUNG"
+    # A task's step rate stayed below the configured fraction of its
+    # gang's median for the sustained window. Payload: rate vs median.
+    TASK_STRAGGLER = "TASK_STRAGGLER"
+    # One-time warning: progress liveness is configured but this task
+    # never reported a step counter — it degrades to heartbeat-only
+    # liveness (never a false hang kill).
+    TASK_PROGRESS_UNINSTRUMENTED = "TASK_PROGRESS_UNINSTRUMENTED"
 
 
 @dataclasses.dataclass

@@ -87,7 +87,9 @@ class Heartbeater(threading.Thread):
                  loss_threshold: int = 0,
                  reconnect: Optional[Callable[[], RpcClient]] = None,
                  orphan_deadline_s: float = 120.0,
-                 on_orphaned: Optional[Callable[[str], None]] = None):
+                 on_orphaned: Optional[Callable[[str], None]] = None,
+                 progress_fn: Optional[Callable[[], Optional[dict]]] = None,
+                 on_dump: Optional[Callable[[], None]] = None):
         super().__init__(name="tony-heartbeater", daemon=True)
         self._client = client
         self._task_id = task_id
@@ -97,6 +99,11 @@ class Heartbeater(threading.Thread):
         self._reconnect = reconnect
         self._orphan_deadline_s = orphan_deadline_s
         self._on_orphaned = on_orphaned
+        # Progress beacon (coordinator/liveness.py): each beat piggybacks
+        # the user process's step counter + stall age; the response may
+        # carry the coordinator's dump directive for a hung verdict.
+        self._progress_fn = progress_fn
+        self._on_dump = on_dump
         self._misses = 0
         # _stop_evt, not _stop: threading.Thread has a private _stop()
         # method; shadowing it with an Event breaks Thread.join().
@@ -117,11 +124,26 @@ class Heartbeater(threading.Thread):
                 # as if the executor were wedged — the coordinator's
                 # liveness monitor is what must notice.
                 continue
+            progress = None
+            if self._progress_fn is not None:
+                try:
+                    progress = self._progress_fn()
+                except Exception:  # noqa: BLE001 — the beat must not die
+                    progress = None
             try:
-                self._client.call("task_executor_heartbeat",
-                                  task_id=self._task_id,
-                                  session_id=self._session_id)
+                res = self._client.call("task_executor_heartbeat",
+                                        task_id=self._task_id,
+                                        session_id=self._session_id,
+                                        progress=progress)
                 self._misses = 0
+                if isinstance(res, dict) and res.get("dump") \
+                        and self._on_dump is not None:
+                    # Hung verdict: the coordinator wants all-thread
+                    # stacks from the user process before it kills it.
+                    try:
+                        self._on_dump()
+                    except Exception:  # noqa: BLE001 — best-effort
+                        log.exception("stack-dump delivery failed")
             except FencedError as e:
                 self._orphan(f"fenced by a live coordinator: {e}")
                 return
@@ -221,6 +243,24 @@ class TaskExecutor:
         self.client = self._make_client(self.coordinator_host,
                                         self.coordinator_port)
         self._orphaned_reason: Optional[str] = None
+        # Progress beacon state (coordinator/liveness.py): the executor
+        # tails the user process's telemetry file and reports the step
+        # counter plus how long ago IT last saw the counter move — a
+        # duration, so coordinator/executor clock skew never corrupts the
+        # stall measurement.
+        self._metrics_file = ""
+        self._beacon_steps: Optional[float] = None
+        self._beacon_advance_t = 0.0
+        # Signal delivered to the user process group on a hung verdict;
+        # `import tony_tpu` in the user process pre-registers a
+        # faulthandler all-thread dump on it. Operators can move it via
+        # the TONY_STACKDUMP_SIGNAL env (execution-env passthrough).
+        try:
+            self._dump_signal = int(
+                e.get(constants.STACKDUMP_SIGNAL, "") or 0) \
+                or int(signal.SIGUSR1)
+        except ValueError:
+            self._dump_signal = int(signal.SIGUSR1)
         self.hostname = e.get("TONY_ADVERTISED_HOST") or socket.gethostname()
         try:
             socket.getaddrinfo(self.hostname, None)
@@ -287,6 +327,70 @@ class TaskExecutor:
         old, self.client = self.client, client
         old.close()
         return client
+
+    # -- progress liveness (coordinator/liveness.py) ---------------------
+    def _progress_beacon(self) -> Optional[dict]:
+        """Heartbeat payload: the user process's step counter (published
+        by telemetry.step() into the metrics file) plus the age of its
+        last advance as seen from THIS process. None while the task has
+        no progress instrumentation — the coordinator then keeps it on
+        heartbeat-only liveness (one-time warning, never a false kill).
+        Any counter CHANGE counts as an advance ('!=' not '>': a user
+        process restarted inside the same task resets the counter
+        downward and is very much alive)."""
+        if not self._metrics_file:
+            return None
+        from tony_tpu import telemetry
+
+        stats = telemetry.read_stats(self._metrics_file)
+        steps = stats.get("steps_completed")
+        if steps is None:
+            return None
+        now = time.monotonic()
+        steps = float(steps)
+        if self._beacon_steps is None or steps != self._beacon_steps:
+            self._beacon_steps = steps
+            self._beacon_advance_t = now
+        return {"steps": steps,
+                "age_s": round(now - self._beacon_advance_t, 3)}
+
+    def _dump_user_stacks(self) -> None:
+        """Coordinator declared this task HUNG: deliver the dump signal so
+        the pre-registered faulthandler handler writes all-thread stacks
+        into the task log — the diagnostics pass before the
+        TERM-grace-KILL lands. The target is the PID stamped into the
+        metrics file: exactly the process whose step counter froze, and
+        by construction one that imported tony_tpu (so the handler is
+        registered). Blasting the whole group instead would kill any
+        member WITHOUT a handler — the `/bin/sh -c` wrapper dies on an
+        unhandled SIGUSR1 and turns the diagnostics pass into the kill."""
+        p = _user_proc[0] if _user_proc else None
+        if p is None or p.poll() is not None:
+            log.warning("coordinator requested a stack dump but no user "
+                        "process is running")
+            return
+        from tony_tpu import telemetry
+
+        pid = 0
+        try:
+            pid = int(telemetry.read_stats(self._metrics_file).get("pid", 0))
+        except (TypeError, ValueError):
+            pid = 0
+        try:
+            # Guard against pid recycling: only signal a pid still inside
+            # the user command's process group.
+            if not pid or os.getpgid(pid) != p.pid:
+                log.warning("no live instrumented pid to stack-dump "
+                            "(metrics pid %s outside user pgid %d)",
+                            pid or "?", p.pid)
+                return
+            log.warning("coordinator declared %s hung; sending dump "
+                        "signal %d to instrumented pid %d for an "
+                        "all-thread stack dump",
+                        self.task_id, self._dump_signal, pid)
+            os.kill(pid, self._dump_signal)
+        except (ProcessLookupError, PermissionError) as e:
+            log.warning("stack-dump signal failed: %s", e)
 
     def _orphan_teardown(self, reason: str) -> None:
         """No coordinator will ever hear from us again (deadline expired)
@@ -406,6 +510,8 @@ class TaskExecutor:
             return constants.EXIT_FAILURE
         self._localize_bundle()
         self.setup_ports()
+        metrics_file = os.path.join(os.getcwd(), "user-metrics.json")
+        self._metrics_file = metrics_file
         hb = Heartbeater(
             self.client, self.task_id,
             self.conf.get_int(K.TASK_HEARTBEAT_INTERVAL_MS, 1000) / 1000.0,
@@ -415,9 +521,10 @@ class TaskExecutor:
             reconnect=self._reconnect_coordinator,
             orphan_deadline_s=float(
                 self.conf.get_int(K.TASK_ORPHAN_DEADLINE_S, 120)),
-            on_orphaned=self._orphan_teardown)
+            on_orphaned=self._orphan_teardown,
+            progress_fn=self._progress_beacon,
+            on_dump=self._dump_user_stacks)
         hb.start()
-        metrics_file = os.path.join(os.getcwd(), "user-metrics.json")
         monitor = TaskMonitor(
             self.task_id,
             push=lambda tid, m: self.client.call("metrics.push", task_id=tid,
@@ -456,6 +563,11 @@ class TaskExecutor:
         # The user process reports its own device stats here (it owns the
         # chips; see tony_tpu/telemetry.py) and the monitor tails the file.
         env[constants.METRICS_FILE] = metrics_file
+        # Hung-task diagnostics contract: `import tony_tpu` in the user
+        # process pre-registers a faulthandler all-thread stack dump on
+        # this signal; _dump_user_stacks delivers it on the coordinator's
+        # hung verdict. Respect an operator-provided override.
+        env.setdefault(constants.STACKDUMP_SIGNAL, str(self._dump_signal))
 
         tb_proc = self._maybe_launch_tensorboard(env)
 

@@ -31,11 +31,16 @@ STEPS_PER_SEC = "STEPS_PER_SEC"
 STEP_DUTY_CYCLE = "STEP_DUTY_CYCLE"
 MODEL_FLOPS_PER_SEC = "MODEL_FLOPS_PER_SEC"
 MFU = "MFU"
+# Final step count: the same counter the executor's progress beacon rides
+# on heartbeats (hang detection, coordinator/liveness.py) — in the final
+# metrics it lets a postmortem line up "steps done" with the step rate.
+STEPS_COMPLETED = "STEPS_COMPLETED"
 _UTIL_PASSTHROUGH = {
     STEPS_PER_SEC: "steps_per_sec",
     STEP_DUTY_CYCLE: "step_duty_cycle",
     MODEL_FLOPS_PER_SEC: "model_flops_per_sec",
     MFU: "mfu_vs_peak_bf16",
+    STEPS_COMPLETED: "steps_completed",
 }
 
 

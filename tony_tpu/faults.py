@@ -21,6 +21,12 @@ site                      where it fires
                           the SIGKILL shape that --recover must survive
 ``executor.reregister``   executor reconnect: drops a re-registration
                           attempt during coordinator-loss recovery
+``user.hang``             telemetry.step_done: a firing silently drops the
+                          step recording — heartbeats continue, progress
+                          freezes (the hung-user-process shape)
+``user.slow_step``        telemetry.step_done: a firing delays the step by
+                          ``amt:`` seconds — one task's step rate skews
+                          below the gang median (the straggler shape)
 ========================  =====================================================
 
 Spec grammar (the value of ``tony.fault.<site>`` conf keys, or one
@@ -28,12 +34,18 @@ Spec grammar (the value of ``tony.fault.<site>`` conf keys, or one
 
 - ``first:N``   — fire on the first N calls of the site (per process)
 - ``at:K``      — fire on call K only (1-based)
+- ``after:N``   — fire on every call past the first N (the freeze shape:
+  progress that starts fine and then stops forever)
 - ``every:N``   — fire on every Nth call
 - ``p:X``       — fire with probability X, from a per-site RNG seeded
   with (seed, site) — the sequence of decisions is identical for a given
   seed, machine-independent
 - ``session:S`` — additional filter: only fire when this process's
   ``TONY_SESSION_ID`` is S (lets a fault hit epoch 0 and spare the retry)
+- ``task:T``    — additional filter: only fire when this process's
+  ``TONY_TASK_ID`` is T (e.g. ``task:worker:1`` — slow ONE gang member)
+- ``amt:X``     — payload for sites that take a magnitude (float,
+  site-interpreted: ``user.slow_step`` reads it as seconds of delay)
 
 Tokens combine with ``,``: ``p:0.5,session:0``. Example conf:
 
@@ -67,7 +79,8 @@ FAULTS_ENV = "TONY_FAULTS"
 #: tony_tpu/conf/keys.py: ``tony.fault.<site with . -> ->``)
 SITES = ("rpc.connect", "rpc.send", "heartbeat", "executor.spawn",
          "storage.put", "storage.get", "checkpoint.save",
-         "coordinator.crash", "executor.reregister")
+         "coordinator.crash", "executor.reregister",
+         "user.hang", "user.slow_step")
 
 
 class InjectedFault(ConnectionError):
@@ -93,18 +106,24 @@ class _SiteRule:
         self.spec = spec
         self.first = 0
         self.at = 0
+        self.after = 0
         self.every = 0
         self.p = 0.0
+        self.amount = 0.0
         self.session: Optional[int] = None
+        self.task: Optional[str] = None
         for token in spec.split(","):
             token = token.strip()
             if not token:
                 continue
-            key, sep, value = token.replace("=", ":").partition(":")
+            # Partition on the FIRST separator only: the task filter's
+            # value legitimately contains ':' ("task:worker:1").
+            key, sep, value = token.replace("=", ":", 1).partition(":")
             if not sep:
                 raise ValueError(
                     f"fault spec token {token!r} for {site!r} needs "
-                    f"key:value (one of first/at/every/p/session)")
+                    f"key:value (one of first/at/after/every/p/amt/"
+                    f"session/task)")
             key = key.strip().lower()
             value = value.strip()
             try:
@@ -112,12 +131,18 @@ class _SiteRule:
                     self.first = int(value)
                 elif key == "at":
                     self.at = int(value)
+                elif key == "after":
+                    self.after = int(value)
                 elif key == "every":
                     self.every = int(value)
                 elif key == "p":
                     self.p = float(value)
+                elif key == "amt":
+                    self.amount = float(value)
                 elif key == "session":
                     self.session = int(value)
+                elif key == "task":
+                    self.task = value
                 else:
                     raise ValueError(f"unknown fault spec key {key!r}")
             except ValueError as e:
@@ -141,9 +166,14 @@ class _SiteRule:
             env_session = int(os.environ.get("TONY_SESSION_ID", "0") or 0)
             if env_session != self.session:
                 return False, n
+        if self.task is not None:
+            if os.environ.get("TONY_TASK_ID", "") != self.task:
+                return False, n
         if self.first and n <= self.first:
             return True, n
         if self.at and n == self.at:
+            return True, n
+        if self.after and n > self.after:
             return True, n
         if self.every and n % self.every == 0:
             return True, n
@@ -172,6 +202,20 @@ class FaultInjector:
             log.warning("FAULT INJECTED at %s (call #%d, spec %r)",
                         site, call_no, rule.spec)
         return fired
+
+    def fire_amount(self, site: str) -> Optional[float]:
+        """Like fire(), but returns the rule's ``amt:`` payload when the
+        site fires (None otherwise) — for magnitude-style sites
+        (user.slow_step: seconds of injected delay per fired step)."""
+        rule = self.rules.get(site)
+        if rule is None:
+            return None
+        fired, call_no = rule.decide()
+        if not fired:
+            return None
+        log.warning("FAULT INJECTED at %s (call #%d, spec %r, amt %g)",
+                    site, call_no, rule.spec, rule.amount)
+        return rule.amount
 
     def check(self, site: str) -> None:
         """Raise InjectedFault when the site fires (transport-style sites)."""
@@ -205,6 +249,13 @@ def fire(site: str) -> bool:
     """Did the site fire? (bool-style sites: heartbeat skip)."""
     inj = _active
     return inj is not None and inj.fire(site)
+
+
+def fire_amount(site: str) -> Optional[float]:
+    """Did the site fire, and with what ``amt:`` payload? None = no
+    (magnitude-style sites: user.slow_step)."""
+    inj = _active
+    return inj.fire_amount(site) if inj is not None else None
 
 
 def check(site: str) -> None:

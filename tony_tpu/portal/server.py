@@ -282,7 +282,30 @@ class PortalServer:
         self._send_html(
             req, f"<h1>metrics — {html.escape(job_id)}</h1>"
                  f"<table border=1 cellpadding=4><tr><th>task</th>{head}"
-                 f"</tr>{rows}</table>")
+                 f"</tr>{rows}</table>" + self._liveness_incidents(evs))
+
+    #: progress-liveness event types surfaced as incidents on the metrics
+    #: view (coordinator/liveness.py verdicts).
+    _LIVENESS_EVENTS = ("TASK_HUNG", "TASK_STRAGGLER",
+                        "TASK_PROGRESS_UNINSTRUMENTED")
+
+    def _liveness_incidents(self, evs) -> str:
+        """Hang/straggler incident table for the metrics view: the 'why
+        did this job restart / crawl' answer next to the utilization
+        numbers (full payloads — including the stack-dump excerpt riding
+        the hang-kill TASK_FINISHED — stay in the events view)."""
+        incidents = [e for e in evs if e.type in self._LIVENESS_EVENTS]
+        if not incidents:
+            return ""
+        rows = "".join(
+            f"<tr><td>{e.timestamp_ms}</td>"
+            f"<td>{html.escape(e.type)}</td>"
+            f"<td>{html.escape(str(e.payload.get('task', '?')))}</td>"
+            f"<td><pre>{html.escape(json.dumps({k: v for k, v in e.payload.items() if k not in ('task', 'session_id')}, indent=1))}"
+            f"</pre></td></tr>" for e in incidents)
+        return (f"<h2>liveness incidents</h2>"
+                f"<table border=1 cellpadding=4><tr><th>ts</th><th>type"
+                f"</th><th>task</th><th>detail</th></tr>{rows}</table>")
 
     @staticmethod
     def _fmt_metric(v) -> str:

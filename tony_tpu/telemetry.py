@@ -63,6 +63,20 @@ def step_done(started_at: float, flops: float = 0.0,
               tokens: float = 0.0) -> None:
     """Record one completed training step that began at ``started_at``
     (``time.monotonic()``). Prefer the ``step()`` context manager."""
+    from tony_tpu import faults
+
+    if faults.fire("user.hang"):
+        # Injected user hang: the recording is silently dropped, so the
+        # published step counter freezes while the process (and its
+        # executor's heartbeats) keep running — exactly the shape the
+        # coordinator's progress-based liveness must catch.
+        return
+    delay = faults.fire_amount("user.slow_step")
+    if delay:
+        # Injected straggler skew: stretch this step by the configured
+        # amount BEFORE timestamping, so the slowdown lands in the step
+        # rate the gang-median policing compares.
+        time.sleep(delay)
     now = time.monotonic()
     with _step_lock:
         if not _steps["first_start"]:
@@ -110,39 +124,46 @@ def step_stats() -> Dict[str, float]:
 
 
 def collect_device_stats() -> Dict[str, float]:
-    """Best-effort per-process accelerator stats; {} when no runtime is up
-    in this process."""
-    if "jax" not in sys.modules:
-        return {}
-    try:
-        jax = sys.modules["jax"]
-        devices = jax.local_devices()
-    except Exception:  # noqa: BLE001 — telemetry must never break the task
-        return {}
-    out: Dict[str, float] = {"device_count": float(len(devices))}
-    in_use = peak = 0.0
-    per_device = []
-    for d in devices:
+    """Best-effort per-process accelerator + step stats; {} when neither is
+    available. Step stats publish WITHOUT a jax runtime — a PyTorch or
+    plain-Python loop wrapped in telemetry.step() still feeds the progress
+    beacon the coordinator's hang detection watches (device stats alone
+    stay jax-gated: this module never imports jax itself)."""
+    out: Dict[str, float] = {}
+    per_device: list = []
+    jax = None
+    if "jax" in sys.modules:
         try:
-            stats = d.memory_stats() or {}
-        except Exception:  # noqa: BLE001
-            stats = {}
-        b = float(stats.get("bytes_in_use", 0) or 0)
-        p = float(stats.get("peak_bytes_in_use", b) or b)
-        in_use += b
-        peak += p
-        per_device.append({"kind": getattr(d, "device_kind", "?"),
-                           "bytes_in_use": b, "peak_bytes_in_use": p})
-    out["hbm_bytes_in_use"] = in_use
-    out["hbm_peak_bytes"] = peak
-    out["devices"] = per_device  # type: ignore[assignment]
+            jax = sys.modules["jax"]
+            devices = jax.local_devices()
+        except Exception:  # noqa: BLE001 — telemetry must never break the task
+            jax, devices = None, []
+        if jax is not None:
+            out["device_count"] = float(len(devices))
+            in_use = peak = 0.0
+            for d in devices:
+                try:
+                    stats = d.memory_stats() or {}
+                except Exception:  # noqa: BLE001
+                    stats = {}
+                b = float(stats.get("bytes_in_use", 0) or 0)
+                p = float(stats.get("peak_bytes_in_use", b) or b)
+                in_use += b
+                peak += p
+                per_device.append({"kind": getattr(d, "device_kind", "?"),
+                                   "bytes_in_use": b,
+                                   "peak_bytes_in_use": p})
+            out["hbm_bytes_in_use"] = in_use
+            out["hbm_peak_bytes"] = peak
+            out["devices"] = per_device  # type: ignore[assignment]
     util = step_stats()
     if util:
         out.update(util)
         kind = per_device[0]["kind"] if per_device else ""
         peak_fl = next((v for k, v in PEAK_BF16_FLOPS.items()
                         if str(kind).startswith(k)), None)
-        if peak_fl and util.get("model_flops_per_sec"):
+        if jax is not None and peak_fl \
+                and util.get("model_flops_per_sec"):
             # flops passed to step() are the model's GLOBAL per-step FLOPs
             # (the 6·N·B·S convention over the global batch), so the
             # denominator must be the GLOBAL device pool — local devices
@@ -151,7 +172,7 @@ def collect_device_stats() -> Dict[str, float]:
             try:
                 n_global = jax.device_count()
             except Exception:  # noqa: BLE001
-                n_global = len(devices)
+                n_global = len(per_device) or 1
             out["mfu_vs_peak_bf16"] = (util["model_flops_per_sec"]
                                        / (peak_fl * n_global))
     return out
@@ -182,11 +203,19 @@ def _loop(path: str, interval_s: float) -> None:
 def maybe_start(interval_s: float = 3.0) -> bool:
     """Start the reporter iff TONY_METRICS_FILE is set and it isn't running
     yet. Called from tony_tpu/__init__ — a bare import inside a task is
-    enough to light up HBM telemetry."""
+    enough to light up HBM telemetry. ``TONY_TELEMETRY_INTERVAL_S``
+    overrides the cadence (progress-liveness tests tighten it so step
+    counters publish faster than the progress deadline)."""
     global _thread
     path = os.environ.get(constants.METRICS_FILE, "")
     if not path:
         return False
+    try:
+        interval_s = float(
+            os.environ.get(constants.TELEMETRY_INTERVAL_ENV, "")
+            or interval_s)
+    except ValueError:
+        pass
     with _started:
         if _thread is not None and _thread.is_alive():
             return True
@@ -203,3 +232,64 @@ def read_stats(path: str) -> Dict[str, float]:
             return json.load(f)
     except (OSError, ValueError):
         return {}
+
+
+# ---------------------------------------------------------------------------
+# Hung-task diagnostics: pre-registered all-thread stack dump.
+#
+# When the coordinator declares a task HUNG (progress frozen, heartbeats
+# alive — coordinator/liveness.py) the executor signals the USER process
+# group with the signal it exported as TONY_STACKDUMP_SIGNAL. This handler
+# — registered at `import tony_tpu`, i.e. before the user code can wedge —
+# makes that signal dump every thread's stack to stderr (the task log),
+# turning "it just stopped" postmortems into tracebacks.
+# ---------------------------------------------------------------------------
+_dump_registered = False
+
+
+def install_stack_dump_handler(stream=None) -> bool:
+    """Register a faulthandler all-thread stack dump on the signal named by
+    ``TONY_STACKDUMP_SIGNAL`` (exported by the executor into the user
+    env). No-op without the env var. A handler the user already installed
+    on that signal is detected and warned about, never broken: the dump
+    chains to it (both run). Returns True iff the dump handler is armed."""
+    global _dump_registered
+    spec = os.environ.get(constants.STACKDUMP_SIGNAL, "")
+    if not spec:
+        return False
+    if _dump_registered:
+        return True
+    try:
+        signum = int(spec)
+    except ValueError:
+        return False
+    import faulthandler
+    import logging
+    import signal as _signal
+
+    try:
+        existing = _signal.getsignal(signum)
+    except (ValueError, OSError):
+        return False
+    chain = callable(existing) and \
+        existing is not _signal.default_int_handler
+    if chain:
+        # The user process got here with its own handler already on the
+        # dump signal (framework or user code). Do not break it — chain —
+        # but say so, because a handler that exits would still cut the
+        # dump short. Chaining over SIG_DFL would instead re-run the
+        # signal's DEFAULT action (terminate, for SIGUSR1/2) and kill the
+        # process we are trying to diagnose — hence callable-only.
+        logging.getLogger(__name__).warning(
+            "signal %d already has a user handler (%r); chaining the "
+            "tony-tpu stack-dump handler in front of it — hung-task "
+            "dumps will run both", signum, existing)
+    try:
+        faulthandler.register(signum, file=stream or sys.stderr,
+                              all_threads=True, chain=chain)
+    except (ValueError, OSError, RuntimeError, AttributeError):
+        # Non-main interpreter, closed stderr, or a platform without
+        # faulthandler signals: diagnostics are best-effort, never fatal.
+        return False
+    _dump_registered = True
+    return True
