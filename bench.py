@@ -57,13 +57,55 @@ def _retry(what, fn, attempts=4, backoff_s=5.0):
             backoff_s *= 2
 
 
+def _span_first_step_latency(history_root):
+    """submit_to_first_step_s measured from the REAL trace spans (the
+    client.submit span's start to the executor.first_step span's end),
+    not wall-clock guesses — and a tracing regression check in the same
+    breath: a missing span tree (no log, no submit span, no first-step
+    span, or unclosed spans) raises, failing the orchestration point
+    loudly instead of silently reporting a probe-local number."""
+    from tony_tpu import constants as tony_constants
+    from tony_tpu import tracing
+    from tony_tpu.events import history as tony_history
+
+    job_dirs = tony_history.list_job_dirs(history_root)
+    if not job_dirs:
+        raise RuntimeError(f"span check: no job dirs under {history_root}")
+    (app, job_dir), = list(job_dirs.items())[:1]
+    path = os.path.join(job_dir, tony_constants.TRACE_FILE)
+    records = tracing.load_records(path)
+    if not records:
+        raise RuntimeError(
+            f"span tree MISSING for {app}: no records at {path} — "
+            f"tracing is broken (tony.trace.enabled off, or a span-log "
+            f"regression)")
+    payload = tracing.to_trace_events(records)
+    if payload["unclosedSpans"]:
+        raise RuntimeError(
+            f"span tree for {app} has unclosed spans: "
+            f"{payload['unclosedSpans']} — tracing regression")
+    spans = {e["name"]: e for e in payload["traceEvents"]
+             if e.get("ph") == "X"}
+    submit = spans.get("client.submit")
+    first = spans.get("executor.first_step")
+    if submit is None or first is None:
+        raise RuntimeError(
+            f"span tree for {app} lacks "
+            f"{'client.submit' if submit is None else 'executor.first_step'}"
+            f" (have: {sorted(spans)}) — tracing regression")
+    return ((first["ts"] + first.get("dur", 0)) - submit["ts"]) / 1e6
+
+
 def bench_orchestration_latency():
     """Submit-to-first-step seconds through the FULL stack (BASELINE.json
     named metric): a 1-worker job on the tpu-slice backend (LocalSim host
     channel — the executor/barrier/runtime-env path a real slice uses),
     whose user script jits one step on whatever accelerator is visible.
-    Must run before this process touches the JAX backend: the worker needs
-    the chip. Reference observable: ``TonyClient.java:838-892`` poll loop."""
+    Since the tracing PR the headline number comes from the job's OWN
+    trace spans (client.submit → executor.first_step), so the bench
+    trajectory doubles as a tracing regression check; the probe's
+    self-reported wall-clock stays as a cross-check. Must run before this
+    process touches the JAX backend: the worker needs the chip."""
     tmp = tempfile.mkdtemp(prefix="tony-bench-orch-")
     result = os.path.join(tmp, "result.json")
     env = dict(os.environ)
@@ -90,7 +132,13 @@ def bench_orchestration_latency():
             f"orchestration bench job failed (rc={r.returncode}): "
             f"{r.stdout[-2000:]}\n{r.stderr[-2000:]}")
     with open(result) as f:
-        return json.load(f)
+        out = json.load(f)
+    # The probe's wall-clock number becomes the cross-check; the headline
+    # is span-derived (and raises if the span tree is missing/unclosed).
+    out["probe_self_reported_s"] = out.pop("submit_to_first_step_s", None)
+    out["submit_to_first_step_s"] = round(
+        _span_first_step_latency(os.path.join(tmp, "history")), 2)
+    return out
 
 
 def _time_scan(run_steps, state, inputs_for_rep, reps,

@@ -21,6 +21,9 @@ import jax
 import jax.numpy as jnp
 import optax
 
+import tony_tpu  # noqa: F401  (starts the telemetry reporter in-task)
+from tony_tpu import telemetry
+
 t0 = float(os.environ["TONY_BENCH_T0"])
 
 from tony_tpu.models import Transformer, TransformerConfig  # noqa: E402
@@ -51,9 +54,19 @@ def step(state, tokens):
     return state.apply_gradients(grads), l
 
 
-state, l = step(state, tokens)
-jax.block_until_ready(l)
+# telemetry.step() feeds the step counter the executor's beacon reads —
+# the first-step TRACE SPAN (and bench.py's span-derived
+# submit_to_first_step_s) anchor on its wall-clock completion timestamp.
+with telemetry.step():
+    state, l = step(state, tokens)
+    jax.block_until_ready(l)
 dt = time.time() - t0
+# Publish the final counter synchronously: this script exits faster than
+# the reporter thread's next cadence tick, and the executor must see
+# steps_completed=1 to emit the first-step span.
+metrics_file = os.environ.get("TONY_METRICS_FILE", "")
+if metrics_file:
+    telemetry.write_stats_once(metrics_file)
 
 with open(os.environ["TONY_BENCH_RESULT"], "w") as f:
     json.dump({"submit_to_first_step_s": round(dt, 2),

@@ -1,0 +1,195 @@
+"""Fast unit suite for the Prometheus exposition layer
+(tony_tpu/metrics.py): text-format validity (label escaping, sample
+line grammar), gauge ring-buffer bounds, histogram cumulative-bucket
+rendering, counter monotonicity — including ACROSS a coordinator
+``--recover`` via the save/load snapshot — and the beacon-shipped
+histogram snapshot path. Select with ``pytest -m faults``.
+"""
+
+import re
+
+import pytest
+
+from tony_tpu import metrics
+from tony_tpu.metrics import (Counter, Histogram, MetricsRegistry,
+                              escape_label_value)
+
+pytestmark = pytest.mark.faults
+
+#: one exposition sample line: name{labels} value
+_SAMPLE_RE = re.compile(
+    r'^[a-zA-Z_:][a-zA-Z0-9_:]*(\{[a-zA-Z_][a-zA-Z0-9_]*="(?:[^"\\]|\\.)*"'
+    r'(,[a-zA-Z_][a-zA-Z0-9_]*="(?:[^"\\]|\\.)*")*\})? '
+    r"[0-9eE.+-]+(inf)?$|^# (HELP|TYPE) .*$")
+
+
+def _assert_valid_exposition(text):
+    assert text.endswith("\n")
+    for line in text.splitlines():
+        assert _SAMPLE_RE.match(line), f"bad exposition line: {line!r}"
+
+
+# ---------------------------------------------------------------------------
+# Label escaping
+# ---------------------------------------------------------------------------
+def test_label_escaping_backslash_quote_newline():
+    assert escape_label_value('a"b') == 'a\\"b'
+    assert escape_label_value("a\\b") == "a\\\\b"
+    assert escape_label_value("a\nb") == "a\\nb"
+    # order matters: the backslash introduced by newline-escaping must
+    # not be re-escaped
+    assert escape_label_value("\n") == "\\n"
+    assert escape_label_value('\\"') == '\\\\\\"'
+
+
+def test_escaped_labels_render_as_valid_exposition():
+    reg = MetricsRegistry()
+    reg.gauge("tony_task_steps_per_sec",
+              {"app": 'job"with\nweird\\chars', "task": "worker:0"}).set(3.5)
+    text = reg.render()
+    _assert_valid_exposition(text)
+    assert 'job\\"with\\nweird\\\\chars' in text
+
+
+# ---------------------------------------------------------------------------
+# Gauges: ring buffer bounds + latest-value rendering
+# ---------------------------------------------------------------------------
+def test_gauge_ring_buffer_is_bounded():
+    reg = MetricsRegistry(ring_points=16)
+    g = reg.gauge("tony_task_steps_per_sec", {"task": "w:0"})
+    for i in range(1000):
+        g.set(float(i))
+    hist = reg.gauge_history("tony_task_steps_per_sec", {"task": "w:0"})
+    assert len(hist) == 16
+    assert hist[-1] == 999.0
+    assert reg.gauge_value("tony_task_steps_per_sec",
+                           {"task": "w:0"}) == 999.0
+
+
+def test_gauge_label_order_is_canonical():
+    reg = MetricsRegistry()
+    reg.gauge("g", {"b": "2", "a": "1"}).set(1)
+    assert reg.gauge_value("g", {"a": "1", "b": "2"}) == 1
+    assert 'g{a="1",b="2"} 1' in reg.render()
+
+
+def test_drop_labels_removes_matching_series():
+    reg = MetricsRegistry()
+    reg.gauge("g", {"app": "a", "task": "w:0"}).set(1)
+    reg.gauge("g", {"app": "a", "task": "w:1"}).set(2)
+    reg.drop_labels({"task": "w:0"})
+    assert reg.gauge_value("g", {"app": "a", "task": "w:0"}) is None
+    assert reg.gauge_value("g", {"app": "a", "task": "w:1"}) == 2
+
+
+# ---------------------------------------------------------------------------
+# Counters: monotonicity, including across --recover
+# ---------------------------------------------------------------------------
+def test_counter_rejects_decrement():
+    c = Counter()
+    c.inc()
+    with pytest.raises(ValueError):
+        c.inc(-1)
+    assert c.value == 1
+
+
+def test_counter_monotonic_across_recover(tmp_path):
+    """The --recover contract: a new registry (new coordinator process)
+    loading the snapshot resumes counters AT their saved values — the
+    exposition never steps backwards across a coordinator replacement."""
+    path = str(tmp_path / "metrics.counters.json")
+    reg1 = MetricsRegistry()
+    labels = {"app": "a1", "method": "task_executor_heartbeat",
+              "ok": "true"}
+    for _ in range(7):
+        reg1.counter("tony_rpc_requests_total", labels).inc()
+    reg1.counter("tony_events_total", {"type": "TASK_STARTED"}).inc(2)
+    reg1.save_counters(path)
+
+    reg2 = MetricsRegistry()            # the recovered coordinator
+    assert reg2.load_counters(path)
+    c = reg2.counter("tony_rpc_requests_total", labels)
+    assert c.value == 7                 # resumed, not reset
+    c.inc()
+    assert c.value == 8
+    assert reg2.counter("tony_events_total",
+                        {"type": "TASK_STARTED"}).value == 2
+    # an unrelated counter still starts at zero
+    assert reg2.counter("tony_rpc_requests_total",
+                        {"app": "other"}).value == 0
+    _assert_valid_exposition(reg2.render())
+
+
+def test_load_counters_tolerates_missing_and_garbage(tmp_path):
+    reg = MetricsRegistry()
+    assert not reg.load_counters(str(tmp_path / "absent.json"))
+    bad = tmp_path / "bad.json"
+    bad.write_text("{ torn")
+    assert not reg.load_counters(str(bad))
+    assert reg.counter("c", {}).value == 0
+
+
+# ---------------------------------------------------------------------------
+# Histograms
+# ---------------------------------------------------------------------------
+def test_histogram_cumulative_buckets_and_inf():
+    h = Histogram(buckets=(0.01, 0.1, 1.0))
+    for v in (0.005, 0.005, 0.05, 0.5, 5.0):
+        h.observe(v)
+    reg = MetricsRegistry()
+    lines = metrics.render_histogram_lines(
+        "tony_rpc_server_seconds", metrics._labels_key({"method": "hb"}),
+        h.snapshot())
+    text = "\n".join(lines) + "\n"
+    _assert_valid_exposition(text)
+    assert 'le="0.01"} 2' in text
+    assert 'le="0.1"} 3' in text
+    assert 'le="1"} 4' in text
+    assert 'le="+Inf"} 5' in text
+    assert "tony_rpc_server_seconds_count" in text
+    # cumulative counts never decrease
+    cums = [int(ln.rsplit(" ", 1)[1]) for ln in lines if "_bucket" in ln]
+    assert cums == sorted(cums)
+    assert reg.render() == ""           # nothing registered yet
+
+
+def test_registry_histogram_and_beacon_snapshot_render():
+    """Both histogram paths — locally observed (server-side) and
+    beacon-shipped snapshots (executor client-side) — render under one
+    # TYPE header as valid exposition."""
+    reg = MetricsRegistry()
+    reg.histogram("tony_rpc_server_seconds",
+                  {"app": "a", "method": "ping"},
+                  buckets=(0.1, 1.0)).observe(0.05)
+    reg.set_histogram_snapshot(
+        "tony_rpc_client_seconds", {"app": "a", "task": "w:0"},
+        {"buckets": [0.1, 1.0], "counts": [3, 1, 0], "sum": 0.42,
+         "count": 4})
+    text = reg.render()
+    _assert_valid_exposition(text)
+    assert text.count("# TYPE tony_rpc_server_seconds histogram") == 1
+    assert text.count("# TYPE tony_rpc_client_seconds histogram") == 1
+    assert 'tony_rpc_client_seconds_bucket{app="a",task="w:0",le="0.1"} 3' \
+        in text
+    assert 'tony_rpc_client_seconds_count{app="a",task="w:0"} 4' in text
+    # malformed beacon snapshots are ignored, never rendered
+    reg.set_histogram_snapshot("tony_rpc_client_seconds",
+                               {"task": "bad"}, {"nonsense": 1})
+    assert '"bad"' not in reg.render()
+
+
+def test_full_registry_render_is_valid_exposition():
+    reg = MetricsRegistry()
+    reg.gauge("tony_task_mfu", {"app": "a", "task": "w:0"},
+              help="MFU vs peak bf16.").set(0.41)
+    reg.counter("tony_rpc_requests_total",
+                {"app": "a", "method": "ping", "ok": "true"},
+                help="RPC requests.").inc(3)
+    reg.histogram("tony_rpc_server_seconds", {"app": "a", "method": "p"},
+                  buckets=(0.1,)).observe(0.2)
+    text = reg.render()
+    _assert_valid_exposition(text)
+    # TYPE precedes that family's samples
+    lines = text.splitlines()
+    assert lines.index("# TYPE tony_task_mfu gauge") \
+        < lines.index('tony_task_mfu{app="a",task="w:0"} 0.41')

@@ -1,0 +1,265 @@
+"""Fast deterministic unit suite for the distributed-tracing layer
+(tony_tpu/tracing.py): span record grammar (B/E/X/I), file vs buffer
+sinks, Perfetto export with unclosed-span detection, trace-id recovery,
+RPC trace-context propagation through real wire frames, the RPC
+latency/observability hooks, and the new ``rpc.slow`` fault site.
+Select with ``pytest -m faults``.
+"""
+
+import json
+import os
+import time
+
+import pytest
+
+from tony_tpu import faults, tracing
+from tony_tpu.rpc.wire import RpcClient, RpcServer
+
+pytestmark = pytest.mark.faults
+
+
+@pytest.fixture(autouse=True)
+def _clean():
+    faults.uninstall()
+    tracing.clear_rpc_context()
+    yield
+    faults.uninstall()
+    tracing.clear_rpc_context()
+
+
+# ---------------------------------------------------------------------------
+# Span records + sinks
+# ---------------------------------------------------------------------------
+def test_file_sink_begin_end_records(tmp_path):
+    """A file-sink tracer writes B at open and E at close — a crashed
+    process leaves evidence of what was in flight."""
+    path = str(tmp_path / "trace.spans.jsonl")
+    t = tracing.Tracer(service="coordinator", path=path)
+    span = t.start_span("coordinator.run", attrs={"app": "a1"})
+    child = t.start_span("session.epoch", parent=span, task="worker:0")
+    child.end(status="SUCCEEDED")
+    span.end()
+    t.close()
+    recs = tracing.load_records(path)
+    assert [r["ev"] for r in recs] == ["B", "B", "E", "E"]
+    assert recs[0]["name"] == "coordinator.run"
+    assert recs[1]["parent"] == recs[0]["span"]
+    assert recs[1]["task"] == "worker:0"
+    # E merges close-time attrs; export folds them into the span.
+    assert recs[2]["args"] == {"status": "SUCCEEDED"}
+
+
+def test_buffer_sink_only_ships_complete_spans():
+    """Buffer-mode tracers (executors) emit nothing at open: a lost push
+    can drop spans but never manufacture an unclosed one."""
+    t = tracing.Tracer(service="executor:worker:0")
+    span = t.start_span("executor.run")
+    assert t.drain() == []          # nothing until the span closes
+    span.end(exit_code=0)
+    recs = t.drain()
+    assert len(recs) == 1 and recs[0]["ev"] == "X"
+    assert recs[0]["args"] == {"exit_code": 0}
+    assert recs[0]["dur_us"] >= 0
+    assert t.drain() == []          # drained exactly once
+
+
+def test_span_end_is_idempotent_and_monotonic():
+    t = tracing.Tracer(service="x")
+    span = t.start_span("s")
+    span.end(first=True)
+    span.end(second=True)           # ignored
+    recs = t.drain()
+    assert len(recs) == 1
+    assert recs[0]["args"] == {"first": True}
+
+
+def test_disabled_tracer_is_inert(tmp_path):
+    path = str(tmp_path / "t.jsonl")
+    t = tracing.Tracer(service="x", path=path, enabled=False)
+    span = t.start_span("never")
+    assert span is tracing.NULL_SPAN
+    span.end()
+    t.emit("e", start_us=0, end_us=1)
+    t.instant("i")
+    assert not os.path.exists(path)
+
+
+def test_write_records_validates_and_appends(tmp_path):
+    """trace.push intake: well-formed records land, junk is dropped."""
+    path = str(tmp_path / "t.jsonl")
+    t = tracing.Tracer(service="coordinator", path=path)
+    good = {"ev": "X", "trace": t.trace_id, "span": "s1", "parent": "",
+            "name": "executor.run", "svc": "executor:w:0", "task": "w:0",
+            "ts_us": 5, "dur_us": 2, "args": {}}
+    n = t.write_records([good, {"ev": "??"}, "junk", None])
+    t.close()
+    assert n == 1
+    assert tracing.load_records(path) == [good]
+
+
+def test_existing_trace_id_recovery(tmp_path):
+    """A --recover coordinator rejoins the ORIGINAL trace by reading the
+    id back from the span log."""
+    path = str(tmp_path / "t.jsonl")
+    t1 = tracing.Tracer(service="coordinator", path=path)
+    t1.start_span("coordinator.run")   # left unclosed: the crash shape
+    t1.close()
+    assert tracing.existing_trace_id(path) == t1.trace_id
+    t2 = tracing.Tracer(trace_id=tracing.existing_trace_id(path),
+                        service="coordinator", path=path)
+    assert t2.trace_id == t1.trace_id
+    assert tracing.existing_trace_id(str(tmp_path / "absent.jsonl")) == ""
+
+
+def test_load_records_tolerates_torn_tail(tmp_path):
+    path = str(tmp_path / "t.jsonl")
+    with open(path, "w") as f:
+        f.write(json.dumps({"ev": "I", "trace": "t", "span": "s",
+                            "name": "a", "svc": "c", "ts_us": 1,
+                            "args": {}}) + "\n")
+        f.write('{"ev": "B", "trunc')     # torn final line
+    recs = tracing.load_records(path)
+    assert len(recs) == 1 and recs[0]["name"] == "a"
+
+
+# ---------------------------------------------------------------------------
+# Perfetto export
+# ---------------------------------------------------------------------------
+def test_to_trace_events_complete_tree_and_metadata(tmp_path):
+    path = str(tmp_path / "t.jsonl")
+    t = tracing.Tracer(service="coordinator", path=path)
+    root = t.start_span("coordinator.run")
+    t.emit("executor.first_step", start_us=root.start_us + 10,
+           end_us=root.start_us + 50, parent=root, task="worker:0")
+    t.instant("application.finished", parent=root,
+              attrs={"status": "SUCCEEDED"})
+    root.end()
+    t.close()
+    payload = tracing.to_trace_events(tracing.load_records(path))
+    assert payload["unclosedSpans"] == []
+    assert payload["traceId"] == t.trace_id
+    xs = {e["name"]: e for e in payload["traceEvents"]
+          if e.get("ph") == "X"}
+    assert set(xs) == {"coordinator.run", "executor.first_step"}
+    assert xs["executor.first_step"]["dur"] == 40
+    assert xs["executor.first_step"]["args"]["parent"] == root.span_id
+    # instant + process metadata present
+    phs = {e["ph"] for e in payload["traceEvents"]}
+    assert {"X", "i", "M"} <= phs
+    # valid JSON end-to-end (the Perfetto loadability contract)
+    assert json.loads(json.dumps(payload))["displayTimeUnit"] == "ms"
+
+
+def test_unclosed_span_detection(tmp_path):
+    path = str(tmp_path / "t.jsonl")
+    t = tracing.Tracer(service="coordinator", path=path)
+    t.start_span("task.lifecycle", task="worker:1")   # never ended
+    done = t.start_span("session.epoch")
+    done.end()
+    t.close()
+    payload = tracing.to_trace_events(tracing.load_records(path))
+    assert payload["unclosedSpans"] == ["task.lifecycle"]
+    assert [e["name"] for e in payload["traceEvents"]
+            if e.get("ph") == "X"] == ["session.epoch"]
+
+
+# ---------------------------------------------------------------------------
+# RPC integration: trace context, observability hooks, rpc.slow
+# ---------------------------------------------------------------------------
+class _Service:
+    def __init__(self):
+        self.seen_ctx = None
+
+    def ping(self, x: int = 0) -> int:
+        self.seen_ctx = tracing.get_rpc_context()
+        return x + 1
+
+    def boom(self) -> None:
+        raise ValueError("nope")
+
+
+def _server_client(**client_kw):
+    svc = _Service()
+    requests = []
+    server = RpcServer(svc, on_request=lambda m, s, ok:
+                       requests.append((m, s, ok)))
+    server.start()
+    client = RpcClient("127.0.0.1", server.port, max_retries=2,
+                       retry_sleep_s=0.05, **client_kw)
+    return svc, server, client, requests
+
+
+def test_trace_context_propagates_through_frames():
+    """The 'tc' field rides the inner request next to 'gen'; the server
+    parks it thread-locally around dispatch and clears it after."""
+    svc, server, client, requests = _server_client()
+    try:
+        client.trace_context = ("trace123", "span456")
+        assert client.call("ping", x=1) == 2
+        assert svc.seen_ctx == ("trace123", "span456")
+        # cleared between requests: an untraced call sees nothing
+        client.trace_context = None
+        client.call("ping", x=1)
+        assert svc.seen_ctx is None
+    finally:
+        client.close()
+        server.stop()
+
+
+def test_on_request_hook_times_every_dispatch_including_errors():
+    svc, server, client, requests = _server_client()
+    try:
+        client.call("ping", x=0)
+        with pytest.raises(Exception):
+            client.call("boom")
+    finally:
+        client.close()
+        server.stop()
+    assert [(m, ok) for m, _, ok in requests] == [("ping", True),
+                                                  ("boom", False)]
+    assert all(s >= 0 for _, s, _ in requests)
+
+
+def test_on_latency_hook_fires_on_success_only():
+    latencies = []
+    svc, server, client, _ = _server_client(
+        on_latency=lambda m, s: latencies.append((m, s)))
+    try:
+        client.call("ping", x=0)
+        with pytest.raises(Exception):
+            client.call("boom")
+    finally:
+        client.close()
+        server.stop()
+    assert [m for m, _ in latencies] == ["ping"]
+    assert latencies[0][1] >= 0
+
+
+def test_rpc_slow_fault_injects_latency_without_dropping():
+    """rpc.slow: the deterministic exercise for latency histograms and
+    spans — the call is delayed by amt seconds, then SUCCEEDS (no retry,
+    no connection error)."""
+    assert "rpc.slow" in faults.SITES
+    faults.install(faults.parse_spec("rpc.slow=first:1,amt:0.08"))
+    latencies = []
+    svc, server, client, _ = _server_client(
+        on_latency=lambda m, s: latencies.append(s))
+    try:
+        t0 = time.monotonic()
+        assert client.call("ping", x=5) == 6       # fired: delayed
+        slow_dt = time.monotonic() - t0
+        assert client.call("ping", x=5) == 6       # past first:1 — fast
+    finally:
+        client.close()
+        server.stop()
+    assert slow_dt >= 0.08
+    # the injected delay happens BEFORE the timed send: measured latency
+    # reflects the genuine wire time, the wall-clock shows the injection
+    assert len(latencies) == 2
+
+
+def test_rpc_slow_conf_key_registered():
+    from tony_tpu.conf import keys as K
+
+    assert K.fault_key("rpc.slow") == "tony.fault.rpc-slow"
+    assert "tony.fault.rpc-slow" in K.registry()

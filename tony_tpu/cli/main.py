@@ -15,10 +15,12 @@ Usage:
 from __future__ import annotations
 
 import argparse
+import json
 import logging
 import os
 import signal
 import sys
+import time
 from typing import List, Optional
 
 from tony_tpu.client import TaskUpdateListener, TonyTpuClient
@@ -204,7 +206,8 @@ def _cmd_status(args: argparse.Namespace) -> int:
                 print(f"tb_url:   {report['tb_url']}")
             for t in report.get("tasks", []):
                 print(f"  {t['name']}:{t['index']:<3} {t['status']:<10} "
-                      f"{t.get('host', '') or ''}{_fmt_progress(t)}")
+                      f"{t.get('host', '') or ''}{_fmt_hb_age(t)}"
+                      f"{_fmt_progress(t)}")
             return 0
         except Exception as e:  # noqa: BLE001
             print(f"(coordinator unreachable: {e}; trying history)",
@@ -223,6 +226,16 @@ def _cmd_status(args: argparse.Namespace) -> int:
           f"{_default_workdir(args.workdir)}, no history under {root})",
           file=sys.stderr)
     return 1
+
+
+def _fmt_hb_age(task: dict) -> str:
+    """Heartbeat-age column for a status row, sourced from the same
+    liveness map the coordinator's heartbeat monitor expires on (absent
+    for terminal/unregistered tasks)."""
+    age = task.get("last_heartbeat_age_s")
+    if age is None:
+        return ""
+    return f"  hb={float(age):.1f}s"
 
 
 def _fmt_progress(task: dict) -> str:
@@ -244,6 +257,125 @@ def _fmt_progress(task: dict) -> str:
     if state in ("hung", "straggler"):
         out += f" {state.upper()}"
     return out
+
+
+_SPARK_BLOCKS = "▁▂▃▄▅▆▇█"
+
+
+def _sparkline(values) -> str:
+    vals = [max(0.0, float(v)) for v in values][-24:]
+    if not vals:
+        return ""
+    hi = max(vals) or 1.0
+    return "".join(_SPARK_BLOCKS[min(7, int(7 * v / hi))] for v in vals)
+
+
+def _fmt_bytes(n) -> str:
+    if n is None:
+        return "-"
+    n = float(n)
+    for unit in ("B", "KiB", "MiB", "GiB", "TiB"):
+        if n < 1024 or unit == "TiB":
+            return f"{n:.0f}{unit}" if unit == "B" else f"{n:.1f}{unit}"
+        n /= 1024
+    return "?"
+
+
+def _render_top(snap: dict) -> str:
+    """One frame of the `tony-tpu top` live view from a metrics.live
+    snapshot: per-task utilization + heartbeat age + a steps/s sparkline
+    (the coordinator's ring-buffer series)."""
+    lines = [f"{snap.get('app_id', '?')}  status={snap.get('status', '?')}"
+             f"  epoch={snap.get('session_id', '?')}"
+             f"  generation={snap.get('generation', '?')}",
+             f"{'TASK':<14}{'STATUS':<11}{'STEPS':>8}{'STEPS/S':>9}"
+             f"{'MFU':>7}{'HBM':>10}{'RSS':>10}{'HB AGE':>8}  "
+             f"{'STATE':<11}TREND"]
+    for t in snap.get("tasks", []):
+        steps = t.get("steps")
+        rate = t.get("steps_per_sec")
+        mfu = t.get("mfu")
+        hb = t.get("heartbeat_age_s")
+        lines.append(
+            f"{t.get('task', '?'):<14}{t.get('status', '?'):<11}"
+            f"{(f'{steps:g}' if steps is not None else '-'):>8}"
+            f"{(f'{rate:.2f}' if rate is not None else '-'):>9}"
+            f"{(f'{mfu:.3f}' if mfu is not None else '-'):>7}"
+            f"{_fmt_bytes(t.get('hbm_bytes')):>10}"
+            f"{_fmt_bytes(t.get('rss_bytes')):>10}"
+            f"{(f'{hb:.1f}s' if hb is not None else '-'):>8}  "
+            f"{t.get('state', '') or '-':<11}"
+            f"{_sparkline(t.get('steps_per_sec_history', []))}")
+    return "\n".join(lines)
+
+
+def _cmd_top(args: argparse.Namespace) -> int:
+    """Live utilization view for a RUNNING job (the `top` for a gang):
+    polls the coordinator's metrics.live RPC — the same registry behind
+    the portal's /metrics exposition — and redraws in place. --once
+    prints a single snapshot (scripts, tests)."""
+    rpc = _coordinator_rpc(args.app_id, args.workdir)
+    if rpc is None:
+        print(f"no coordinator address for {args.app_id} under "
+              f"{_default_workdir(args.workdir)} (job finished? wrong "
+              f"--workdir?) — `tony-tpu metrics` views need a live job",
+              file=sys.stderr)
+        return 1
+    try:
+        while True:
+            try:
+                snap = rpc.call("metrics.live")
+            except Exception as e:  # noqa: BLE001
+                print(f"coordinator unreachable: {e}", file=sys.stderr)
+                return 1
+            frame = _render_top(snap)
+            if args.once:
+                print(frame)
+                return 0
+            # Clear + home, then one frame: flicker-free enough without
+            # curses, and plain pipes just see frames separated by FF.
+            print("\x1b[2J\x1b[H" + frame
+                  if sys.stdout.isatty() else frame, flush=True)
+            time.sleep(args.interval)
+    except KeyboardInterrupt:
+        return 0
+
+
+def _cmd_trace(args: argparse.Namespace) -> int:
+    """Export a job's span log as Chrome/Perfetto trace_events JSON
+    (load at https://ui.perfetto.dev or chrome://tracing). The span log
+    lives in the job's history dir next to the jhist stream; works on
+    running AND finished jobs."""
+    from tony_tpu import constants, tracing
+    from tony_tpu.events import history
+
+    root = _history_root(args)
+    job_dir = history.list_job_dirs(root).get(args.app_id)
+    if job_dir is None:
+        print(f"unknown application {args.app_id} under {root}",
+              file=sys.stderr)
+        return 1
+    path = os.path.join(job_dir, constants.TRACE_FILE)
+    if not os.path.exists(path):
+        print(f"no span log at {path} — the job ran with "
+              f"tony.trace.enabled=false, or predates tracing",
+              file=sys.stderr)
+        return 1
+    payload = tracing.to_trace_events(tracing.load_records(path))
+    text = json.dumps(payload, indent=1)
+    if args.out:
+        with open(args.out, "w", encoding="utf-8") as f:
+            f.write(text)
+        print(f"wrote {args.out}", file=sys.stderr)
+    else:
+        print(text)
+    n_spans = sum(1 for e in payload["traceEvents"]
+                  if e.get("ph") == "X")
+    unclosed = payload.get("unclosedSpans", [])
+    print(f"{n_spans} spans, {len(unclosed)} unclosed"
+          + (f" ({', '.join(unclosed)})" if unclosed else ""),
+          file=sys.stderr)
+    return 0
 
 
 def _history_root(args: argparse.Namespace) -> str:
@@ -534,6 +666,29 @@ def build_parser() -> argparse.ArgumentParser:
                                       "submitted from")
     st.add_argument("--history-root")
     st.set_defaults(fn=_cmd_status)
+
+    tp = sub.add_parser(
+        "top",
+        help="live per-task utilization view for a running job "
+             "(steps/s, MFU, HBM, RSS, heartbeat age — the gang's `top`)")
+    tp.add_argument("app_id")
+    tp.add_argument("--workdir", help="client workdir the job was "
+                                      "submitted from (default ~/.tony-tpu)")
+    tp.add_argument("--interval", type=float, default=2.0,
+                    help="refresh cadence in seconds (default 2)")
+    tp.add_argument("--once", action="store_true",
+                    help="print one snapshot and exit (scripts/tests)")
+    tp.set_defaults(fn=_cmd_top)
+
+    tr = sub.add_parser(
+        "trace",
+        help="export a job's control-plane trace as Chrome/Perfetto "
+             "trace_events JSON (submit → rendezvous → first step → "
+             "teardown, one stitched tree)")
+    tr.add_argument("app_id")
+    tr.add_argument("--history-root")
+    tr.add_argument("--out", help="write JSON here instead of stdout")
+    tr.set_defaults(fn=_cmd_trace)
 
     h = sub.add_parser("history", help="list finished jobs")
     h.add_argument("--history-root")

@@ -27,7 +27,7 @@ import time
 import uuid
 from typing import List, Optional
 
-from tony_tpu import constants
+from tony_tpu import constants, tracing
 from tony_tpu.conf.config import ConfigError, TonyTpuConfig
 from tony_tpu.conf import keys as K
 from tony_tpu.rpc.wire import RpcClient
@@ -68,6 +68,16 @@ class TonyTpuClient:
         self._coord_proc: Optional[subprocess.Popen] = None
         self._rpc: Optional[RpcClient] = None
         self._last_task_infos: List[dict] = []
+        # Distributed tracing: the client is where the job's ONE trace
+        # starts — the submit span is the root every coordinator/executor
+        # span hangs under, and the anchor bench.py measures
+        # submit→first-step from. Buffered locally, shipped over
+        # trace.push once the coordinator answers its first report.
+        self._tracer = tracing.Tracer(
+            service="client",
+            enabled=conf.get_bool(K.TRACE_ENABLED, True))
+        self._submit_span = tracing.NULL_SPAN
+        self._trace_pushed = False
 
     # -- construction ----------------------------------------------------
     @classmethod
@@ -217,7 +227,14 @@ class TonyTpuClient:
         os.makedirs(self.job_dir, exist_ok=True)
         for lst in self.listeners:
             lst.on_application_id_received(self.app_id)
-        self._stage_bundle()
+        self._submit_span = self._tracer.start_span(
+            "client.submit", attrs={"app": self.app_id})
+        stage_span = self._tracer.start_span(
+            "client.stage", parent=self._submit_span)
+        try:
+            self._stage_bundle()
+        finally:
+            stage_span.end()
         self.conf.set(K.INTERNAL_APP_ID, self.app_id)
         from tony_tpu.utils.version import version_info
 
@@ -259,6 +276,10 @@ class TonyTpuClient:
             os.path.dirname(os.path.abspath(__file__))))
         env["PYTHONPATH"] = (repo_root + os.pathsep +
                              env.get("PYTHONPATH", "")).rstrip(os.pathsep)
+        if self._tracer.enabled:
+            # The coordinator's run span parents under this submit span.
+            env[constants.TRACE_ID_ENV] = self._tracer.trace_id
+            env[constants.TRACE_PARENT_ENV] = self._submit_span.span_id
         self._coord_proc = subprocess.Popen(
             cmd, stdout=coord_log, stderr=subprocess.STDOUT, env=env)
         coord_log.close()
@@ -315,6 +336,18 @@ class TonyTpuClient:
                     return constants.EXIT_FAILURE
                 time.sleep(interval)
                 continue
+            if not self._trace_pushed:
+                # First answered report: the app is live — close the
+                # submit span and ship the client's spans into the job's
+                # span log (best-effort; the trace survives without them).
+                self._trace_pushed = True
+                self._submit_span.end(status=report.get("status", ""))
+                records = self._tracer.drain()
+                if records:
+                    try:
+                        self._rpc.call("trace.push", records=records)
+                    except Exception:  # noqa: BLE001
+                        pass
             tasks = report.get("tasks", [])
             if tasks != self._last_task_infos:
                 self._last_task_infos = tasks

@@ -327,6 +327,35 @@ TASK_STRAGGLER_RESTART = _key(
     "a fresh process/host beats a gang crawling at the straggler's "
     "pace. Leave off unless step rates are expected to be uniform.")
 
+# --- tracing / live metrics (tony_tpu/tracing.py, tony_tpu/metrics.py) ---
+TRACE_ENABLED = _key(
+    "tony.trace.enabled", True, bool,
+    "Distributed tracing across the control plane: client submit span, "
+    "coordinator lifecycle/epoch/rendezvous/task spans, executor "
+    "register/user-process/first-step spans, stitched into one tree per "
+    "job via trace context on every RPC frame. The span log "
+    "(trace.spans.jsonl) lives in the job history dir next to the jhist "
+    "stream; export with `tony-tpu trace <app>` (Perfetto JSON) or the "
+    "portal /trace/<app> timeline. Off = zero overhead (null spans).")
+TRACE_RPC_SPANS = _key(
+    "tony.trace.rpc-spans", "significant", str,
+    "Server-side per-RPC spans: 'significant' (default — registration, "
+    "results, kill; periodic methods like heartbeats and metrics pushes "
+    "are aggregated into the RPC latency histograms instead of spamming "
+    "the span log), 'all' (every method — debugging only; heartbeats "
+    "arrive once per second per task), or 'off' (histograms only).")
+METRICS_RING_POINTS = _key(
+    "tony.metrics.ring-points", 512, int,
+    "Ring-buffer depth of each in-memory gauge time series in the "
+    "coordinator MetricsRegistry (sparklines for `tony-tpu top`, "
+    "short-window rates). Bounded by design: Prometheus owns long-term "
+    "storage; the registry is the scrape source, not a TSDB.")
+METRICS_EXPORT_INTERVAL_S = _key(
+    "tony.metrics.export-interval-s", 2.0, float,
+    "Cadence at which the coordinator renders the Prometheus exposition "
+    "into <job_dir>/metrics.prom (the portal /metrics scrape source) and "
+    "snapshots counters for recovery. Control-plane-rate, not per-step.")
+
 # --- rpc ------------------------------------------------------------------
 RPC_CALL_TIMEOUT_S = _key(
     "tony.rpc.call-timeout-s", 10.0, float,
@@ -466,6 +495,12 @@ FAULT_RPC_CONNECT = _key(
 FAULT_RPC_SEND = _key(
     "tony.fault.rpc-send", "", str,
     "Inject a dropped-connection failure before an RPC request is sent.")
+FAULT_RPC_SLOW = _key(
+    "tony.fault.rpc-slow", "", str,
+    "Inject latency into RPC client calls: firings delay the request by "
+    "'amt:X' seconds before it is sent — the deterministic exercise for "
+    "trace spans and the RPC latency histograms (a slow-control-plane "
+    "rehearsal that never drops a frame).")
 FAULT_HEARTBEAT = _key(
     "tony.fault.heartbeat", "", str,
     "Make the executor silently skip heartbeats that fire this spec "
@@ -598,7 +633,7 @@ _JOB_KEY_RE: Pattern[str] = re.compile(
 
 _RESERVED_NON_JOB_SEGMENTS = {
     "application", "task", "coordinator", "client", "history", "tpu", "portal",
-    "keep-failed-task-dirs", "internal", "fault", "rpc",
+    "keep-failed-task-dirs", "internal", "fault", "rpc", "trace", "metrics",
 }
 
 

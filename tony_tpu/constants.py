@@ -45,6 +45,12 @@ TELEMETRY_INTERVAL_ENV = "TONY_TELEMETRY_INTERVAL_S"
 # SIGUSR1; operators can pre-set it (tony.application.execution-env) to
 # move the dump off a signal the user script needs.
 STACKDUMP_SIGNAL = "TONY_STACKDUMP_SIGNAL"
+# Distributed-tracing context (tony_tpu/tracing.py): the job's trace id
+# and the parent span id for this process's root span. The client exports
+# them to the coordinator; the coordinator exports them to executors with
+# the task's lifecycle span as the parent — one stitched tree per job.
+TRACE_ID_ENV = "TONY_TRACE_ID"
+TRACE_PARENT_ENV = "TONY_TRACE_PARENT"
 TASK_ID = "TONY_TASK_ID"              # "<jobtype>:<index>"
 TASK_COMMAND = "TONY_TASK_COMMAND"    # user command for this task
 EXECUTOR_CONF = "TONY_EXECUTOR_CONF"  # path to the frozen final config
@@ -113,6 +119,17 @@ FINAL_CONFIG_FILE = "tony-final.json"
 # Write-ahead session journal, next to the history stream in the job dir
 # (coordinator/journal.py — the crash-recovery source of truth).
 JOURNAL_FILE = "session.journal.jsonl"
+# Distributed-tracing span log, next to the jhist stream in the job dir
+# (tony_tpu/tracing.py): coordinator-written JSON lines; executors ship
+# their spans into it over the trace.push RPC.
+TRACE_FILE = "trace.spans.jsonl"
+# Rendered Prometheus text exposition, refreshed by the coordinator every
+# tony.metrics.export-interval-s; the portal's /metrics scrape endpoint
+# concatenates these across live jobs.
+METRICS_PROM_FILE = "metrics.prom"
+# Counter snapshot (tony_tpu/metrics.py save_counters): reloaded by a
+# --recover coordinator so counters stay monotonic across recovery.
+METRICS_COUNTERS_FILE = "metrics.counters.json"
 EVENTS_SUFFIX = ".jhist.jsonl"
 INPROGRESS_SUFFIX = ".jhist.jsonl.inprogress"
 HISTORY_INTERMEDIATE = "intermediate"

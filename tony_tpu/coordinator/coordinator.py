@@ -29,8 +29,9 @@ import threading
 import time
 from typing import Dict, List, Optional
 
-from tony_tpu import constants, faults
+from tony_tpu import constants, faults, tracing
 from tony_tpu.cluster.base import Backend, TaskLaunchSpec
+from tony_tpu.metrics import MetricsRegistry
 from tony_tpu.conf.config import TonyTpuConfig
 from tony_tpu.conf import keys as K
 from tony_tpu.coordinator import journal, liveness
@@ -105,6 +106,15 @@ class _RpcService:
 
     def metrics__get(self, task_id: str) -> Optional[dict]:
         return self._c.metrics_store.get(task_id)
+
+    def metrics__live(self) -> dict:
+        """Live per-task utilization snapshot (the `tony-tpu top` feed)."""
+        return self._c.metrics_live()
+
+    def trace__push(self, records) -> int:
+        """Executor/client span intake: remote spans land in the job's
+        span log, stitching the cross-process trace tree."""
+        return self._c.ingest_trace_records(records)
 
 
 class Coordinator:
@@ -199,6 +209,40 @@ class Coordinator:
         self._worker_termination_done = False
         self._final_conf_path = ""
 
+        # --- distributed tracing (tony_tpu/tracing.py): the coordinator
+        # owns the job's span log, next to the jhist stream. A recovered
+        # coordinator rejoins the ORIGINAL trace (id read back from the
+        # log) so the outage shows up as a gap in one tree, not two trees.
+        trace_path = os.path.join(job_dir, constants.TRACE_FILE)
+        trace_id = tracing.existing_trace_id(trace_path) if st else ""
+        self.tracer = tracing.Tracer(
+            trace_id=trace_id or os.environ.get(constants.TRACE_ID_ENV)
+            or None,
+            service="coordinator", path=trace_path,
+            enabled=conf.get_bool(K.TRACE_ENABLED, True))
+        mode = str(conf.get(K.TRACE_RPC_SPANS, "significant") or "")
+        self._rpc_span_mode = mode if mode in ("all", "significant",
+                                               "off") else "significant"
+        self._run_span = tracing.NULL_SPAN
+        self._epoch_span = tracing.NULL_SPAN
+        self._rendezvous_span: Optional[object] = None
+        self._task_spans: Dict[str, object] = {}
+
+        # --- live metrics (tony_tpu/metrics.py): beacon-fed registry,
+        # rendered as Prometheus exposition into <job_dir>/metrics.prom
+        # (the portal's /metrics scrape source) on the export cadence.
+        # Counters reload across --recover so they never step backwards.
+        self.metrics = MetricsRegistry(
+            ring_points=conf.get_int(K.METRICS_RING_POINTS, 512))
+        self._counters_path = os.path.join(job_dir,
+                                           constants.METRICS_COUNTERS_FILE)
+        if st is not None:
+            self.metrics.load_counters(self._counters_path)
+        self._prom_path = os.path.join(job_dir, constants.METRICS_PROM_FILE)
+        self._prom_interval_s = float(
+            conf.get(K.METRICS_EXPORT_INTERVAL_S, 2.0) or 2.0)
+        self._prom_last_write = 0.0
+
         if rpc_token is None and conf.get_bool(K.APPLICATION_SECURITY_ENABLED):
             import secrets
             rpc_token = secrets.token_hex(16)
@@ -215,11 +259,13 @@ class Coordinator:
             port=conf.get_int(K.COORDINATOR_PORT_KEY, 0),
             token=rpc_token, tls=tls,
             generation=self.generation,
-            on_superseded=self._on_superseded)
+            on_superseded=self._on_superseded,
+            on_request=self._on_rpc_request)
 
         self.events = EventHandler(
             job_dir, history.in_progress_name(app_id, self._started_ms,
-                                              self.user))
+                                              self.user),
+            on_emit=self._on_event_emitted)
         # Write-ahead journal (crash recovery): opened for append in both
         # lives; the generation bump is the first record of each life so
         # even an immediately-recrashed coordinator leaves a fence trail.
@@ -262,6 +308,184 @@ class Coordinator:
                 f"coordinator is at epoch {self.session.session_id}")
 
     # ------------------------------------------------------------------
+    # Observability: tracing + live metrics
+    # ------------------------------------------------------------------
+    #: periodic methods excluded from per-RPC spans in 'significant' mode
+    #: (they arrive ~1/s/task and belong in the latency histograms, not
+    #: the span log; 'all' traces them anyway, 'off' traces nothing).
+    _PERIODIC_RPC = frozenset((
+        "task_executor_heartbeat", "metrics.push", "metrics.get",
+        "metrics.live", "get_application_report", "get_task_infos",
+        "trace.push"))
+
+    def _on_rpc_request(self, method: str, seconds: float,
+                        ok: bool) -> None:
+        """RpcServer hook: every dispatched request lands in the server
+        latency histogram + request counter, and significant ones get a
+        span parented under the caller's trace context."""
+        app = {"app": self.app_id}
+        self.metrics.histogram(
+            "tony_rpc_server_seconds", {**app, "method": method},
+            help="Coordinator-side RPC dispatch latency.").observe(seconds)
+        self.metrics.counter(
+            "tony_rpc_requests_total",
+            {**app, "method": method, "ok": str(bool(ok)).lower()},
+            help="RPC requests dispatched by the coordinator.").inc()
+        if self._rpc_span_mode == "off" or not self.tracer.enabled:
+            return
+        if self._rpc_span_mode == "significant" \
+                and method in self._PERIODIC_RPC:
+            return
+        ctx = tracing.get_rpc_context()
+        end = tracing.now_us()
+        self.tracer.emit(f"rpc.{method}", start_us=end - int(seconds * 1e6),
+                         end_us=end,
+                         parent=ctx[1] if ctx else self._run_span,
+                         attrs={"ok": bool(ok)})
+
+    def _on_event_emitted(self, event: Event) -> None:
+        self.metrics.counter(
+            "tony_events_total",
+            {"app": self.app_id, "type": event.type.value},
+            help="Job-history events emitted, by type.").inc()
+
+    def _observe_beacon(self, task_id: str,
+                        progress: Optional[dict]) -> None:
+        """Fold a heartbeat's metrics beacon into the registry: the
+        steady-state utilization series behind /metrics and `top`."""
+        if not isinstance(progress, dict):
+            return
+        labels = {"app": self.app_id, "task": task_id}
+        if "steps" in progress:
+            try:
+                self.metrics.gauge(
+                    "tony_task_steps_completed", labels,
+                    help="Step counter from the task's progress beacon."
+                ).set(float(progress["steps"]))
+            except (TypeError, ValueError):
+                pass
+        m = progress.get("metrics")
+        if isinstance(m, dict):
+            for src, name, help_ in (
+                    ("steps_per_sec", "tony_task_steps_per_sec",
+                     "Training steps per second (telemetry.step)."),
+                    ("tokens_per_sec", "tony_task_tokens_per_sec",
+                     "Tokens per second (telemetry.step tokens=)."),
+                    ("mfu", "tony_task_mfu",
+                     "Model FLOPs utilization vs peak bf16."),
+                    ("hbm_bytes", "tony_task_hbm_bytes",
+                     "Device HBM bytes in use (user process)."),
+                    ("rss_bytes", "tony_task_rss_bytes",
+                     "Process-tree resident set size bytes.")):
+                if src in m:
+                    try:
+                        self.metrics.gauge(name, labels, help=help_).set(
+                            float(m[src]))
+                    except (TypeError, ValueError):
+                        continue
+        rpc = progress.get("rpc")
+        if isinstance(rpc, dict):
+            self.metrics.set_histogram_snapshot(
+                "tony_rpc_client_seconds", labels, rpc,
+                help="Executor-side RPC call latency (cumulative over "
+                     "the executor's lifetime).")
+
+    def _maybe_write_prom(self, force: bool = False) -> None:
+        """Refresh <job_dir>/metrics.prom (atomic replace) + the counter
+        snapshot, throttled to the export cadence — the file the portal
+        serves live at /metrics."""
+        now = time.monotonic()
+        if not force and now - self._prom_last_write < self._prom_interval_s:
+            return
+        self._prom_last_write = now
+        app = {"app": self.app_id}
+        self.metrics.gauge(
+            "tony_coordinator_generation", app,
+            help="Coordinator generation (bumps on --recover)."
+        ).set(self.generation)
+        self.metrics.gauge("tony_session_epoch", app,
+                           help="Current retry epoch.").set(
+            self.session.session_id)
+        with self._hb_lock:
+            hb = dict(self._last_hb)
+        for task_id, last in hb.items():
+            self.metrics.gauge(
+                "tony_task_heartbeat_age_seconds",
+                {**app, "task": task_id},
+                help="Seconds since the task's last heartbeat — the same "
+                     "signal the liveness monitor expires on.").set(
+                now - last)
+        counts: Dict[str, int] = {}
+        for t in self.session.all_tasks():
+            counts[t.status.value] = counts.get(t.status.value, 0) + 1
+        for status, n in counts.items():
+            self.metrics.gauge("tony_tasks", {**app, "status": status},
+                               help="Tasks by status.").set(n)
+        text = self.metrics.render()
+        tmp = f"{self._prom_path}.tmp.{os.getpid()}"
+        try:
+            with open(tmp, "w", encoding="utf-8") as f:
+                f.write(text)
+            os.replace(tmp, self._prom_path)
+        except OSError as e:
+            log.debug("metrics.prom write failed: %s", e)
+        self.metrics.save_counters(self._counters_path)
+
+    def metrics_live(self) -> dict:
+        """The `tony-tpu top` feed: current utilization + liveness per
+        task, with a short steps/s history for sparklines (ring-buffer
+        series, bounded by tony.metrics.ring-points)."""
+        now = time.monotonic()
+        with self._hb_lock:
+            hb = dict(self._last_hb)
+        tasks = []
+        for t in self.session.all_tasks():
+            labels = {"app": self.app_id, "task": t.task_id}
+            row: Dict[str, object] = {"task": t.task_id,
+                                      "status": t.status.value}
+            snap = self.progress.snapshot(t.task_id) or {}
+            if snap.get("state"):
+                row["state"] = snap["state"]
+            if "steps" in snap:
+                row["steps"] = snap["steps"]
+            for name, key in (("tony_task_steps_per_sec", "steps_per_sec"),
+                              ("tony_task_mfu", "mfu"),
+                              ("tony_task_hbm_bytes", "hbm_bytes"),
+                              ("tony_task_rss_bytes", "rss_bytes")):
+                v = self.metrics.gauge_value(name, labels)
+                if v is not None:
+                    row[key] = v
+            history_v = self.metrics.gauge_history(
+                "tony_task_steps_per_sec", labels)
+            if history_v:
+                row["steps_per_sec_history"] = history_v[-32:]
+            last = hb.get(t.task_id)
+            if last is not None:
+                row["heartbeat_age_s"] = round(now - last, 3)
+            tasks.append(row)
+        return {"app_id": self.app_id, "generation": self.generation,
+                "session_id": self.session.session_id,
+                "status": self.session.status.value, "tasks": tasks}
+
+    def ingest_trace_records(self, records) -> int:
+        return self.tracer.write_records(records)
+
+    def _end_task_span(self, task_id: str, **attrs) -> None:
+        span = self._task_spans.pop(task_id, None)
+        if span is not None:
+            span.end(**attrs)
+
+    def _close_epoch_spans(self, status: SessionStatus) -> None:
+        """Close the epoch's open spans when its monitor loop returns —
+        every span the coordinator opens must close (the golden trace
+        test treats unclosed spans as a regression)."""
+        if self._rendezvous_span is not None:
+            self._rendezvous_span.end(aborted=True)
+            self._rendezvous_span = None
+        self._epoch_span.end(status=status.value)
+        self._epoch_span = tracing.NULL_SPAN
+
+    # ------------------------------------------------------------------
     # Launching
     # ------------------------------------------------------------------
     def _task_env(self, task: Task) -> Dict[str, str]:
@@ -287,6 +511,13 @@ class Coordinator:
             # Lets the executor RE-resolve a restarted coordinator (it
             # rewrites this file with its fresh ephemeral port).
             env[constants.COORDINATOR_ADDR_FILE] = self.addr_file
+        if self.tracer.enabled:
+            # Trace context: the executor's spans parent under this
+            # task's lifecycle span, stitching one tree per job.
+            env[constants.TRACE_ID_ENV] = self.tracer.trace_id
+            span = self._task_spans.get(task.task_id)
+            if span is not None and getattr(span, "span_id", ""):
+                env[constants.TRACE_PARENT_ENV] = span.span_id
         if self.rpc_token:
             env["TONY_RPC_TOKEN"] = self.rpc_token
         ckpt_dir = str(self.conf.get(K.APPLICATION_CHECKPOINT_DIR, "") or "")
@@ -356,6 +587,12 @@ class Coordinator:
             # never a duplicate launch over a live executor.
             self.journal.task(task.task_id, TaskStatus.SCHEDULED.value,
                               self.session.session_id)
+            # Lifecycle span opens BEFORE the env is built so the
+            # executor inherits it as its trace parent.
+            if task.task_id not in self._task_spans:
+                self._task_spans[task.task_id] = self.tracer.start_span(
+                    "task.lifecycle", parent=self._epoch_span,
+                    task=task.task_id, attrs={"job": job_name})
             spec = TaskLaunchSpec(
                 task_id=task.task_id, job_name=job_name, index=i,
                 command=job.command, env=self._task_env(task),
@@ -369,6 +606,7 @@ class Coordinator:
                 # coordinator crash — the analogue of an unserviceable
                 # container request.
                 log.error("launch of %s failed: %s", task.task_id, e)
+                self._end_task_span(task.task_id, error=str(e))
                 self.session.fail(f"launch of {task.task_id} failed: {e}",
                                   FailureDomain.INFRA_TRANSIENT)
                 return
@@ -393,6 +631,14 @@ class Coordinator:
         self._check_epoch(task_id, session_id)
         ok = self.session.register_worker(task_id, host, port)
         if ok:
+            if task_id not in self._task_spans and self.tracer.enabled:
+                # Post-recovery re-adoption: the original lifecycle span
+                # died unclosed with the previous coordinator; open a
+                # fresh one in the SAME trace so the task's second life
+                # is visible on the timeline.
+                self._task_spans[task_id] = self.tracer.start_span(
+                    "task.lifecycle", parent=self._epoch_span,
+                    task=task_id, attrs={"re_registered": True})
             # Write-ahead: the registration must be on disk before the
             # executor can observe it succeeded (a crash after the reply
             # but before the append would resurrect an unregistered task
@@ -458,6 +704,10 @@ class Coordinator:
         with self._hb_lock:
             if task_id in self._last_hb:
                 self._last_hb[task_id] = time.monotonic()
+        # The beacon doubles as the live-metrics feed: utilization gauges
+        # and the executor's client-latency histogram ride the same dict
+        # the liveness tracker reads steps from.
+        self._observe_beacon(task_id, progress)
         if self.progress.observe(task_id, progress):
             self._maybe_journal_progress(task_id)
         if self.progress.should_dump(task_id):
@@ -531,6 +781,9 @@ class Coordinator:
             # finally-block mapping).
             status = SessionStatus.KILLED
         tasks = []
+        with self._hb_lock:
+            hb = dict(self._last_hb)
+        hb_now = time.monotonic()
         for t in self.session.all_tasks():
             info = t.to_info()
             # Live progress state for the status surfaces (CLI `status`,
@@ -539,6 +792,12 @@ class Coordinator:
             snap = self.progress.snapshot(t.task_id)
             if snap:
                 info["progress"] = snap
+            # Heartbeat age, from the same map the liveness monitor
+            # expires on — the CLI status column (absent once a task is
+            # terminal and unregistered from the monitor).
+            last = hb.get(t.task_id)
+            if last is not None:
+                info["last_heartbeat_age_s"] = round(hb_now - last, 3)
             tasks.append(info)
         return {
             "app_id": self.app_id,
@@ -575,6 +834,8 @@ class Coordinator:
         self.session.on_task_completed(
             task_id, exit_code,
             domain_hint=self.backend.completion_domain(task_id))
+        self._end_task_span(task_id, exit_code=exit_code,
+                            status=t.status.value)
         self.journal.task(
             task_id, t.status.value, self.session.session_id,
             exit_code=exit_code,
@@ -631,6 +892,8 @@ class Coordinator:
             # here).
             progress_snap = self.progress.snapshot(task_id)
             self.progress.forget(task_id)
+            self._end_task_span(task_id, deemed_dead=True,
+                                heartbeat_age_s=round(hb_age_s, 3))
             if t.handle is not None:
                 self.backend.kill_task(t.handle, grace_s=0.0)
             # Fail first so the recorded reason is the liveness expiry, not
@@ -737,6 +1000,7 @@ class Coordinator:
                 hb_age_s = time.monotonic() - last
         progress_snap = self.progress.snapshot(task_id)
         self.progress.forget(task_id)
+        self._end_task_span(task_id, killed=reason[:200])
         dump_excerpt = self._stack_dump_excerpt(task_id) \
             if capture_dump else ""
         log.error("%s — killing into an INFRA_TRANSIENT retry", reason)
@@ -816,6 +1080,13 @@ class Coordinator:
         self.rpc.start()
         self.events.start()
         recovered = self._recover_state is not None
+        # Root coordinator span: parented under the client's submit span
+        # (env trace context) on a fresh job; a recovery run is a new root
+        # in the SAME trace — the outage reads as a gap between them.
+        self._run_span = self.tracer.start_span(
+            "coordinator.recover" if recovered else "coordinator.run",
+            parent=os.environ.get(constants.TRACE_PARENT_ENV, "") or None,
+            attrs={"app": self.app_id, "generation": self.generation})
         if not recovered:
             self.events.emit(Event(EventType.APPLICATION_INITED, {
                 "app_id": self.app_id, "user": self.user,
@@ -864,6 +1135,7 @@ class Coordinator:
                     self._start_session(attempt, retry_domain)
                 first = False
                 status = self._monitor()
+                self._close_epoch_spans(status)
                 if status == SessionStatus.SUCCEEDED \
                         or self._stop_requested.is_set():
                     break
@@ -1000,8 +1272,14 @@ class Coordinator:
         self.journal.epoch(attempt, self._infra_retries_used,
                            self._preempt_retries_used)
         self._reregistration_grace = False
+        self._epoch_span = self.tracer.start_span(
+            "session.epoch", parent=self._run_span,
+            attrs={"epoch": attempt})
         self.scheduler = GangScheduler(self.conf, self._launch_job)
         self._schedule_start = time.monotonic()
+        self._rendezvous_span = self.tracer.start_span(
+            "gang.rendezvous", parent=self._epoch_span,
+            attrs={"expected": self.session.num_expected})
         self.scheduler.schedule_ready()
 
     def _resume_session(self) -> None:
@@ -1030,9 +1308,16 @@ class Coordinator:
             "journal_records": st.records if st else 0,
             "awaiting_reregistration": [t.task_id for t in live]}))
         self._reregistration_grace = True
+        self._epoch_span = self.tracer.start_span(
+            "session.epoch", parent=self._run_span,
+            attrs={"epoch": self.session.session_id, "resumed": True})
         self.scheduler = GangScheduler(self.conf, self._launch_job)
         self.scheduler.restore(st.scheduled_jobs, st.completed_jobs)
         self._schedule_start = time.monotonic()
+        self._rendezvous_span = self.tracer.start_span(
+            "gang.rendezvous", parent=self._epoch_span,
+            attrs={"expected": self.session.num_expected,
+                   "re_registration": True})
         self.scheduler.schedule_ready()
 
     def _monitor(self) -> SessionStatus:
@@ -1056,6 +1341,16 @@ class Coordinator:
                 log.info("recovery: all surviving tasks re-registered; "
                          "resuming normal monitoring")
                 self._reregistration_grace = False
+            if self._rendezvous_span is not None \
+                    and self.session.all_registered():
+                # The gang barrier opened: every later step (first step,
+                # epochs) hangs off a closed rendezvous on the timeline.
+                self._rendezvous_span.end(
+                    registered=self.session.num_registered)
+                self._rendezvous_span = None
+            # Live-metrics export (throttled internally): keeps the
+            # portal's /metrics exposition fresh while the job runs.
+            self._maybe_write_prom()
             if self._stop_requested.is_set():
                 self.session.fail(self._stop_reason or "stop requested")
                 # TERM with the FULL configured grace (reference
@@ -1134,6 +1429,11 @@ class Coordinator:
         # and the failed epoch's periodic checkpoints are the resume
         # source (save-on-TERM still gets 1 s for tiny states).
         grace = min(self.conf.get_int(K.COORDINATOR_STOP_GRACE_S, 15), 1)
+        # The old gang's lifecycle spans end here: the epoch reset is the
+        # terminal event for tasks killed with mark="none" (they never
+        # reach _process_completion under the replaced session).
+        for task_id in list(self._task_spans):
+            self._end_task_span(task_id, epoch_reset=True)
         self._kill_all_tasks(grace, mark="none")
         # Wait for the old gang to be FULLY down, draining exits as they
         # land. Breaking on the first empty poll is not enough: a killed
@@ -1185,9 +1485,20 @@ class Coordinator:
             "failure_domain": (self.session.failure_domain.value
                                if self.session.failure_domain else ""),
         }))
+        # Close the trace: untracked services killed at teardown still
+        # hold open lifecycle spans; the finish marker + root span close
+        # the tree (zero unclosed spans on any orderly shutdown), and the
+        # final exposition snapshot freezes terminal task states.
+        for task_id in list(self._task_spans):
+            self._end_task_span(task_id, teardown=True)
+        self.tracer.instant("application.finished", parent=self._run_span,
+                            attrs={"status": self.final_status.value})
+        self._run_span.end(status=self.final_status.value)
+        self._maybe_write_prom(force=True)
         self.events.stop(history.final_name(
             self.app_id, self._started_ms, int(time.time() * 1000), self.user,
             self.final_status.value))
         self.journal.close()
         self.backend.stop()
         self.rpc.stop()
+        self.tracer.close()

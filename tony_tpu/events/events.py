@@ -75,12 +75,16 @@ class Event:
 class EventHandler:
     """Queue-backed async writer (reference EventHandler.java:98-113)."""
 
-    def __init__(self, job_dir: str, in_progress_name: str):
+    def __init__(self, job_dir: str, in_progress_name: str,
+                 on_emit: Optional[Any] = None):
         self._queue: "queue.Queue[Optional[Event]]" = queue.Queue()
         self._job_dir = job_dir
         self._path = os.path.join(job_dir, in_progress_name)
         self._thread: Optional[threading.Thread] = None
         self._stopped = threading.Event()
+        # Observability tap: called synchronously with each emitted event
+        # (the coordinator counts event types into its metrics registry).
+        self._on_emit = on_emit
         os.makedirs(job_dir, exist_ok=True)
 
     def start(self) -> None:
@@ -89,6 +93,11 @@ class EventHandler:
         self._thread.start()
 
     def emit(self, event: Event) -> None:
+        if self._on_emit is not None:
+            try:
+                self._on_emit(event)
+            except Exception:  # noqa: BLE001 — the tap must never block history
+                pass
         self._queue.put(event)
 
     def _drain(self) -> None:

@@ -23,10 +23,11 @@ import threading
 import time
 from typing import Callable, Dict, Optional
 
-from tony_tpu import constants
+from tony_tpu import constants, tracing
 from tony_tpu.conf.config import TonyTpuConfig
 from tony_tpu.conf import keys as K
 from tony_tpu.executor.monitor import TaskMonitor
+from tony_tpu.metrics import Histogram
 from tony_tpu.executor.ports import ReservedPort
 from tony_tpu.rpc.wire import FencedError, RpcClient
 from tony_tpu.runtimes.base import TaskIdentity, get_runtime
@@ -240,6 +241,24 @@ class TaskExecutor:
         # heartbeat thread forever (the precondition for loss detection).
         self._rpc_call_timeout_s = float(
             self.conf.get(K.RPC_CALL_TIMEOUT_S, 10.0) or 0) or None
+        # Client-side RPC latency histogram: cumulative over this
+        # executor's lifetime, shipped on every heartbeat beacon and
+        # re-exposed by the coordinator as tony_rpc_client_seconds.
+        self._rpc_hist = Histogram()
+        # Distributed tracing (tony_tpu/tracing.py): the coordinator
+        # exported the job's trace id and this task's lifecycle span as
+        # our parent; spans are buffered locally and shipped home over
+        # trace.push. Absent env (tracing off / old coordinator) = no-op.
+        self.tracer = tracing.Tracer(
+            trace_id=e.get(constants.TRACE_ID_ENV) or None,
+            service=f"executor:{self.task_id}",
+            enabled=bool(e.get(constants.TRACE_ID_ENV)))
+        self._trace_parent = e.get(constants.TRACE_PARENT_ENV, "")
+        self._run_span = tracing.NULL_SPAN
+        self._trace_ctx: Optional[tuple] = None
+        self._user_start_us = 0
+        self._first_step_emitted = False
+        self._monitor: Optional[TaskMonitor] = None
         self.client = self._make_client(self.coordinator_host,
                                         self.coordinator_port)
         self._orphaned_reason: Optional[str] = None
@@ -271,12 +290,34 @@ class TaskExecutor:
 
     # -- coordinator link (crash recovery) -------------------------------
     def _make_client(self, host: str, port: int) -> RpcClient:
-        return RpcClient(
+        client = RpcClient(
             host, port, token=self._rpc_token,
             max_retries=self._rpc_max_retries,
             retry_sleep_s=self._rpc_retry_sleep_s,
             tls=self._tls, generation=self.generation,
-            call_timeout_s=self._rpc_call_timeout_s)
+            call_timeout_s=self._rpc_call_timeout_s,
+            on_latency=self._record_rpc_latency)
+        client.trace_context = self._trace_ctx
+        return client
+
+    def _record_rpc_latency(self, method: str, seconds: float) -> None:
+        self._rpc_hist.observe(seconds)
+
+    def _flush_trace(self) -> None:
+        """Ship buffered spans to the coordinator's span log. Best-effort:
+        spans are only ever shipped COMPLETE, so a failed push loses
+        detail but can never leave the job's trace with an unclosed
+        executor span."""
+        if not self.tracer.enabled:
+            return
+        records = self.tracer.drain()
+        if not records:
+            return
+        try:
+            self.client.call("trace.push", records=records)
+        except Exception as e:  # noqa: BLE001 — tracing is best-effort
+            log.debug("trace push failed (%d spans dropped): %s",
+                      len(records), e)
 
     def _resolve_coordinator(self) -> None:
         """Re-read the coordinator address file, if one is reachable from
@@ -312,7 +353,9 @@ class TaskExecutor:
             token=self._rpc_token, max_retries=1, retry_sleep_s=0.1,
             connect_timeout_s=5.0, tls=self._tls,
             generation=self.generation,
-            call_timeout_s=self._rpc_call_timeout_s)
+            call_timeout_s=self._rpc_call_timeout_s,
+            on_latency=self._record_rpc_latency)
+        client.trace_context = self._trace_ctx
         try:
             client.call("register_worker_spec", task_id=self.task_id,
                         host=self.hostname,
@@ -328,31 +371,71 @@ class TaskExecutor:
         old.close()
         return client
 
-    # -- progress liveness (coordinator/liveness.py) ---------------------
+    # -- progress liveness + metrics beacon ------------------------------
     def _progress_beacon(self) -> Optional[dict]:
-        """Heartbeat payload: the user process's step counter (published
-        by telemetry.step() into the metrics file) plus the age of its
-        last advance as seen from THIS process. None while the task has
-        no progress instrumentation — the coordinator then keeps it on
-        heartbeat-only liveness (one-time warning, never a false kill).
+        """Heartbeat payload, two audiences in one dict. For the liveness
+        tracker (coordinator/liveness.py): the user process's step counter
+        (published by telemetry.step() into the metrics file) plus the age
+        of its last advance as seen from THIS process — absent while the
+        task has no progress instrumentation, so the coordinator keeps it
+        on heartbeat-only liveness (one-time warning, never a false kill).
         Any counter CHANGE counts as an advance ('!=' not '>': a user
-        process restarted inside the same task resets the counter
-        downward and is very much alive)."""
+        process restarted inside the same task resets the counter downward
+        and is very much alive). For the live-metrics registry: a
+        ``metrics`` sub-dict (steps/s, MFU, HBM, RSS) and the cumulative
+        RPC client-latency histogram snapshot."""
         if not self._metrics_file:
             return None
         from tony_tpu import telemetry
 
         stats = telemetry.read_stats(self._metrics_file)
+        beacon: Dict[str, object] = {}
         steps = stats.get("steps_completed")
-        if steps is None:
-            return None
-        now = time.monotonic()
-        steps = float(steps)
-        if self._beacon_steps is None or steps != self._beacon_steps:
-            self._beacon_steps = steps
-            self._beacon_advance_t = now
-        return {"steps": steps,
-                "age_s": round(now - self._beacon_advance_t, 3)}
+        if steps is not None:
+            now = time.monotonic()
+            steps = float(steps)
+            if self._beacon_steps is None or steps != self._beacon_steps:
+                self._beacon_steps = steps
+                self._beacon_advance_t = now
+            beacon["steps"] = steps
+            beacon["age_s"] = round(now - self._beacon_advance_t, 3)
+            self._maybe_emit_first_step(stats, steps)
+        m: Dict[str, float] = {}
+        for src, dst in (("steps_per_sec", "steps_per_sec"),
+                         ("tokens_per_sec", "tokens_per_sec"),
+                         ("mfu_vs_peak_bf16", "mfu"),
+                         ("hbm_bytes_in_use", "hbm_bytes")):
+            v = stats.get(src)
+            if isinstance(v, (int, float)):
+                m[dst] = float(v)
+        if self._monitor is not None and self._monitor.last_rss:
+            m["rss_bytes"] = self._monitor.last_rss
+        if m:
+            beacon["metrics"] = m
+        if self._rpc_hist.count:
+            beacon["rpc"] = self._rpc_hist.snapshot()
+        return beacon or None
+
+    def _maybe_emit_first_step(self, stats: dict, steps: float) -> None:
+        """Record the submit→first-step tail: a complete span from user-
+        process start to the FIRST telemetry step, end-anchored on the
+        user process's own wall timestamp (telemetry first_step_done_ts)
+        rather than this poll's arrival time. The span bench.py measures
+        its submit_to_first_step_s from."""
+        if self._first_step_emitted or steps < 1 \
+                or not self.tracer.enabled or not self._user_start_us:
+            return
+        self._first_step_emitted = True
+        end_ts = stats.get("first_step_done_ts")
+        try:
+            end_us = int(float(end_ts) * 1e6) if end_ts else tracing.now_us()
+        except (TypeError, ValueError):
+            end_us = tracing.now_us()
+        self.tracer.emit("executor.first_step",
+                         start_us=self._user_start_us,
+                         end_us=max(end_us, self._user_start_us),
+                         parent=self._run_span, task=self.task_id,
+                         attrs={"steps_at_observation": steps})
 
     def _dump_user_stacks(self) -> None:
         """Coordinator declared this task HUNG: deliver the dump signal so
@@ -508,7 +591,19 @@ class TaskExecutor:
         if not self.command:
             log.error("no task command configured for %s", self.task_id)
             return constants.EXIT_FAILURE
-        self._localize_bundle()
+        self._run_span = self.tracer.start_span(
+            "executor.run", parent=self._trace_parent, task=self.task_id)
+        # Every RPC this executor makes carries the trace context, so
+        # coordinator-side RPC spans stitch under this run span.
+        self._trace_ctx = (self.tracer.trace_id, self._run_span.span_id) \
+            if self.tracer.enabled else None
+        self.client.trace_context = self._trace_ctx
+        localize_span = self.tracer.start_span(
+            "executor.localize", parent=self._run_span, task=self.task_id)
+        try:
+            self._localize_bundle()
+        finally:
+            localize_span.end()
         self.setup_ports()
         metrics_file = os.path.join(os.getcwd(), "user-metrics.json")
         self._metrics_file = metrics_file
@@ -533,14 +628,23 @@ class TaskExecutor:
                                          5000) / 1000.0,
             metrics_file=metrics_file)
 
+        register_span = self.tracer.start_span(
+            "executor.register", parent=self._run_span, task=self.task_id)
         try:
             cluster_spec = self.register_and_get_cluster_spec()
         except FencedError as e:
+            register_span.end(fenced=True)
             log.error("registration fenced for %s: %s", self.task_id, e)
             return constants.EXIT_KILLED
+        register_span.end(barrier_open=cluster_spec is not None)
         if cluster_spec is None:
             log.error("registration barrier timed out for %s", self.task_id)
+            self._run_span.end(barrier_timeout=True)
+            self._flush_trace()
             return constants.EXIT_FAILURE
+        # First flush: registration/localization spans reach the span log
+        # even if this executor is later SIGKILLed mid-training.
+        self._flush_trace()
         log.info("cluster spec: %s", cluster_spec)
 
         framework = str(self.conf.get(K.APPLICATION_FRAMEWORK, "jax"))
@@ -584,11 +688,13 @@ class TaskExecutor:
         # rely on).
         monitor._pid_fn = os.getpid
         monitor.start()
+        self._monitor = monitor
 
         def _on_user_start(p) -> None:
             # Publish the user pgid: in-process for the signal forwarder,
             # on disk for backends that must reap the user tree even after
             # this executor is SIGKILLed (constants.USER_PGID_FILE).
+            self._user_start_us = tracing.now_us()
             _user_proc[:] = [p]
             try:
                 with open(os.path.join(os.getcwd(),
@@ -605,13 +711,18 @@ class TaskExecutor:
         from tony_tpu.executor.preemption import start_for_executor
         preempt_watcher = start_for_executor(_user_proc)
 
+        user_span = self.tracer.start_span(
+            "executor.user_process", parent=self._run_span,
+            task=self.task_id)
         try:
             exit_code = procutil.execute_shell(
                 self.command,
                 timeout_s=self.conf.get_int(
                     K.TASK_EXECUTOR_EXECUTION_TIMEOUT_S, 0),
                 env=env, on_start=_on_user_start)
+            user_span.end(exit_code=exit_code)
         finally:
+            user_span.end(aborted=True)   # no-op when ended above
             _user_proc[:] = []
             # The group is reaped (execute_shell's finally); drop the pgid
             # file so later backend kills can't TERM a recycled group id
@@ -630,6 +741,13 @@ class TaskExecutor:
                 self.rendezvous_port.release()
             self._teardown_tensorboard(tb_proc)
         log.info("user process for %s exited with %d", self.task_id, exit_code)
+        # A short task can finish before the heartbeater's next beacon
+        # poll: read the final telemetry snapshot once more so the
+        # first-step span lands even for one-step jobs (the bench probe).
+        try:
+            self._progress_beacon()
+        except Exception:  # noqa: BLE001 — diagnostics only
+            pass
         self._maybe_upload_profile()
 
         if self._orphaned_reason is not None:
@@ -642,8 +760,14 @@ class TaskExecutor:
             hb.stop()
             log.error("exiting as orphaned executor: %s",
                       self._orphaned_reason)
+            self._run_span.end(orphaned=self._orphaned_reason)
             return constants.EXIT_KILLED
         hb.stop()
+        # Close + ship the whole executor tree BEFORE reporting the
+        # result: once the coordinator processes the exit it may tear the
+        # epoch down, and these frames should already be in the log.
+        self._run_span.end(exit_code=exit_code)
+        self._flush_trace()
         self._report_result_with_recovery(exit_code)
         self._maybe_skew_sleep()
         return exit_code

@@ -123,6 +123,9 @@ class TaskMonitor:
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self._samples = 0
+        # Latest raw RSS sample (not max/avg): the live-metrics beacon
+        # reads it so `tony-tpu top` shows current memory, not the peak.
+        self.last_rss = 0.0
         self._metrics: Dict[str, float] = {
             MAX_MEMORY_BYTES: 0.0, AVG_MEMORY_BYTES: 0.0,
             MAX_TPU_HBM_BYTES: 0.0, AVG_TPU_HBM_BYTES: 0.0,
@@ -150,6 +153,7 @@ class TaskMonitor:
                     self._metrics[key] = float(stats[src])
         if not hbm:
             hbm = tpu_hbm_in_use_bytes()
+        self.last_rss = float(rss)
         self._samples += 1
         n = self._samples
         # max/avg aggregation (reference TaskMonitor.java:172-186).

@@ -27,6 +27,10 @@ site                      where it fires
 ``user.slow_step``        telemetry.step_done: a firing delays the step by
                           ``amt:`` seconds — one task's step rate skews
                           below the gang median (the straggler shape)
+``rpc.slow``              RpcClient.call: a firing delays the request by
+                          ``amt:`` seconds before it is sent — injected
+                          control-plane latency that never drops a frame
+                          (exercises trace spans + latency histograms)
 ========================  =====================================================
 
 Spec grammar (the value of ``tony.fault.<site>`` conf keys, or one
@@ -77,8 +81,8 @@ FAULTS_ENV = "TONY_FAULTS"
 
 #: the canonical site names (kept in lockstep with the conf keys in
 #: tony_tpu/conf/keys.py: ``tony.fault.<site with . -> ->``)
-SITES = ("rpc.connect", "rpc.send", "heartbeat", "executor.spawn",
-         "storage.put", "storage.get", "checkpoint.save",
+SITES = ("rpc.connect", "rpc.send", "rpc.slow", "heartbeat",
+         "executor.spawn", "storage.put", "storage.get", "checkpoint.save",
          "coordinator.crash", "executor.reregister",
          "user.hang", "user.slow_step")
 

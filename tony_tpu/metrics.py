@@ -1,0 +1,321 @@
+"""Live metrics pipeline primitives: ring-buffer time series, monotonic
+counters, latency histograms, and Prometheus text exposition.
+
+The reference's metrics surface was post-hoc only: TaskMonitor pushed
+max/avg aggregates that surfaced on TASK_FINISHED (``TaskMonitor.java``)
+— nothing answered "what is the gang doing RIGHT NOW". Here the
+executor's heartbeat already carries a progress beacon
+(coordinator/liveness.py); the same beacon widened with utilization
+numbers (steps/s, MFU, HBM, RSS — tony_tpu/telemetry.py derives them in
+the user process) feeds a coordinator-side :class:`MetricsRegistry`,
+which renders the whole job as Prometheus text exposition (served live
+by the portal at ``/metrics`` and written to ``metrics.prom`` in the job
+dir) and as the ``metrics.live`` RPC behind ``tony-tpu top``.
+
+Design constraints:
+
+- **Bounded memory**: gauges keep a ring buffer of the last N points
+  (``tony.metrics.ring-points``) — enough for sparklines and short-window
+  rates, never an unbounded series store. Prometheus owns long-term
+  storage; this registry is the scrape source, not a TSDB.
+- **Counter monotonicity across ``--recover``**: counters snapshot to
+  ``metrics.counters.json`` (atomic replace) and a recovered coordinator
+  reloads them, so ``tony_rpc_requests_total`` never steps backwards just
+  because the coordinator process was replaced — rate() windows spanning
+  a recovery stay truthful.
+- **Cross-process histograms**: executors keep their RPC client latency
+  histogram locally and ship the cumulative snapshot on the beacon; the
+  registry re-exposes it verbatim (cumulative counts from the executor's
+  own lifetime — exactly the monotonic shape Prometheus expects).
+"""
+
+from __future__ import annotations
+
+import bisect
+import collections
+import json
+import os
+import threading
+import time
+from typing import Any, Deque, Dict, List, Optional, Tuple
+
+#: Latency buckets (seconds) shared by RPC server/client histograms:
+#: sub-ms localhost dispatch up to the 10 s call-timeout ceiling.
+DEFAULT_LATENCY_BUCKETS_S = (0.001, 0.0025, 0.005, 0.01, 0.025, 0.05,
+                             0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0)
+
+_LabelsKey = Tuple[Tuple[str, str], ...]
+
+
+def escape_label_value(value: Any) -> str:
+    """Prometheus text-format label escaping: backslash, double-quote and
+    newline (exposition format spec, in this order — escaping the
+    backslash last would corrupt the other two escapes)."""
+    return (str(value).replace("\\", "\\\\").replace("\n", "\\n")
+            .replace('"', '\\"'))
+
+
+def _labels_key(labels: Optional[Dict[str, Any]]) -> _LabelsKey:
+    return tuple(sorted((str(k), str(v))
+                        for k, v in (labels or {}).items()))
+
+
+def format_labels(key: _LabelsKey,
+                  extra: Optional[List[Tuple[str, str]]] = None) -> str:
+    pairs = list(key) + list(extra or [])
+    if not pairs:
+        return ""
+    return "{" + ",".join(f'{k}="{escape_label_value(v)}"'
+                          for k, v in pairs) + "}"
+
+
+def _fmt_value(v: float) -> str:
+    f = float(v)
+    if f == int(f) and abs(f) < 1e15:
+        return str(int(f))
+    return repr(f)
+
+
+class Series:
+    """Gauge with bounded history: the ring buffer behind sparklines and
+    the `latest` sample the exposition renders."""
+
+    def __init__(self, maxlen: int = 512):
+        self.points: Deque[Tuple[float, float]] = collections.deque(
+            maxlen=max(2, int(maxlen)))
+
+    def set(self, value: float, ts: Optional[float] = None) -> None:
+        self.points.append((ts if ts is not None else time.time(),
+                            float(value)))
+
+    @property
+    def latest(self) -> Optional[float]:
+        return self.points[-1][1] if self.points else None
+
+    def values(self) -> List[float]:
+        return [v for _, v in self.points]
+
+
+class Counter:
+    """Monotonic counter; ``inc`` with a negative amount is a programming
+    error and raises (monotonicity is the contract Prometheus rate()
+    depends on)."""
+
+    def __init__(self, base: float = 0.0):
+        self.value = float(base)
+
+    def inc(self, amount: float = 1.0) -> None:
+        if amount < 0:
+            raise ValueError(f"counter decrement ({amount}) is not allowed")
+        self.value += amount
+
+
+class Histogram:
+    """Fixed-bucket latency histogram (cumulative on render, like the
+    exposition format wants). ``snapshot()`` is the wire form executors
+    put on the heartbeat beacon."""
+
+    def __init__(self, buckets: Tuple[float, ...] = DEFAULT_LATENCY_BUCKETS_S):
+        self.buckets = tuple(sorted(float(b) for b in buckets))
+        self.counts = [0] * (len(self.buckets) + 1)  # last = overflow
+        self.sum = 0.0
+        self.count = 0
+        self._lock = threading.Lock()
+
+    def observe(self, value: float) -> None:
+        v = float(value)
+        idx = bisect.bisect_left(self.buckets, v)
+        with self._lock:
+            self.counts[idx] += 1
+            self.sum += v
+            self.count += 1
+
+    def snapshot(self) -> Dict[str, Any]:
+        with self._lock:
+            return {"buckets": list(self.buckets),
+                    "counts": list(self.counts),
+                    "sum": self.sum, "count": self.count}
+
+
+def render_histogram_lines(name: str, key: _LabelsKey,
+                           snap: Dict[str, Any]) -> List[str]:
+    """_bucket/_sum/_count lines from a snapshot (cumulative, +Inf last)."""
+    buckets = [float(b) for b in snap.get("buckets", [])]
+    counts = [int(c) for c in snap.get("counts", [])]
+    counts += [0] * (len(buckets) + 1 - len(counts))
+    lines = []
+    cum = 0
+    for b, c in zip(buckets, counts):
+        cum += c
+        lines.append(f"{name}_bucket{format_labels(key, [('le', _fmt_value(b))])}"
+                     f" {cum}")
+    total = int(snap.get("count", cum + counts[len(buckets)]))
+    lines.append(f'{name}_bucket{format_labels(key, [("le", "+Inf")])} '
+                 f"{total}")
+    lines.append(f"{name}_sum{format_labels(key)} "
+                 f"{_fmt_value(float(snap.get('sum', 0.0)))}")
+    lines.append(f"{name}_count{format_labels(key)} {total}")
+    return lines
+
+
+class MetricsRegistry:
+    """The coordinator's in-memory metrics store: gauges (ring-buffer
+    series), counters (recover-persistent), histograms (local and
+    beacon-shipped snapshots), rendered as one Prometheus exposition."""
+
+    def __init__(self, ring_points: int = 512):
+        self._ring_points = ring_points
+        self._gauges: Dict[str, Dict[_LabelsKey, Series]] = {}
+        self._counters: Dict[str, Dict[_LabelsKey, Counter]] = {}
+        self._hists: Dict[str, Dict[_LabelsKey, Histogram]] = {}
+        self._hist_snaps: Dict[str, Dict[_LabelsKey, Dict[str, Any]]] = {}
+        self._help: Dict[str, str] = {}
+        self._saved_counters: Dict[str, Dict[str, float]] = {}
+        self._lock = threading.Lock()
+
+    # -- instruments -----------------------------------------------------
+    def gauge(self, name: str, labels: Optional[Dict[str, Any]] = None,
+              help: str = "") -> Series:
+        key = _labels_key(labels)
+        with self._lock:
+            if help and name not in self._help:
+                self._help[name] = help
+            fam = self._gauges.setdefault(name, {})
+            series = fam.get(key)
+            if series is None:
+                series = fam[key] = Series(self._ring_points)
+        return series
+
+    def counter(self, name: str, labels: Optional[Dict[str, Any]] = None,
+                help: str = "") -> Counter:
+        key = _labels_key(labels)
+        with self._lock:
+            if help and name not in self._help:
+                self._help[name] = help
+            fam = self._counters.setdefault(name, {})
+            c = fam.get(key)
+            if c is None:
+                base = self._saved_counters.get(name, {}).get(
+                    json.dumps(key), 0.0)
+                c = fam[key] = Counter(base)
+        return c
+
+    def histogram(self, name: str,
+                  labels: Optional[Dict[str, Any]] = None,
+                  buckets: Tuple[float, ...] = DEFAULT_LATENCY_BUCKETS_S,
+                  help: str = "") -> Histogram:
+        key = _labels_key(labels)
+        with self._lock:
+            if help and name not in self._help:
+                self._help[name] = help
+            fam = self._hists.setdefault(name, {})
+            h = fam.get(key)
+            if h is None:
+                h = fam[key] = Histogram(buckets)
+        return h
+
+    def set_histogram_snapshot(self, name: str,
+                               labels: Optional[Dict[str, Any]],
+                               snap: Dict[str, Any],
+                               help: str = "") -> None:
+        """Adopt a remote histogram verbatim (executor client-latency
+        histograms ride the beacon as cumulative snapshots)."""
+        if not isinstance(snap, dict) or "buckets" not in snap:
+            return
+        with self._lock:
+            if help and name not in self._help:
+                self._help[name] = help
+            self._hist_snaps.setdefault(name, {})[_labels_key(labels)] = snap
+
+    # -- reads -----------------------------------------------------------
+    def gauge_value(self, name: str,
+                    labels: Optional[Dict[str, Any]] = None
+                    ) -> Optional[float]:
+        with self._lock:
+            series = self._gauges.get(name, {}).get(_labels_key(labels))
+        return series.latest if series is not None else None
+
+    def gauge_history(self, name: str,
+                      labels: Optional[Dict[str, Any]] = None
+                      ) -> List[float]:
+        with self._lock:
+            series = self._gauges.get(name, {}).get(_labels_key(labels))
+        return series.values() if series is not None else []
+
+    def drop_labels(self, match: Dict[str, Any]) -> None:
+        """Drop every series/counter/histogram whose labels contain all of
+        ``match`` (a finished retry epoch's task series must not linger as
+        frozen gauges in the exposition)."""
+        want = set(_labels_key(match))
+        with self._lock:
+            for store in (self._gauges, self._counters, self._hists,
+                          self._hist_snaps):
+                for fam in store.values():
+                    for key in [k for k in fam if want <= set(k)]:
+                        del fam[key]
+
+    # -- exposition ------------------------------------------------------
+    def render(self) -> str:
+        with self._lock:
+            gauges = {n: dict(f) for n, f in self._gauges.items()}
+            counters = {n: dict(f) for n, f in self._counters.items()}
+            hists = {n: dict(f) for n, f in self._hists.items()}
+            hist_snaps = {n: dict(f) for n, f in self._hist_snaps.items()}
+            helps = dict(self._help)
+        lines: List[str] = []
+        for name in sorted(gauges):
+            if helps.get(name):
+                lines.append(f"# HELP {name} {helps[name]}")
+            lines.append(f"# TYPE {name} gauge")
+            for key, series in sorted(gauges[name].items()):
+                if series.latest is not None:
+                    lines.append(f"{name}{format_labels(key)} "
+                                 f"{_fmt_value(series.latest)}")
+        for name in sorted(counters):
+            if helps.get(name):
+                lines.append(f"# HELP {name} {helps[name]}")
+            lines.append(f"# TYPE {name} counter")
+            for key, c in sorted(counters[name].items()):
+                lines.append(f"{name}{format_labels(key)} "
+                             f"{_fmt_value(c.value)}")
+        all_hist_names = sorted(set(hists) | set(hist_snaps))
+        for name in all_hist_names:
+            if helps.get(name):
+                lines.append(f"# HELP {name} {helps[name]}")
+            lines.append(f"# TYPE {name} histogram")
+            for key, h in sorted(hists.get(name, {}).items()):
+                lines.extend(render_histogram_lines(name, key, h.snapshot()))
+            for key, snap in sorted(hist_snaps.get(name, {}).items()):
+                lines.extend(render_histogram_lines(name, key, snap))
+        return "\n".join(lines) + "\n" if lines else ""
+
+    # -- recover persistence ---------------------------------------------
+    def save_counters(self, path: str) -> None:
+        """Atomic counter snapshot — the recover seed (class docstring)."""
+        with self._lock:
+            payload = {name: {json.dumps(key): c.value
+                              for key, c in fam.items()}
+                       for name, fam in self._counters.items()}
+        tmp = f"{path}.tmp.{os.getpid()}"
+        try:
+            with open(tmp, "w", encoding="utf-8") as f:
+                json.dump(payload, f)
+            os.replace(tmp, path)
+        except OSError:
+            pass
+
+    def load_counters(self, path: str) -> bool:
+        """Seed counters from a previous life's snapshot; lazily applied as
+        each counter is first touched (so label sets need no pre-walk)."""
+        try:
+            with open(path, encoding="utf-8") as f:
+                payload = json.load(f)
+        except (OSError, ValueError):
+            return False
+        if not isinstance(payload, dict):
+            return False
+        with self._lock:
+            self._saved_counters = {
+                str(name): {str(k): float(v) for k, v in fam.items()}
+                for name, fam in payload.items() if isinstance(fam, dict)}
+        return True

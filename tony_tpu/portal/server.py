@@ -162,9 +162,14 @@ class PortalServer:
         try:
             if not parts:
                 return self._jobs_index(req, as_json)
+            if parts == ["metrics"]:
+                # Bare /metrics: Prometheus text exposition across every
+                # LIVE job — the scrape endpoint (per-job HTML stays at
+                # /metrics/<job>).
+                return self._prom_view(req)
             view, *rest = parts
             if view in ("config", "jobs", "logs", "logfile",
-                        "profiles", "metrics") and rest:
+                        "profiles", "metrics", "trace") and rest:
                 job_id = rest[0]
                 if view == "config":
                     return self._config_view(req, job_id, as_json)
@@ -178,6 +183,8 @@ class PortalServer:
                     return self._profiles_view(req, job_id, as_json)
                 if view == "metrics":
                     return self._metrics_view(req, job_id, as_json)
+                if view == "trace":
+                    return self._trace_view(req, job_id, as_json)
             self._send(req, 404, "text/plain", b"not found")
         except Exception as e:  # noqa: BLE001
             log.exception("portal error for %s", req.path)
@@ -202,7 +209,9 @@ class PortalServer:
                 f"<td><a href='/jobs/{a}'>events</a> "
                 f"<a href='/config/{a}'>config</a> "
                 f"<a href='/logs/{a}'>logs</a> "
-                f"<a href='/profiles/{a}'>profiles</a></td></tr>")
+                f"<a href='/profiles/{a}'>profiles</a> "
+                f"<a href='/metrics/{a}'>metrics</a> "
+                f"<a href='/trace/{a}'>trace</a></td></tr>")
         body.append("</table>")
         self._send_html(req, "".join(body))
 
@@ -233,7 +242,21 @@ class PortalServer:
                  f"<table border=1 cellpadding=4>"
                  f"<tr><th>key</th><th>value</th></tr>{rows}</table>")
 
+    def _job_live(self, job_id: str) -> bool:
+        """Still-running job: its dir holds only an .inprogress stream (no
+        finalized history file yet)."""
+        job_dir = self._job_dir(job_id)
+        return job_dir is not None and \
+            history.find_history_file(job_dir) is None
+
     def _events(self, job_id: str):
+        # Cache bypass for IN-PROGRESS jobs: their event stream grows
+        # between requests, and the live views (events, metrics,
+        # liveness incidents) must never serve a snapshot up to
+        # _CACHE_TTL_S stale. Finished jobs never change — they keep the
+        # cache (the reference CacheWrapper behaviour).
+        if self._job_live(job_id):
+            return history.read_job_events(self.history_root, job_id)
         evs = self.cache.get("events", job_id)
         if evs is None:
             evs = history.read_job_events(self.history_root, job_id)
@@ -314,6 +337,108 @@ class PortalServer:
         if isinstance(v, float):
             return f"{v:,.4g}"
         return str(v)
+
+    def _prom_view(self, req) -> None:
+        """Prometheus scrape endpoint: concatenate the exposition files
+        each live job's coordinator keeps fresh in its job dir
+        (metrics.prom, tony.metrics.export-interval-s cadence), merged by
+        metric family so HELP/TYPE lines stay unique and grouped. Never
+        cached — a scrape must see the current write."""
+        inter = os.path.join(self.history_root,
+                             constants.HISTORY_INTERMEDIATE)
+        families: Dict[str, Dict[str, List[str]]] = {}
+        order: List[str] = []
+        if os.path.isdir(inter):
+            for app in sorted(os.listdir(inter)):
+                path = os.path.join(inter, app, constants.METRICS_PROM_FILE)
+                try:
+                    with open(path, encoding="utf-8") as f:
+                        text = f.read()
+                except OSError:
+                    continue
+                fam = None
+                for line in text.splitlines():
+                    if line.startswith("# "):
+                        parts = line.split(None, 3)
+                        name = parts[2] if len(parts) > 2 else ""
+                        fam = families.setdefault(
+                            name, {"meta": [], "samples": []})
+                        if name not in order:
+                            order.append(name)
+                        if line not in fam["meta"]:
+                            fam["meta"].append(line)
+                    elif line.strip() and fam is not None:
+                        fam["samples"].append(line)
+        lines: List[str] = []
+        for name in order:
+            lines.extend(families[name]["meta"])
+            lines.extend(families[name]["samples"])
+        body = ("\n".join(lines) + "\n") if lines \
+            else "# no live jobs exporting metrics\n"
+        self._send(req, 200,
+                   "text/plain; version=0.0.4; charset=utf-8",
+                   body.encode())
+
+    def _trace_view(self, req, job_id: str, as_json: bool) -> None:
+        """Per-job trace timeline from the span log the coordinator keeps
+        next to the jhist stream. JSON = Chrome/Perfetto trace_events
+        (same payload as `tony-tpu trace`); HTML = a simple Gantt of the
+        spans, newest-run-friendly for 'what is the launch path doing'
+        incident reads. Live jobs bypass the cache like events do."""
+        from tony_tpu import tracing
+
+        job_dir = self._job_dir(job_id)
+        if job_dir is None:
+            return self._send(req, 404, "text/plain", b"unknown job")
+        path = os.path.join(job_dir, constants.TRACE_FILE)
+        if not os.path.exists(path):
+            return self._send(req, 404, "text/plain",
+                              b"no trace recorded for job")
+        payload = None
+        if not self._job_live(job_id):
+            payload = self.cache.get("trace", job_id)
+        if payload is None:
+            payload = tracing.to_trace_events(tracing.load_records(path))
+            if not self._job_live(job_id):
+                self.cache.put("trace", job_id, payload)
+        if as_json:
+            return self._send_json(req, payload)
+        spans = [e for e in payload["traceEvents"] if e.get("ph") == "X"]
+        if not spans:
+            return self._send_html(
+                req, f"<h1>trace — {html.escape(job_id)}</h1>"
+                     f"<p>no complete spans yet</p>")
+        t0 = min(e["ts"] for e in spans)
+        t1 = max(e["ts"] + e.get("dur", 0) for e in spans)
+        total = max(t1 - t0, 1)
+        rows = []
+        for e in sorted(spans, key=lambda s: s["ts"]):
+            left = 100.0 * (e["ts"] - t0) / total
+            width = max(100.0 * e.get("dur", 0) / total, 0.15)
+            task = str(e.get("args", {}).get("task", "") or
+                       e.get("cat", ""))
+            rows.append(
+                f"<tr><td>{html.escape(e['name'])}</td>"
+                f"<td>{html.escape(task)}</td>"
+                f"<td>{(e['ts'] - t0) / 1e3:,.1f}</td>"
+                f"<td>{e.get('dur', 0) / 1e3:,.1f}</td>"
+                f"<td style='width:50%'><div style='margin-left:"
+                f"{left:.2f}%;width:{width:.2f}%;background:#4a90d9;"
+                f"height:10px'></div></td></tr>")
+        unclosed = payload.get("unclosedSpans", [])
+        warn = (f"<p><b>{len(unclosed)} unclosed span(s):</b> "
+                f"{html.escape(', '.join(unclosed))}</p>" if unclosed
+                else "")
+        self._send_html(
+            req, f"<h1>trace — {html.escape(job_id)}</h1>"
+                 f"<p>trace {html.escape(str(payload.get('traceId', '')))}"
+                 f" · {len(spans)} spans · {total / 1e3:,.1f} ms"
+                 f" · <a href='/trace/{html.escape(job_id)}?format=json'>"
+                 f"Perfetto JSON</a></p>{warn}"
+                 f"<table border=1 cellpadding=3 width='100%'>"
+                 f"<tr><th>span</th><th>task</th><th>start ms</th>"
+                 f"<th>dur ms</th><th>timeline</th></tr>"
+                 + "".join(rows) + "</table>")
 
     def _log_paths(self, job_id: str) -> List[Tuple[str, str]]:
         """(task, path) pairs from the job's own TASK_FINISHED events — the

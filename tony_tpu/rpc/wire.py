@@ -36,8 +36,17 @@ Wire format: 4-byte big-endian length, then a msgpack map per frame.
 - signed frame: {"p": <inner msgpack bytes>, "m": <hmac>}; unsigned: {"p"}
   (the client's FIRST frame additionally carries {"cn": bytes}, its
   connection nonce; all MACs use server_nonce + client_nonce)
-- inner request:  {"id": int, "method": str, "args": {...}[, "gen": int]}
+- inner request:  {"id": int, "method": str, "args": {...}[, "gen": int]
+                   [, "tc": [trace_id, span_id]]}
 - inner response: {"id": int, "ok": bool, "result"| "error"[, "g": int]}
+
+Trace context ("tc", tony_tpu/tracing.py): a traced caller stamps its
+(trace_id, parent span id) into every request, next to the generation
+field; the server parks it in a thread-local around dispatch so handler-
+side spans stitch under the caller's span — the cross-process edge of the
+per-job trace tree. Observability hooks: ``on_request`` (server) and
+``on_latency`` (client) time every call for the RPC latency histograms;
+both are optional and free when unset.
 
 Generation fencing (coordinator crash recovery): a recovered coordinator
 starts with a bumped, journal-persisted generation and stamps it into the
@@ -67,7 +76,7 @@ from typing import Any, Dict, Optional, Tuple
 
 import msgpack
 
-from tony_tpu import faults
+from tony_tpu import faults, tracing
 from tony_tpu.retry import RetryPolicy
 
 log = logging.getLogger(__name__)
@@ -200,10 +209,15 @@ class RpcServer:
                  token: Optional[str] = None,
                  tls: Optional[ssl.SSLContext] = None,
                  generation: int = 0,
-                 on_superseded: Optional[Any] = None):
+                 on_superseded: Optional[Any] = None,
+                 on_request: Optional[Any] = None):
         self._service = service
         self._token = token or None     # "" = unauthenticated, like None
         self._tls = tls
+        # Observability hook: called (method, seconds, ok) after every
+        # dispatched request, with the caller's trace context still set —
+        # the coordinator feeds its latency histograms and RPC spans here.
+        self._on_request = on_request
         # Coordinator generation this server speaks for (0 = unfenced).
         # Fixed for the server's lifetime: a recovery is a NEW process.
         self._generation = int(generation)
@@ -329,6 +343,14 @@ class RpcServer:
 
     def _dispatch(self, req: Dict[str, Any]) -> Dict[str, Any]:
         rid = req.get("id", 0)
+        # Caller's trace context rides the frame next to the generation
+        # field; park it thread-locally so handler-side spans stitch under
+        # the caller's span (tony_tpu/tracing.py).
+        tc = req.get("tc")
+        if isinstance(tc, (list, tuple)) and len(tc) == 2:
+            tracing.set_rpc_context((str(tc[0]), str(tc[1])))
+        t0 = time.monotonic()
+        ok = True
         try:
             # Auth happened at the frame layer (_recv_signed MAC check);
             # by the time a request reaches dispatch it is authentic.
@@ -341,9 +363,18 @@ class RpcServer:
             result = fn(**(req.get("args") or {}))
             return {"id": rid, "ok": True, "result": result}
         except Exception as e:  # noqa: BLE001 — must never kill the server loop
+            ok = False
             if not isinstance(e, RpcError):
                 log.exception("rpc handler error in %s", req.get("method"))
             return {"id": rid, "ok": False, "error": f"{type(e).__name__}: {e}"}
+        finally:
+            if self._on_request is not None:
+                try:
+                    self._on_request(str(req.get("method", "")),
+                                     time.monotonic() - t0, ok)
+                except Exception:  # noqa: BLE001 — observability only
+                    log.exception("on_request hook")
+            tracing.clear_rpc_context()
 
     @property
     def address(self) -> Tuple[str, int]:
@@ -390,10 +421,18 @@ class RpcClient:
                  connect_timeout_s: float = 10.0,
                  tls: Optional[ssl.SSLContext] = None,
                  generation: int = 0,
-                 call_timeout_s: Optional[float] = None):
+                 call_timeout_s: Optional[float] = None,
+                 on_latency: Optional[Any] = None):
         self._addr = (host, port)
         self._token = token or None     # "" = unauthenticated, like None
         self._tls = tls
+        # (trace_id, span_id) stamped into every request ("tc") when set —
+        # the caller's edge of the cross-process span tree.
+        self.trace_context: Optional[Tuple[str, str]] = None
+        # Observability hook: called (method, seconds) on every SUCCESSFUL
+        # call with its end-to-end latency (send→response, this attempt) —
+        # executors feed their client-latency histogram here.
+        self._on_latency = on_latency
         # Lowest coordinator generation this client will talk to (0 =
         # unfenced). Adopted UPWARD from server hellos/responses — a
         # successor coordinator is legitimate; a lower one is a zombie.
@@ -496,10 +535,19 @@ class RpcClient:
                     # rides the same reconnect+backoff path a real reset
                     # takes (tony_tpu/faults.py site table).
                     faults.check("rpc.send")
+                    slow = faults.fire_amount("rpc.slow")
+                    if slow:
+                        # Injected control-plane latency: the frame still
+                        # goes through, just late — lands in the latency
+                        # histograms and trace spans, never in a retry.
+                        time.sleep(slow)
+                    t_call = time.monotonic()
                     self._id += 1
                     req = {"id": self._id, "method": method, "args": args}
                     if self._generation:
                         req["gen"] = self._generation
+                    if self.trace_context is not None:
+                        req["tc"] = list(self.trace_context)
                     extra = {"cn": self._client_nonce} \
                         if self._token and self._hello_pending else None
                     _send_signed(self._sock, req, self._token, self._nonce,
@@ -530,6 +578,12 @@ class RpcClient:
                         if err.startswith("FencedError"):
                             raise FencedError(err)
                         raise RpcError(err)
+                    if self._on_latency is not None:
+                        try:
+                            self._on_latency(method,
+                                             time.monotonic() - t_call)
+                        except Exception:  # noqa: BLE001 — observability only
+                            pass
                     return resp.get("result")
                 except (AuthError, FencedError):
                     # Both are terminal verdicts about THIS peer/process

@@ -45,7 +45,7 @@ _thread: Optional[threading.Thread] = None
 # ---------------------------------------------------------------------------
 _step_lock = threading.Lock()
 _steps = {"count": 0, "busy_s": 0.0, "flops": 0.0, "tokens": 0.0,
-          "first_start": 0.0, "last_end": 0.0}
+          "first_start": 0.0, "last_end": 0.0, "first_end_wall": 0.0}
 
 # Public peak bf16 matmul FLOP/s per chip (spec sheets), for the MFU derive.
 PEAK_BF16_FLOPS = {
@@ -81,6 +81,10 @@ def step_done(started_at: float, flops: float = 0.0,
     with _step_lock:
         if not _steps["first_start"]:
             _steps["first_start"] = started_at
+            # Wall-clock completion of the FIRST step: the one absolute
+            # timestamp the executor's first-step trace span (and the
+            # bench's submit→first-step metric) anchors on.
+            _steps["first_end_wall"] = time.time()
         _steps["count"] += 1
         _steps["busy_s"] += max(0.0, now - started_at)
         _steps["flops"] += flops
@@ -120,6 +124,8 @@ def step_stats() -> Dict[str, float]:
         out["tokens_per_sec"] = s["tokens"] / wall
     if s["flops"]:
         out["model_flops_per_sec"] = s["flops"] / wall
+    if s["first_end_wall"]:
+        out["first_step_done_ts"] = s["first_end_wall"]
     return out
 
 
