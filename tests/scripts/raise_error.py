@@ -1,0 +1,12 @@
+"""Diagnosis drill: crash with a distinctive user traceback that the
+incident engine must extract verbatim from the task log tail."""
+import sys
+
+
+def train():
+    raise ValueError("diagnosis drill: injected user exception")
+
+
+if __name__ == "__main__":
+    sys.stderr.write("starting doomed training run\n")
+    train()

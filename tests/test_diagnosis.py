@@ -1,0 +1,715 @@
+"""Flight recorder + automatic failure diagnosis (tony_tpu/diagnosis/).
+
+Golden diagnosis matrix: synthetic incident bundles for every verdict
+category (category + blamed task + evidence assertions), the shared
+exit-decoder and log-excerpt helpers, incident.json torn-tail behaviour,
+the rules↔EventType parity smoke (rules must not rot as events evolve),
+the portal /diagnose view — plus two real fault-harness e2e drills:
+a user exception whose traceback `tony-tpu diagnose` must print
+verbatim, and the wedged-collective (user.hang) drill whose report must
+carry the stack-dump excerpt and hang timeline end to end.
+"""
+
+import json
+import os
+import sys
+import urllib.error
+import urllib.request
+
+import pytest
+
+from tony_tpu import constants, diagnosis
+from tony_tpu.conf import keys as K
+from tony_tpu.diagnosis import rules as R
+from tony_tpu.diagnosis.exitcodes import describe_exit, exit_signal
+from tony_tpu.events.events import Event, EventType
+from tony_tpu.utils import logs as logutil
+
+from test_e2e import SCRIPTS, _dump_task_logs, make_conf, submit
+
+
+# ---------------------------------------------------------------------------
+# shared helpers: exit decoding + log excerpts
+# ---------------------------------------------------------------------------
+@pytest.mark.faults
+def test_exit_signal_decoding_both_encodings():
+    assert exit_signal(-9) == 9          # Popen form
+    assert exit_signal(137) == 9         # shell 128+N form
+    assert exit_signal(143) == 15
+    assert exit_signal(1) is None
+    assert exit_signal(0) is None
+    assert "SIGKILL" in describe_exit(-9)
+    assert "OOM-killer" in describe_exit(137)
+    assert "SIGTERM" in describe_exit(143)
+    assert "SIGSEGV" in describe_exit(139)
+    assert describe_exit(1) == "exit 1"
+    assert describe_exit(0) == "exit 0"
+    assert describe_exit(None) == ""
+
+
+@pytest.mark.faults
+def test_tail_file_is_seek_based_and_exact(tmp_path):
+    p = tmp_path / "big.log"
+    blob = b"x" * 2_000_000 + b"THE-END-MARKER"
+    p.write_bytes(blob)
+    tail = logutil.tail_file(str(p), 1000)
+    assert len(tail) == 1000
+    assert tail == blob[-1000:]
+    assert logutil.tail_file(str(p), 0) == b""
+    # small file: whole content
+    small = tmp_path / "s.log"
+    small.write_bytes(b"abc")
+    assert logutil.tail_file(str(small), 1000) == b"abc"
+    assert logutil.tail_text(str(tmp_path / "missing.log"), 10) is None
+
+
+_TB1 = ("Traceback (most recent call last):\n"
+        "  File \"a.py\", line 1, in <module>\n"
+        "    handled()\n"
+        "KeyError: 'retried and survived'\n")
+_TB2 = ("Traceback (most recent call last):\n"
+        "  File \"train.py\", line 9, in <module>\n"
+        "    raise ValueError(\"fatal\")\n"
+        "ValueError: fatal\n")
+
+
+@pytest.mark.faults
+def test_extract_traceback_takes_the_last_block():
+    text = "noise\n" + _TB1 + "more training logs\n" + _TB2 + "epilogue\n"
+    tb = logutil.extract_traceback(text)
+    assert tb.startswith("Traceback (most recent call last):")
+    assert "ValueError: fatal" in tb
+    assert "KeyError" not in tb
+    assert "epilogue" not in tb
+    assert logutil.extract_traceback("no traceback here") == ""
+
+
+@pytest.mark.faults
+def test_extract_traceback_keeps_chained_group():
+    chained = (_TB1 +
+               "\nThe above exception was the direct cause of the "
+               "following exception:\n\n" + _TB2)
+    tb = logutil.extract_traceback("prefix\n" + chained)
+    assert "KeyError" in tb and "ValueError: fatal" in tb
+
+
+@pytest.mark.faults
+def test_extract_stack_dump_spans_all_threads():
+    text = ("log line\n"
+            "Thread 0x00007f1 (most recent call first):\n"
+            "  File \"w.py\", line 3 in loop\n"
+            "Current thread 0x00007f2 (most recent call first):\n"
+            "  File \"train.py\", line 9 in step\n")
+    dump = logutil.extract_stack_dump(text)
+    assert dump.startswith("Thread 0x00007f1")
+    assert "Current thread" in dump
+    assert logutil.extract_stack_dump("nothing") == ""
+
+
+# ---------------------------------------------------------------------------
+# golden matrix: synthetic incident bundles, one per category
+# ---------------------------------------------------------------------------
+def golden_job(tmp_path, app_id, payloads, journal=None, spans=None,
+               status="FAILED", logs=None):
+    """Build a finalized job dir from (type, payload, ts_ms) triples;
+    returns its path. ``logs`` maps filename → content, written under
+    the tmp tree so event payloads can reference them."""
+    job = tmp_path / "history" / "intermediate" / app_id
+    job.mkdir(parents=True)
+    paths = {}
+    for name, content in (logs or {}).items():
+        p = tmp_path / "logs" / app_id / name
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(content)
+        paths[name] = str(p)
+    hist = job / f"{app_id}-1000-9000-tester-{status}.jhist.jsonl"
+    with open(hist, "w", encoding="utf-8") as f:
+        for typ, payload, ts in payloads:
+            f.write(Event(EventType(typ), payload, ts).to_json() + "\n")
+    if journal:
+        with open(job / constants.JOURNAL_FILE, "w") as f:
+            for rec in journal:
+                f.write(json.dumps(rec) + "\n")
+    if spans:
+        with open(job / constants.TRACE_FILE, "w") as f:
+            for rec in spans:
+                f.write(json.dumps(rec) + "\n")
+    return str(job), paths
+
+
+def _fin(app_id, reason, domain, ts=9000, status="FAILED"):
+    return ("APPLICATION_FINISHED",
+            {"app_id": app_id, "status": status, "failure_reason": reason,
+             "failure_domain": domain}, ts)
+
+
+@pytest.mark.faults
+def test_golden_user_exception(tmp_path):
+    stderr = "training...\n" + _TB2
+    job, paths = golden_job(
+        tmp_path, "app_user",
+        [("TASK_STARTED", {"task": "worker:0"}, 1100),
+         ("TASK_FINISHED", {"task": "worker:0", "exit_code": 1,
+                            "status": "FAILED",
+                            "failure_domain": "USER_ERROR",
+                            "logs": ["<stderr>"]}, 2000),
+         _fin("app_user", "chief task worker:0 failed (exit 1, "
+              "USER_ERROR)", "USER_ERROR")],
+        logs={"stderr.log": stderr})
+    _patch_log_path(job, "<stderr>", paths["stderr.log"])
+    inc = diagnosis.diagnose_job_dir(job)
+    v = inc["verdict"]
+    assert v["category"] == "USER_TRACEBACK"
+    assert v["blamed_task"] == "worker:0"
+    assert any("ValueError: fatal" in e for e in v["evidence"])
+    assert "ValueError: fatal" in inc["blamed_task"]["traceback"]
+
+
+def _patch_log_path(job_dir, placeholder, real):
+    """Rewrite the placeholder log path inside the golden history file
+    (json-escaped replacement keeps the stream decodable)."""
+    for f in os.listdir(job_dir):
+        if f.endswith(constants.EVENTS_SUFFIX):
+            p = os.path.join(job_dir, f)
+            text = open(p, encoding="utf-8").read()
+            open(p, "w", encoding="utf-8").write(
+                text.replace(json.dumps(placeholder),
+                             json.dumps(real)))
+
+
+@pytest.mark.faults
+def test_golden_hang(tmp_path):
+    dump = ("Current thread 0x7f11 (most recent call first):\n"
+            "  File \"collective.py\", line 40 in all_reduce\n")
+    job, _ = golden_job(
+        tmp_path, "app_hang",
+        [("TASK_STARTED", {"task": "worker:0"}, 1100),
+         ("TASK_HUNG", {"task": "worker:0", "steps": 3, "stalled_s": 4.2,
+                        "timeout_s": 3}, 5000),
+         ("TASK_FINISHED", {"task": "worker:0", "exit_code": 137,
+                            "status": "KILLED",
+                            "failure_domain": "INFRA_TRANSIENT",
+                            "reason": "task worker:0 hung: heartbeats "
+                                      "alive but no step progress",
+                            "last_heartbeat_age_s": 0.4,
+                            "progress": {"state": "hung", "steps": 3},
+                            "stack_dump_excerpt": dump,
+                            "logs": []}, 6000),
+         _fin("app_hang", "task worker:0 hung", "INFRA_TRANSIENT")])
+    inc = diagnosis.diagnose_job_dir(job)
+    v = inc["verdict"]
+    assert v["category"] == "HANG"
+    assert v["blamed_task"] == "worker:0"
+    assert any("stalled_s=4.2" in e for e in v["evidence"])
+    assert any("heartbeats were alive" in e for e in v["evidence"])
+    assert "all_reduce" in inc["blamed_task"]["stack_dump"]
+    # hang timeline: the TASK_HUNG verdict sits between start and kill
+    whats = [r["what"] for r in inc["timeline"]]
+    assert whats.index("TASK_HUNG") < whats.index("TASK_FINISHED")
+
+
+@pytest.mark.faults
+def test_golden_storage_flake_storm(tmp_path):
+    tb = ("Traceback (most recent call last):\n"
+          "  File \"store.py\", line 5, in get_file\n"
+          "    raise InjectedFault('storage.get', 3)\n"
+          "tony_tpu.faults.InjectedFault: injected fault at storage.get "
+          "(call #3)\n")
+    journal = [
+        {"t": "verdict", "session": 0, "domain": "INFRA_TRANSIENT",
+         "reason": "chief task worker:0 failed (exit 1)", "ts": 3000},
+        {"t": "verdict", "session": 1, "domain": "INFRA_TRANSIENT",
+         "reason": "chief task worker:0 failed (exit 1)", "ts": 6000},
+    ]
+    job, paths = golden_job(
+        tmp_path, "app_storm",
+        [("TASK_STARTED", {"task": "worker:0"}, 1100),
+         ("TASK_FINISHED", {"task": "worker:0", "exit_code": 1,
+                            "status": "FAILED",
+                            "failure_domain": "USER_ERROR",
+                            "logs": ["<stderr>"]}, 2900),
+         _fin("app_storm", "chief task worker:0 failed (exit 1, "
+              "USER_ERROR)", "USER_ERROR")],
+        journal=journal, logs={"stderr.log": "fetching config\n" + tb})
+    _patch_log_path(job, "<stderr>", paths["stderr.log"])
+    inc = diagnosis.diagnose_job_dir(job)
+    v = inc["verdict"]
+    # The exit code said USER_ERROR; the infra-shaped traceback must
+    # overrule it — that correction is the whole point of the engine.
+    assert v["category"] == "INFRA_STORM"
+    assert v["blamed_task"] == "worker:0"
+    assert any("InjectedFault" in e for e in v["evidence"])
+
+
+@pytest.mark.faults
+def test_golden_preemption(tmp_path):
+    job, _ = golden_job(
+        tmp_path, "app_preempt",
+        [("TASK_STARTED", {"task": "worker:0"}, 1100),
+         ("TASK_FINISHED", {"task": "worker:0", "exit_code": 143,
+                            "status": "FAILED",
+                            "failure_domain": "PREEMPTION",
+                            "logs": []}, 4000),
+         _fin("app_preempt", "chief task worker:0 failed (exit 143, "
+              "PREEMPTION)", "PREEMPTION")])
+    inc = diagnosis.diagnose_job_dir(job)
+    v = inc["verdict"]
+    assert v["category"] == "PREEMPTION"
+    assert v["blamed_task"] == "worker:0"
+    assert any("PREEMPTION" in e for e in v["evidence"])
+
+
+@pytest.mark.faults
+def test_golden_heartbeat_expiry(tmp_path):
+    job, _ = golden_job(
+        tmp_path, "app_dead",
+        [("TASK_STARTED", {"task": "worker:1"}, 1100),
+         ("TASK_FINISHED", {"task": "worker:1", "exit_code": 137,
+                            "status": "KILLED",
+                            "failure_domain": "INFRA_TRANSIENT",
+                            "reason": "task worker:1 deemed dead (missed "
+                                      "heartbeats for 2.5s)",
+                            "last_heartbeat_age_s": 2.7,
+                            "progress": {}, "logs": []}, 4000),
+         _fin("app_dead", "task worker:1 deemed dead (missed heartbeats)",
+              "INFRA_TRANSIENT")])
+    inc = diagnosis.diagnose_job_dir(job)
+    v = inc["verdict"]
+    assert v["category"] == "INFRA_STORM"
+    assert v["rule"] == "executor-vanished"
+    assert v["blamed_task"] == "worker:1"
+    assert any("heartbeat silence" in e for e in v["evidence"])
+
+
+@pytest.mark.faults
+def test_golden_coordinator_loss(tmp_path):
+    job, _ = golden_job(
+        tmp_path, "app_loss",
+        [("COORDINATOR_RECOVERED",
+          {"app_id": "app_loss", "generation": 2, "session_id": 0,
+           "awaiting_reregistration": ["worker:0"]}, 5000),
+         _fin("app_loss", "re-registration grace (recovery): 0/1 tasks "
+              "registered within 60s", "INFRA_TRANSIENT")],
+        journal=[{"t": "gen", "generation": 1, "ts": 1000},
+                 {"t": "gen", "generation": 2, "ts": 5000}])
+    inc = diagnosis.diagnose_job_dir(job)
+    v = inc["verdict"]
+    assert v["category"] == "COORDINATOR_LOSS"
+    assert any("COORDINATOR_RECOVERED" in e for e in v["evidence"])
+    assert any("re-registration grace" in e for e in v["evidence"])
+
+
+@pytest.mark.faults
+def test_golden_port_rendezvous(tmp_path):
+    job, _ = golden_job(
+        tmp_path, "app_rdv",
+        [_fin("app_rdv", "registration timeout: 1/2 tasks registered "
+              "within 3s", "INFRA_TRANSIENT")])
+    inc = diagnosis.diagnose_job_dir(job)
+    assert inc["verdict"]["category"] == "PORT_RENDEZVOUS"
+    assert any("registration timeout" in e
+               for e in inc["verdict"]["evidence"])
+
+
+@pytest.mark.faults
+def test_golden_oom_hbm(tmp_path):
+    tb = ("Traceback (most recent call last):\n"
+          "  File \"train.py\", line 30, in step\n"
+          "    loss = fwd(batch)\n"
+          "jaxlib.xla_extension.XlaRuntimeError: RESOURCE_EXHAUSTED: "
+          "Out of memory while trying to allocate 17179869184 bytes.\n")
+    job, paths = golden_job(
+        tmp_path, "app_hbm",
+        [("TASK_FINISHED", {"task": "worker:0", "exit_code": 1,
+                            "status": "FAILED",
+                            "failure_domain": "USER_ERROR",
+                            "logs": ["<stderr>"]}, 2000),
+         _fin("app_hbm", "chief task worker:0 failed", "USER_ERROR")],
+        logs={"stderr.log": tb})
+    _patch_log_path(job, "<stderr>", paths["stderr.log"])
+    inc = diagnosis.diagnose_job_dir(job)
+    v = inc["verdict"]
+    assert v["category"] == "OOM_HBM"
+    assert v["blamed_task"] == "worker:0"
+    assert any("RESOURCE_EXHAUSTED" in e for e in v["evidence"])
+
+
+@pytest.mark.faults
+def test_golden_oom_rss(tmp_path):
+    job, paths = golden_job(
+        tmp_path, "app_rss",
+        [("TASK_FINISHED", {"task": "worker:0", "exit_code": -9,
+                            "status": "FAILED",
+                            "failure_domain": "USER_ERROR",
+                            "metrics": {"MAX_MEMORY_BYTES": 8_000_000_000},
+                            "logs": ["<stderr>"]}, 2000),
+         _fin("app_rss", "chief task worker:0 failed", "USER_ERROR")],
+        logs={"stderr.log": "loading dataset shard\n"})
+    _patch_log_path(job, "<stderr>", paths["stderr.log"])
+    inc = diagnosis.diagnose_job_dir(job)
+    v = inc["verdict"]
+    assert v["category"] == "OOM_RSS"
+    assert v["blamed_task"] == "worker:0"
+    assert any("OOM-killer" in e for e in v["evidence"])
+
+
+@pytest.mark.faults
+def test_golden_straggler_cascade(tmp_path):
+    job, _ = golden_job(
+        tmp_path, "app_strag",
+        [("TASK_STRAGGLER", {"task": "worker:1", "rate_steps_per_s": 0.4,
+                             "median_steps_per_s": 2.0}, 3000),
+         ("TASK_FINISHED", {"task": "worker:1", "exit_code": 137,
+                            "status": "KILLED",
+                            "failure_domain": "INFRA_TRANSIENT",
+                            "reason": "task worker:1 proactively restarted "
+                                      "as a straggler", "logs": []}, 4000),
+         _fin("app_strag", "task worker:1 proactively restarted",
+              "INFRA_TRANSIENT")])
+    inc = diagnosis.diagnose_job_dir(job)
+    v = inc["verdict"]
+    assert v["category"] == "STRAGGLER_CASCADE"
+    assert v["blamed_task"] == "worker:1"
+    assert any("TASK_STRAGGLER" in e for e in v["evidence"])
+
+
+@pytest.mark.faults
+def test_golden_unknown_fallback(tmp_path):
+    job, _ = golden_job(
+        tmp_path, "app_unk",
+        [_fin("app_unk", "mystery failure", "")])
+    inc = diagnosis.diagnose_job_dir(job)
+    assert inc["verdict"]["category"] == "UNKNOWN"
+    assert any("mystery failure" in e for e in inc["verdict"]["evidence"])
+
+
+@pytest.mark.faults
+def test_first_failure_blame_uses_span_timestamps(tmp_path):
+    """Two failed tasks whose TASK_FINISHED events share the same ms
+    timestamp: the span tree's µs clock must break the tie (first
+    failure, not dict order)."""
+    spans = [
+        {"ev": "X", "trace": "t", "span": "a", "parent": "",
+         "name": "executor.user_process", "svc": "executor",
+         "task": "worker:1", "ts_us": 1_500_000, "dur_us": 100,
+         "args": {"exit_code": 1}},
+        {"ev": "X", "trace": "t", "span": "b", "parent": "",
+         "name": "executor.user_process", "svc": "executor",
+         "task": "worker:0", "ts_us": 1_700_000, "dur_us": 100,
+         "args": {"exit_code": 1}},
+    ]
+    job, _ = golden_job(
+        tmp_path, "app_tie",
+        [("TASK_FINISHED", {"task": "worker:0", "exit_code": 1,
+                            "status": "FAILED",
+                            "failure_domain": "USER_ERROR",
+                            "logs": []}, 2000),
+         ("TASK_FINISHED", {"task": "worker:1", "exit_code": 1,
+                            "status": "FAILED",
+                            "failure_domain": "USER_ERROR",
+                            "logs": []}, 2000),
+         _fin("app_tie", "2 tracked task(s) failed", "USER_ERROR")],
+        spans=spans)
+    inc = diagnosis.diagnose_job_dir(job)
+    assert inc["verdict"]["blamed_task"] == "worker:1"
+
+
+# ---------------------------------------------------------------------------
+# CI smoke: rules can't rot against the event schema; incident.json
+# degrades to absent on torn reads
+# ---------------------------------------------------------------------------
+@pytest.mark.faults
+def test_every_rule_references_existing_event_types():
+    """Every EventType name a diagnosis rule declares must exist — a
+    renamed/removed event must fail THIS test, not silently produce
+    rules that never fire again."""
+    assert R.RULES, "rule registry is empty"
+    valid = {e.value for e in EventType}
+    for rule in R.RULES:
+        assert rule.events_used, \
+            f"rule {rule.name} declares no events_used"
+        for name in rule.events_used:
+            assert name in valid, \
+                f"rule {rule.name} references unknown EventType {name!r}"
+        assert rule.category in R.CATEGORY_PRECEDENCE
+
+
+@pytest.mark.faults
+def test_incident_json_roundtrip_and_torn_tail(tmp_path):
+    doc = {"schema": 1, "app_id": "a", "verdict": {"category": "HANG"},
+           "findings": [], "timeline": [{"ts_ms": 1, "what": "X",
+                                         "detail": "d"}]}
+    path = str(tmp_path / constants.INCIDENT_FILE)
+    diagnosis.save_incident(path, doc)
+    assert diagnosis.load_incident(path) == doc
+    # torn tail (the crash window): a truncated document reads as absent,
+    # never a traceback — same degrade-to-prefix contract as read_events.
+    blob = open(path, "rb").read()
+    for cut in (len(blob) // 2, len(blob) - 3, 1):
+        open(path, "wb").write(blob[:cut])
+        assert diagnosis.load_incident(path) is None
+    open(path, "w").write("[1, 2, 3]")       # valid JSON, wrong shape
+    assert diagnosis.load_incident(path) is None
+    assert diagnosis.load_incident(str(tmp_path / "absent.json")) is None
+
+
+@pytest.mark.faults
+def test_renderers_handle_minimal_and_full_docs(tmp_path):
+    job, _ = golden_job(
+        tmp_path, "app_render",
+        [_fin("app_render", "boom", "USER_ERROR")])
+    inc = diagnosis.diagnose_job_dir(job)
+    text = diagnosis.render_text(inc)
+    assert "incident report — app_render" in text
+    assert "verdict:" in text
+    html = diagnosis.render_html(inc)
+    assert "diagnosis — app_render" in html
+    # degenerate doc: renderers must not KeyError
+    assert diagnosis.render_text({"app_id": "x"})
+    assert diagnosis.render_html({"app_id": "x"})
+
+
+# ---------------------------------------------------------------------------
+# portal /diagnose
+# ---------------------------------------------------------------------------
+@pytest.mark.faults
+def test_portal_diagnose_view(tmp_path):
+    from tony_tpu.portal import PortalServer
+
+    dump = "Current thread 0x1 (most recent call first):\n  File \"t.py\""
+    job, _ = golden_job(
+        tmp_path, "app_portal",
+        [("TASK_HUNG", {"task": "worker:0", "steps": 2, "stalled_s": 5.0,
+                        "timeout_s": 3}, 3000),
+         ("TASK_FINISHED", {"task": "worker:0", "exit_code": 137,
+                            "status": "KILLED",
+                            "failure_domain": "INFRA_TRANSIENT",
+                            "reason": "task worker:0 hung",
+                            "stack_dump_excerpt": dump, "logs": []}, 4000),
+         _fin("app_portal", "task worker:0 hung", "INFRA_TRANSIENT")])
+    # pre-written incident.json (the coordinator's artifact) is served
+    # for finished jobs
+    incident = diagnosis.diagnose_job_dir(job, app_id="app_portal")
+    diagnosis.save_incident(os.path.join(job, constants.INCIDENT_FILE),
+                            incident)
+    srv = PortalServer(str(tmp_path / "history"), port=0,
+                       mover_interval_s=3600, purger_interval_s=3600)
+    srv.start()
+    try:
+        with urllib.request.urlopen(
+                f"{srv.url}/diagnose/app_portal?format=json",
+                timeout=10) as r:
+            doc = json.loads(r.read())
+        assert doc["verdict"]["category"] == "HANG"
+        assert doc["verdict"]["blamed_task"] == "worker:0"
+        with urllib.request.urlopen(f"{srv.url}/diagnose/app_portal",
+                                    timeout=10) as r:
+            page = r.read().decode()
+        assert "HANG" in page and "worker:0" in page
+        assert "stack dump excerpt" in page
+        # unknown job → 404
+        with pytest.raises(urllib.error.HTTPError) as e:
+            urllib.request.urlopen(f"{srv.url}/diagnose/nope", timeout=10)
+        assert e.value.code == 404
+    finally:
+        srv.stop()
+
+
+@pytest.mark.faults
+def test_portal_logfile_tail_param(tmp_path):
+    """Satellite: /logfile/<job>/<i> serves a seek-based tail honouring
+    ?tail=N — a huge task log must never be slurped whole."""
+    from tony_tpu.portal import PortalServer
+
+    big = "A" * 50_000 + "TAIL-SENTINEL"
+    job, paths = golden_job(
+        tmp_path, "app_logs",
+        [("TASK_FINISHED", {"task": "worker:0", "exit_code": 1,
+                            "status": "FAILED",
+                            "failure_domain": "USER_ERROR",
+                            "logs": ["<stderr>"]}, 2000),
+         _fin("app_logs", "boom", "USER_ERROR")],
+        logs={"stderr.log": big})
+    _patch_log_path(job, "<stderr>", paths["stderr.log"])
+    srv = PortalServer(str(tmp_path / "history"), port=0,
+                       mover_interval_s=3600, purger_interval_s=3600)
+    srv.start()
+    try:
+        with urllib.request.urlopen(
+                f"{srv.url}/logfile/app_logs/0?tail=100", timeout=10) as r:
+            body = r.read().decode()
+        assert len(body) == 100
+        assert body.endswith("TAIL-SENTINEL")
+        with urllib.request.urlopen(
+                f"{srv.url}/logfile/app_logs/0", timeout=10) as r:
+            assert len(r.read()) == len(big)   # default tail covers it
+        with pytest.raises(urllib.error.HTTPError) as e:
+            urllib.request.urlopen(
+                f"{srv.url}/logfile/app_logs/0?tail=bogus", timeout=10)
+        assert e.value.code == 400
+    finally:
+        srv.stop()
+
+
+# ---------------------------------------------------------------------------
+# fault-harness e2e drills
+# ---------------------------------------------------------------------------
+def _job_dir(tmp_path, app_id):
+    return str(tmp_path / "history" / "intermediate" / app_id)
+
+
+def test_e2e_user_exception_diagnosed_and_cli_prints_traceback(
+        tmp_path, capsys):
+    """User-exception drill: the failed job's incident.json is written
+    automatically, JOB_DIAGNOSED lands in the event stream, and
+    `tony-tpu diagnose` prints the user traceback VERBATIM."""
+    conf = make_conf(tmp_path, "raise_error.py", workers=1)
+    client, rec, code = submit(conf, tmp_path)
+    assert code == constants.EXIT_FAILURE
+    assert rec.finished[0] == "FAILED"
+
+    incident_path = os.path.join(_job_dir(tmp_path, rec.app_id),
+                                 constants.INCIDENT_FILE)
+    assert os.path.exists(incident_path), \
+        "incident.json must be written automatically on failure"
+    inc = diagnosis.load_incident(incident_path)
+    assert inc["verdict"]["category"] == "USER_TRACEBACK"
+    assert inc["verdict"]["blamed_task"] == "worker:0"
+    assert not inc["provisional"]
+    assert "diagnosis drill: injected user exception" in \
+        inc["blamed_task"]["traceback"]
+
+    # the verdict rode the event stream for downstream tooling
+    from tony_tpu.events import history
+    evs = history.read_job_events(str(tmp_path / "history"), rec.app_id)
+    diagnosed = [e for e in evs if e.type == "JOB_DIAGNOSED"]
+    assert len(diagnosed) == 1
+    assert diagnosed[0].payload["category"] == "USER_TRACEBACK"
+    assert diagnosed[0].payload["blamed_task"] == "worker:0"
+    # the executor-shipped traceback is on the TASK_FINISHED itself
+    fins = [e for e in evs if e.type == "TASK_FINISHED"]
+    assert any("injected user exception" in e.payload.get("traceback", "")
+               for e in fins), "executor must ship the traceback home"
+
+    from tony_tpu.cli.main import main
+    assert main(["diagnose", rec.app_id,
+                 "--history-root", str(tmp_path / "history")]) == 0
+    out = capsys.readouterr().out
+    assert "USER_TRACEBACK" in out
+    assert "blamed task: worker:0" in out
+    assert "Traceback (most recent call last):" in out
+    assert 'raise ValueError("diagnosis drill: injected user exception")' \
+        in out
+    assert "ValueError: diagnosis drill: injected user exception" in out
+
+
+def _cli_diagnose_json(tmp_path, app_id, capsys):
+    """Run `tony-tpu diagnose --json` and parse the document — the five
+    golden fault scenarios are asserted through the REAL CLI surface."""
+    from tony_tpu.cli.main import main
+
+    assert main(["diagnose", app_id, "--json",
+                 "--history-root", str(tmp_path / "history")]) == 0
+    return json.loads(capsys.readouterr().out)
+
+
+def test_e2e_heartbeat_expiry_diagnosed(tmp_path, monkeypatch, capsys):
+    """Golden scenario: the executor goes silent (skipped heartbeats) —
+    diagnose must read it as an INFRA verdict on the vanished task, not
+    a user bug."""
+    monkeypatch.setenv(constants.TEST_NUM_HB_MISS, "10")
+    conf = make_conf(tmp_path, "sleep_5.py", workers=1, extra={
+        K.TASK_HEARTBEAT_INTERVAL_MS: 200,
+        K.TASK_MAX_MISSED_HEARTBEATS: 3,
+    })
+    client, rec, code = submit(conf, tmp_path)
+    assert code == constants.EXIT_FAILURE
+    inc = _cli_diagnose_json(tmp_path, rec.app_id, capsys)
+    v = inc["verdict"]
+    assert v["category"] == "INFRA_STORM"
+    assert v["rule"] == "executor-vanished"
+    assert v["blamed_task"] == "worker:0"
+    assert any("heartbeat silence" in e for e in v["evidence"])
+
+
+def test_e2e_storage_flake_storm_diagnosed(tmp_path, capsys):
+    """Golden scenario: a persistent storage storm kills the executors'
+    config fetch. The exit code classifies USER_ERROR, but the
+    infra-shaped traceback must overrule it to INFRA_STORM — the
+    correction is the engine's reason to exist."""
+    store_root = tmp_path / "remote-store"
+    conf = make_conf(tmp_path, "exit_0.py", workers=1, extra={
+        K.REMOTE_STORE: f"file://{store_root}",
+    })
+    # first:40 outlasts the store's 5-attempt retry in every executor
+    # process; the client's staging PUTs are untouched.
+    conf.set(K.fault_key("storage.get"), "first:40")
+    client, rec, code = submit(conf, tmp_path)
+    assert code == constants.EXIT_FAILURE, _dump_task_logs(client)
+    inc = _cli_diagnose_json(tmp_path, rec.app_id, capsys)
+    v = inc["verdict"]
+    assert v["category"] == "INFRA_STORM"
+    assert v["blamed_task"] == "worker:0"
+    assert any("InjectedFault" in e or "ConnectionError" in e
+               for e in v["evidence"])
+
+
+def test_e2e_preemption_diagnosed(tmp_path, monkeypatch, capsys):
+    """Golden scenario: slice host reclaimed with ZERO retry budget so
+    the job fails — diagnose must surface the backend's PREEMPTION
+    attribution and blame the preempted task."""
+    from test_cluster_tpu import slice_conf
+
+    monkeypatch.setenv(constants.TEST_SLICE_FAIL_HOST, "fakehost-0")
+    conf = slice_conf(tmp_path, "sleep_5.py", workers=1, n_hosts=1,
+                      inventory=2,
+                      extra={K.APPLICATION_RETRY_COUNT: 0,
+                             K.APPLICATION_PREEMPTION_RETRY_COUNT: 0})
+    client, rec, code = submit(conf, tmp_path)
+    assert code == constants.EXIT_FAILURE
+    inc = _cli_diagnose_json(tmp_path, rec.app_id, capsys)
+    v = inc["verdict"]
+    assert v["category"] == "PREEMPTION"
+    assert v["blamed_task"] == "worker:0"
+    assert any("PREEMPTION" in e for e in v["evidence"])
+
+
+def test_e2e_wedged_collective_drill_diagnose_report(tmp_path, capsys):
+    """The wedged-collective drill end to end: a user process that keeps
+    heartbeating with a frozen step counter (user.hang), no retry budget
+    — the incident report must carry the HANG verdict, the blamed task,
+    the stack-dump excerpt, and the hang timeline."""
+    conf = make_conf(tmp_path, "hang_after_steps.py", workers=1, extra={
+        K.TASK_HEARTBEAT_INTERVAL_MS: 100,
+        K.TASK_PROGRESS_TIMEOUT_S: 3,
+        K.TASK_PROGRESS_WARMUP_S: 60,
+        K.TASK_HANG_DUMP_GRACE_S: 1,
+        K.APPLICATION_RETRY_COUNT: 0,
+    })
+    conf.set(K.EXECUTION_ENV, "TONY_TELEMETRY_INTERVAL_S=0.2")
+    conf.set(K.fault_key("user.hang"), "after:3")
+    client, rec, code = submit(conf, tmp_path)
+    assert code == constants.EXIT_FAILURE, _dump_task_logs(client)
+    assert rec.finished[0] == "FAILED"
+
+    inc = diagnosis.load_incident(
+        os.path.join(_job_dir(tmp_path, rec.app_id),
+                     constants.INCIDENT_FILE))
+    assert inc is not None, "incident.json missing for the hang drill"
+    v = inc["verdict"]
+    assert v["category"] == "HANG"
+    assert v["blamed_task"] == "worker:0"
+    # the all-thread stack dump captured by the hung-task diagnostics
+    # pass made it into the report
+    assert "hang_after_steps" in inc["blamed_task"]["stack_dump"]
+    whats = [r["what"] for r in inc["timeline"]]
+    assert "TASK_HUNG" in whats
+    assert whats.index("TASK_HUNG") < whats.index("APPLICATION_FINISHED")
+
+    from tony_tpu.cli.main import main
+    assert main(["diagnose", rec.app_id,
+                 "--history-root", str(tmp_path / "history")]) == 0
+    out = capsys.readouterr().out
+    assert "HANG" in out
+    assert "stack dump excerpt" in out
+    assert "TASK_HUNG" in out

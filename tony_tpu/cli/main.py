@@ -207,7 +207,7 @@ def _cmd_status(args: argparse.Namespace) -> int:
             for t in report.get("tasks", []):
                 print(f"  {t['name']}:{t['index']:<3} {t['status']:<10} "
                       f"{t.get('host', '') or ''}{_fmt_hb_age(t)}"
-                      f"{_fmt_progress(t)}")
+                      f"{_fmt_progress(t)}{_fmt_exit(t)}")
             return 0
         except Exception as e:  # noqa: BLE001
             print(f"(coordinator unreachable: {e}; trying history)",
@@ -226,6 +226,18 @@ def _cmd_status(args: argparse.Namespace) -> int:
           f"{_default_workdir(args.workdir)}, no history under {root})",
           file=sys.stderr)
     return 1
+
+
+def _fmt_exit(task: dict) -> str:
+    """Decoded exit-signal suffix for a failed task's status row —
+    '-9'/'137' render as 'SIGKILL (signal 9; likely OOM-killer ...)'
+    via the shared decoder the rule engine uses too."""
+    code = task.get("exit_code")
+    if code in (None, 0):
+        return ""
+    from tony_tpu.diagnosis.exitcodes import describe_exit
+
+    return f"  {describe_exit(code)}"
 
 
 def _fmt_hb_age(task: dict) -> str:
@@ -375,6 +387,41 @@ def _cmd_trace(args: argparse.Namespace) -> int:
     print(f"{n_spans} spans, {len(unclosed)} unclosed"
           + (f" ({', '.join(unclosed)})" if unclosed else ""),
           file=sys.stderr)
+    return 0
+
+
+def _cmd_diagnose(args: argparse.Namespace) -> int:
+    """Automatic failure diagnosis: print the incident report for a job
+    — verdict category, blamed task, evidence lines, the user traceback
+    / stack-dump excerpt verbatim, and the causal timeline. Finished
+    jobs serve the coordinator-written incident.json (recompute with
+    --fresh); live jobs get a PROVISIONAL read computed on the spot.
+    Works post-hoc on any history dir, including one copied off a dead
+    host."""
+    from tony_tpu import constants, diagnosis
+    from tony_tpu.events import history
+
+    root = _history_root(args)
+    job_dir = history.list_job_dirs(root).get(args.app_id)
+    if job_dir is None:
+        print(f"unknown application {args.app_id} under {root}",
+              file=sys.stderr)
+        return 1
+    live = history.find_history_file(job_dir) is None
+    incident = None
+    if not live and not args.fresh:
+        incident = diagnosis.load_incident(
+            os.path.join(job_dir, constants.INCIDENT_FILE))
+    if incident is None:
+        incident = diagnosis.diagnose_job_dir(job_dir, app_id=args.app_id,
+                                              provisional=live)
+    if args.json:
+        print(json.dumps(incident, indent=1, sort_keys=True))
+        return 0
+    if incident.get("status") == "SUCCEEDED":
+        print(f"{args.app_id} SUCCEEDED — nothing to diagnose "
+              f"(full report follows for the curious)", file=sys.stderr)
+    print(diagnosis.render_text(incident))
     return 0
 
 
@@ -689,6 +736,20 @@ def build_parser() -> argparse.ArgumentParser:
     tr.add_argument("--history-root")
     tr.add_argument("--out", help="write JSON here instead of stdout")
     tr.set_defaults(fn=_cmd_trace)
+
+    dg = sub.add_parser(
+        "diagnose",
+        help="why did my job die: verdict category, blamed task, "
+             "evidence, traceback/stack-dump excerpts, causal timeline "
+             "(post-hoc on history; live jobs get a provisional read)")
+    dg.add_argument("app_id")
+    dg.add_argument("--history-root")
+    dg.add_argument("--json", action="store_true",
+                    help="print the raw incident.json document")
+    dg.add_argument("--fresh", action="store_true",
+                    help="re-run the rule engine even when the "
+                         "coordinator already wrote incident.json")
+    dg.set_defaults(fn=_cmd_diagnose)
 
     h = sub.add_parser("history", help="list finished jobs")
     h.add_argument("--history-root")

@@ -356,6 +356,23 @@ METRICS_EXPORT_INTERVAL_S = _key(
     "into <job_dir>/metrics.prom (the portal /metrics scrape source) and "
     "snapshots counters for recovery. Control-plane-rate, not per-step.")
 
+# --- automatic failure diagnosis (tony_tpu/diagnosis/) --------------------
+DIAGNOSIS_ENABLED = _key(
+    "tony.diagnosis.enabled", True, bool,
+    "On any non-SUCCEEDED finish the coordinator assembles an incident "
+    "bundle (events + journal + spans + metrics + log tails with "
+    "extracted tracebacks/stack dumps + scrubbed config), runs the rule "
+    "engine over it, writes <job_dir>/incident.json and emits "
+    "JOB_DIAGNOSED with the verdict (category, blamed task, evidence). "
+    "Read it with `tony-tpu diagnose <app>` or the portal "
+    "/diagnose/<app>. Off = no automatic diagnosis (the CLI/portal can "
+    "still run the engine post-hoc on the history dir).")
+DIAGNOSIS_LOG_TAIL_BYTES = _key(
+    "tony.diagnosis.log-tail-bytes", 65536, int,
+    "How much of each task log's TAIL the diagnosis collector reads "
+    "(seek-based — multi-GB logs cost only this much memory) when "
+    "hunting tracebacks, stack dumps and OOM markers.")
+
 # --- rpc ------------------------------------------------------------------
 RPC_CALL_TIMEOUT_S = _key(
     "tony.rpc.call-timeout-s", 10.0, float,
@@ -634,6 +651,7 @@ _JOB_KEY_RE: Pattern[str] = re.compile(
 _RESERVED_NON_JOB_SEGMENTS = {
     "application", "task", "coordinator", "client", "history", "tpu", "portal",
     "keep-failed-task-dirs", "internal", "fault", "rpc", "trace", "metrics",
+    "diagnosis",
 }
 
 

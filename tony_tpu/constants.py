@@ -130,6 +130,11 @@ METRICS_PROM_FILE = "metrics.prom"
 # Counter snapshot (tony_tpu/metrics.py save_counters): reloaded by a
 # --recover coordinator so counters stay monotonic across recovery.
 METRICS_COUNTERS_FILE = "metrics.counters.json"
+# Automatic failure diagnosis (tony_tpu/diagnosis/): the incident
+# document the coordinator writes on any non-SUCCEEDED finish — verdict
+# category, blamed task, evidence, causal timeline. Atomically replaced;
+# readers treat a torn/absent file as "recompute from the bundle".
+INCIDENT_FILE = "incident.json"
 EVENTS_SUFFIX = ".jhist.jsonl"
 INPROGRESS_SUFFIX = ".jhist.jsonl.inprogress"
 HISTORY_INTERMEDIATE = "intermediate"

@@ -40,6 +40,7 @@ from tony_tpu.coordinator.liveness import ProgressTracker
 from tony_tpu.coordinator.scheduler import GangScheduler
 from tony_tpu.coordinator.session import (FailureDomain, Session,
                                           SessionStatus, Task, TaskStatus)
+from tony_tpu.diagnosis.exitcodes import describe_exit
 from tony_tpu.events.events import Event, EventHandler, EventType
 from tony_tpu.events import history
 from tony_tpu.rpc.wire import FencedError, RpcServer
@@ -78,9 +79,11 @@ class _RpcService:
         return self._c.register_tensorboard_url(task_id, url)
 
     def register_execution_result(self, task_id: str, exit_code: int,
-                                  session_id: int = -1) -> int:
+                                  session_id: int = -1,
+                                  diagnostics: Optional[dict] = None) -> int:
         return self._c.register_execution_result(task_id, exit_code,
-                                                 session_id=session_id)
+                                                 session_id=session_id,
+                                                 diagnostics=diagnostics)
 
     def finish_application(self) -> str:
         self._c.client_signalled_finish.set()
@@ -163,6 +166,10 @@ class Coordinator:
                     registered=tr.registered)
         self.scheduler: Optional[GangScheduler] = None
         self.metrics_store: Dict[str, dict] = {}
+        # Executor-shipped postmortem context (register_execution_result
+        # `diagnostics`): extracted user traceback + decoded exit signal,
+        # folded into the task's TASK_FINISHED and the incident bundle.
+        self._task_diag: Dict[str, dict] = {}
         self.tb_url: str = ""
         self.client_signalled_finish = threading.Event()
         self.final_status = SessionStatus.RUNNING
@@ -681,11 +688,18 @@ class Coordinator:
         return True
 
     def register_execution_result(self, task_id: str, exit_code: int,
-                                  session_id: int = -1) -> int:
+                                  session_id: int = -1,
+                                  diagnostics: Optional[dict] = None) -> int:
         """Executor self-report; unregisters from the liveness monitor so a
         completed task can't be deemed dead (reference design note
-        ``ApplicationMaster.java:891-919``)."""
+        ``ApplicationMaster.java:891-919``). ``diagnostics`` is the
+        executor's postmortem extract for a failed user process (the
+        traceback from its own log tail, the decoded exit signal) —
+        captured at the source, where the log is ALWAYS local, instead
+        of hoping the coordinator can reach the file."""
         self._check_epoch(task_id, session_id)
+        if isinstance(diagnostics, dict) and diagnostics:
+            self._task_diag[task_id] = diagnostics
         with self._hb_lock:
             self._last_hb.pop(task_id, None)
         self.progress.forget(task_id)
@@ -841,14 +855,24 @@ class Coordinator:
             exit_code=exit_code,
             domain=t.failure_domain.value if t.failure_domain else "")
         logs = self.backend.task_log_paths(task_id)
-        self.events.emit(Event(EventType.TASK_FINISHED, {
+        payload = {
             "task": task_id, "exit_code": exit_code,
             "status": t.status.value,
+            "exit_detail": describe_exit(exit_code),
             "failure_domain": (t.failure_domain.value
                                if t.failure_domain else ""),
             "metrics": self.metrics_store.get(task_id, {}),
             "logs": list(logs) if logs else [],
-            "session_id": self.session.session_id}))
+            "session_id": self.session.session_id}
+        diag = self._task_diag.get(task_id) if exit_code != 0 else None
+        if diag:
+            # Executor-extracted postmortem: the user traceback rides the
+            # event stream so diagnosis works even after task dirs purge.
+            if diag.get("traceback"):
+                payload["traceback"] = str(diag["traceback"])[:8192]
+            if diag.get("exit_detail"):
+                payload["exit_detail"] = str(diag["exit_detail"])
+        self.events.emit(Event(EventType.TASK_FINISHED, payload))
         if self.scheduler is not None and t.tracked:
             job = self.session.jobs[t.job_name]
             done = [self.session.get_task(f"{t.job_name}:{i}")
@@ -918,7 +942,10 @@ class Coordinator:
             self.events.emit(Event(EventType.TASK_FINISHED, {
                 "task": task_id, "exit_code": constants.EXIT_KILLED,
                 "status": t.status.value,
+                "exit_detail": describe_exit(constants.EXIT_KILLED),
                 "failure_domain": FailureDomain.INFRA_TRANSIENT.value,
+                "reason": f"task {task_id} deemed dead (missed "
+                          f"heartbeats for {self._hb_expiry_s:.1f}s)",
                 "last_heartbeat_age_s": round(hb_age_s, 3),
                 "progress": progress_snap or {},
                 "metrics": self.metrics_store.get(task_id, {}),
@@ -1029,6 +1056,7 @@ class Coordinator:
         payload = {
             "task": task_id, "exit_code": constants.EXIT_KILLED,
             "status": t.status.value,
+            "exit_detail": describe_exit(constants.EXIT_KILLED),
             "failure_domain": FailureDomain.INFRA_TRANSIENT.value,
             "reason": reason,
             "progress": progress_snap or dict(info),
@@ -1048,27 +1076,16 @@ class Coordinator:
         the stacks even after task dirs are purged. Empty when the log is
         unreachable (remote host) or the dump never landed (user signal
         override, dump signal lost)."""
+        from tony_tpu.utils import logs as logutil
+
         paths = self.backend.task_log_paths(task_id)
-        if not paths:
-            return ""
-        for path in reversed(paths):       # stderr is the usual home
-            try:
-                with open(path, "rb") as f:
-                    f.seek(0, os.SEEK_END)
-                    size = f.tell()
-                    f.seek(max(0, size - 64 * 1024))
-                    tail = f.read().decode("utf-8", "replace")
-            except OSError:
+        for path in reversed(paths or ()):  # stderr is the usual home
+            tail = logutil.tail_text(path, 64 * 1024)
+            if tail is None:
                 continue
-            # faulthandler dump markers (Python's own format); take the
-            # FIRST marker in the tail so the excerpt spans the whole
-            # dump, not just its final thread block.
-            idx = tail.find("Thread 0x")
-            cur = tail.find("Current thread 0x")
-            if idx < 0 or (0 <= cur < idx):
-                idx = cur
-            if idx >= 0:
-                return tail[idx:idx + max_bytes]
+            excerpt = logutil.extract_stack_dump(tail, max_bytes)
+            if excerpt:
+                return excerpt
         return ""
 
     # ------------------------------------------------------------------
@@ -1255,6 +1272,9 @@ class Coordinator:
             # tasks re-arm from scratch (fresh warmup, fresh deadlines).
             self.progress.reset()
             self._progress_journal_t.clear()
+            # Postmortem extracts belong to the old epoch's processes —
+            # a stale traceback must not attach to the new gang's exits.
+            self._task_diag.clear()
             self._worker_termination_done = False
         # Bump the attempt only after the fresh session is installed: a
         # concurrent application_report must never see (old FAILED session,
@@ -1452,6 +1472,50 @@ class Coordinator:
                         "relaunch may be refused by the backend")
         self.backend.poll_completions()   # clear final stale completions
 
+    def _maybe_diagnose(self) -> None:
+        """Automatic failure diagnosis (tony_tpu/diagnosis/): on any
+        non-SUCCEEDED finish, flush the event stream to disk, run the
+        collector + rule engine over the job dir, write incident.json,
+        and emit JOB_DIAGNOSED so downstream tooling sees the verdict
+        without re-running the engine. Best-effort by contract: the
+        flight recorder must never be the reason a teardown fails."""
+        if self.final_status == SessionStatus.SUCCEEDED:
+            return
+        if not self.conf.get_bool(K.DIAGNOSIS_ENABLED, True):
+            return
+        try:
+            from tony_tpu import diagnosis
+
+            # The collector reads the in-progress jhist file from disk;
+            # the async writer must materialize everything emitted so
+            # far (including APPLICATION_FINISHED) first.
+            self.events.flush()
+            incident = diagnosis.diagnose_job_dir(
+                self.job_dir, app_id=self.app_id,
+                tail_bytes=self.conf.get_int(
+                    K.DIAGNOSIS_LOG_TAIL_BYTES, 65536))
+            # The just-emitted APPLICATION_FINISHED carries the final
+            # status; stamp it in case the stream lagged anyway.
+            incident["status"] = self.final_status.value
+            incident["provisional"] = False
+            path = os.path.join(self.job_dir, constants.INCIDENT_FILE)
+            diagnosis.save_incident(path, incident)
+            v = incident.get("verdict") or {}
+            log.warning(
+                "incident diagnosis: %s (blamed task %s, rule %s) — "
+                "report at %s", v.get("category", "UNKNOWN"),
+                v.get("blamed_task") or "-", v.get("rule", "?"), path)
+            self.events.emit(Event(EventType.JOB_DIAGNOSED, {
+                "app_id": self.app_id,
+                "category": v.get("category", "UNKNOWN"),
+                "blamed_task": v.get("blamed_task", ""),
+                "rule": v.get("rule", ""),
+                "confidence": v.get("confidence", 0.0),
+                "summary": v.get("summary", ""),
+                "incident_path": path}))
+        except Exception:  # noqa: BLE001 — diagnosis is best-effort
+            log.exception("incident diagnosis failed")
+
     def _stop(self) -> None:
         """Reference ``stop()`` :670-711 — stop running tasks with grace,
         wait for the client finish signal, finalize history."""
@@ -1485,6 +1549,7 @@ class Coordinator:
             "failure_domain": (self.session.failure_domain.value
                                if self.session.failure_domain else ""),
         }))
+        self._maybe_diagnose()
         # Close the trace: untracked services killed at teardown still
         # hold open lifecycle spans; the finish marker + root span close
         # the tree (zero unclosed spans on any orderly shutdown), and the

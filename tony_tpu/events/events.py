@@ -48,6 +48,11 @@ class EventType(str, enum.Enum):
     # never reported a step counter — it degrades to heartbeat-only
     # liveness (never a false hang kill).
     TASK_PROGRESS_UNINSTRUMENTED = "TASK_PROGRESS_UNINSTRUMENTED"
+    # Automatic failure diagnosis ran on a non-SUCCEEDED finish
+    # (tony_tpu/diagnosis/): payload carries the verdict category, the
+    # blamed task, the rule that fired, and the incident.json path —
+    # downstream tooling reads the verdict without re-running the engine.
+    JOB_DIAGNOSED = "JOB_DIAGNOSED"
 
 
 @dataclasses.dataclass
@@ -121,9 +126,27 @@ class EventHandler:
                     continue
                 if ev is None:
                     break
+                if isinstance(ev, threading.Event):
+                    # Flush barrier: everything queued before it is now
+                    # written; push it to disk and wake the waiter.
+                    fsync_file(f)
+                    dirty = False
+                    ev.set()
+                    continue
                 f.write(ev.to_json() + "\n")
                 dirty = True
             fsync_file(f)
+
+    def flush(self, timeout: float = 5.0) -> bool:
+        """Block until every event emitted so far is written AND synced
+        to the in-progress file (FIFO queue ⇒ a barrier marker behind
+        them proves it). The diagnosis collector reads that file from
+        disk mid-teardown, so the stream must be materialized first."""
+        if self._thread is None or not self._thread.is_alive():
+            return False
+        done = threading.Event()
+        self._queue.put(done)  # type: ignore[arg-type]
+        return done.wait(timeout)
 
     def stop(self, final_name: str) -> str:
         """Flush remaining events and rename in-progress → final

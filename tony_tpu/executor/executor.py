@@ -591,6 +591,15 @@ class TaskExecutor:
         if not self.command:
             log.error("no task command configured for %s", self.task_id)
             return constants.EXIT_FAILURE
+        # Postmortem span durability: the buffered complete-only sink
+        # only reaches the job's span log via trace.push, so an executor
+        # dying on SIGTERM (backend kill, preemption ladder) used to
+        # take its whole side of the timeline with it. atexit covers
+        # every orderly-ish death — the signal forwarder exits via
+        # SystemExit, which runs atexit hooks; only SIGKILL still loses
+        # the buffer (and can lose nothing else either).
+        import atexit
+        atexit.register(self._flush_trace)
         self._run_span = self.tracer.start_span(
             "executor.run", parent=self._trace_parent, task=self.task_id)
         # Every RPC this executor makes carries the trace context, so
@@ -768,11 +777,38 @@ class TaskExecutor:
         # epoch down, and these frames should already be in the log.
         self._run_span.end(exit_code=exit_code)
         self._flush_trace()
-        self._report_result_with_recovery(exit_code)
+        self._report_result_with_recovery(
+            exit_code, diagnostics=self._postmortem_diagnostics(exit_code))
         self._maybe_skew_sleep()
         return exit_code
 
-    def _report_result_with_recovery(self, exit_code: int) -> None:
+    def _postmortem_diagnostics(self, exit_code: int) -> Optional[dict]:
+        """Failed user process: extract the postmortem the coordinator
+        can't reliably get itself — the last Python traceback from the
+        task's own log tail (always local to THIS host, unlike the
+        coordinator's view of it) and the decoded exit signal. Rides the
+        result report into the TASK_FINISHED event and the incident
+        bundle."""
+        if exit_code == 0:
+            return None
+        from tony_tpu.diagnosis.exitcodes import describe_exit
+        from tony_tpu.utils import logs as logutil
+
+        diag: Dict[str, str] = {"exit_detail": describe_exit(exit_code)}
+        for name in ("stderr.log", "stdout.log"):
+            text = logutil.tail_text(os.path.join(os.getcwd(), name),
+                                     64 * 1024)
+            if not text:
+                continue
+            tb = logutil.extract_traceback(text)
+            if tb:
+                diag["traceback"] = tb
+                break
+        return diag
+
+    def _report_result_with_recovery(
+            self, exit_code: int,
+            diagnostics: Optional[dict] = None) -> None:
         """Deliver the exit code, surviving a coordinator outage. A task
         that FINISHES while the coordinator is down would otherwise
         discard its result after one failed call — and the recovered
@@ -788,7 +824,8 @@ class TaskExecutor:
             try:
                 self.client.call("register_execution_result",
                                  task_id=self.task_id, exit_code=exit_code,
-                                 session_id=self.session_id)
+                                 session_id=self.session_id,
+                                 diagnostics=diagnostics)
                 return
             except FencedError as e:
                 log.warning("result for %s fenced by a live coordinator: "

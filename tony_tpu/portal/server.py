@@ -158,7 +158,8 @@ class PortalServer:
                                   b"unauthorized (bearer token required)")
         parsed = urlparse(req.path)
         parts = [p for p in parsed.path.split("/") if p]
-        as_json = parse_qs(parsed.query).get("format", [""])[0] == "json"
+        query = parse_qs(parsed.query)
+        as_json = query.get("format", [""])[0] == "json"
         try:
             if not parts:
                 return self._jobs_index(req, as_json)
@@ -169,7 +170,8 @@ class PortalServer:
                 return self._prom_view(req)
             view, *rest = parts
             if view in ("config", "jobs", "logs", "logfile",
-                        "profiles", "metrics", "trace") and rest:
+                        "profiles", "metrics", "trace", "diagnose") \
+                    and rest:
                 job_id = rest[0]
                 if view == "config":
                     return self._config_view(req, job_id, as_json)
@@ -178,13 +180,16 @@ class PortalServer:
                 if view == "logs":
                     return self._logs_view(req, job_id, as_json)
                 if view == "logfile" and len(rest) >= 2:
-                    return self._logfile_view(req, job_id, int(rest[1]))
+                    return self._logfile_view(req, job_id, int(rest[1]),
+                                              query)
                 if view == "profiles":
                     return self._profiles_view(req, job_id, as_json)
                 if view == "metrics":
                     return self._metrics_view(req, job_id, as_json)
                 if view == "trace":
                     return self._trace_view(req, job_id, as_json)
+                if view == "diagnose":
+                    return self._diagnose_view(req, job_id, as_json)
             self._send(req, 404, "text/plain", b"not found")
         except Exception as e:  # noqa: BLE001
             log.exception("portal error for %s", req.path)
@@ -211,7 +216,8 @@ class PortalServer:
                 f"<a href='/logs/{a}'>logs</a> "
                 f"<a href='/profiles/{a}'>profiles</a> "
                 f"<a href='/metrics/{a}'>metrics</a> "
-                f"<a href='/trace/{a}'>trace</a></td></tr>")
+                f"<a href='/trace/{a}'>trace</a> "
+                f"<a href='/diagnose/{a}'>diagnose</a></td></tr>")
         body.append("</table>")
         self._send_html(req, "".join(body))
 
@@ -491,17 +497,54 @@ class PortalServer:
         self._send_html(
             req, f"<h1>profiler traces — {html.escape(job_id)}</h1>{body}")
 
-    def _logfile_view(self, req, job_id: str, index: int) -> None:
+    def _logfile_view(self, req, job_id: str, index: int,
+                      query: Optional[Dict[str, list]] = None) -> None:
+        """Tail of one recorded task log. Seek-based (utils/logs.py —
+        a multi-GB log costs only the requested tail, never a whole-file
+        read into memory); ``?tail=N`` overrides the byte count."""
+        from tony_tpu.utils import logs as logutil
+
         pairs = self._log_paths(job_id)
         if not 0 <= index < len(pairs):
             return self._send(req, 404, "text/plain", b"no such log")
         path = pairs[index][1]
-        if not os.path.exists(path):
+        tail_bytes = logutil.DEFAULT_TAIL_BYTES
+        raw = (query or {}).get("tail", [""])[0]
+        if raw:
+            try:
+                tail_bytes = max(0, int(raw))
+            except ValueError:
+                return self._send(req, 400, "text/plain",
+                                  b"bad ?tail= value (bytes expected)")
+        try:
+            data = logutil.tail_file(path, tail_bytes)
+        except OSError:
             return self._send(req, 404, "text/plain",
                               b"log file no longer present")
-        with open(path, "rb") as f:
-            data = f.read()[-1_000_000:]  # tail cap
         self._send(req, 200, "text/plain; charset=utf-8", data)
+
+    def _diagnose_view(self, req, job_id: str, as_json: bool) -> None:
+        """Automatic failure diagnosis (tony_tpu/diagnosis/): serve the
+        coordinator-written incident.json for finished jobs; compute a
+        PROVISIONAL read live for running ones (never cached — a live
+        diagnosis must track the job). HTML and JSON from the same
+        document the CLI renders."""
+        from tony_tpu import diagnosis
+
+        job_dir = self._job_dir(job_id)
+        if job_dir is None:
+            return self._send(req, 404, "text/plain", b"unknown job")
+        incident = None
+        if not self._job_live(job_id):
+            incident = diagnosis.load_incident(
+                os.path.join(job_dir, constants.INCIDENT_FILE))
+        if incident is None:
+            incident = diagnosis.diagnose_job_dir(
+                job_dir, app_id=job_id,
+                provisional=self._job_live(job_id))
+        if as_json:
+            return self._send_json(req, incident)
+        self._send_html(req, diagnosis.render_html(incident))
 
     # -- plumbing --------------------------------------------------------
     def _send(self, req, code: int, ctype: str, body: bytes) -> None:
