@@ -63,7 +63,13 @@ def _span_first_step_latency(history_root):
     not wall-clock guesses — and a tracing regression check in the same
     breath: a missing span tree (no log, no submit span, no first-step
     span, or unclosed spans) raises, failing the orchestration point
-    loudly instead of silently reporting a probe-local number."""
+    loudly instead of silently reporting a probe-local number.
+
+    Returns (latency_s, breakdown): the headline number plus the
+    per-phase decomposition (tracing.cold_start_breakdown) whose phase
+    durations are consecutive boundary intervals and sum EXACTLY to the
+    headline — so a future regression is attributable to one phase from
+    the BENCH json alone, without re-running the job."""
     from tony_tpu import constants as tony_constants
     from tony_tpu import tracing
     from tony_tpu.events import history as tony_history
@@ -93,7 +99,9 @@ def _span_first_step_latency(history_root):
             f"span tree for {app} lacks "
             f"{'client.submit' if submit is None else 'executor.first_step'}"
             f" (have: {sorted(spans)}) — tracing regression")
-    return ((first["ts"] + first.get("dur", 0)) - submit["ts"]) / 1e6
+    latency = ((first["ts"] + first.get("dur", 0)) - submit["ts"]) / 1e6
+    breakdown = tracing.cold_start_breakdown(records)
+    return latency, breakdown
 
 
 def bench_orchestration_latency():
@@ -136,8 +144,15 @@ def bench_orchestration_latency():
     # The probe's wall-clock number becomes the cross-check; the headline
     # is span-derived (and raises if the span tree is missing/unclosed).
     out["probe_self_reported_s"] = out.pop("submit_to_first_step_s", None)
-    out["submit_to_first_step_s"] = round(
-        _span_first_step_latency(os.path.join(tmp, "history")), 2)
+    latency, breakdown = _span_first_step_latency(
+        os.path.join(tmp, "history"))
+    out["submit_to_first_step_s"] = round(latency, 2)
+    # Per-phase cold-start decomposition (consecutive boundary intervals;
+    # sums exactly to the headline): the artifact that makes a
+    # submit-latency regression attributable from the BENCH json alone.
+    out["phases"] = breakdown["phases"]
+    out["phase_total_s"] = breakdown["total_s"]
+    out["phase_span_durations"] = breakdown["span_durations"]
     return out
 
 

@@ -1,0 +1,17 @@
+"""Minimal first-step probe for the warm-pool e2e drill: record ONE
+telemetry step (the anchor of the executor.first_step span / the bench's
+submit_to_first_step_s) with no jax import — the drill measures the
+ORCHESTRATION path, and the pool's jax preload is exercised separately.
+The final synchronous write matters: this script exits faster than the
+reporter thread's cadence, and the executor must see steps_completed=1."""
+import os
+
+import tony_tpu  # noqa: F401  (starts the telemetry reporter in-task)
+from tony_tpu import telemetry
+
+with telemetry.step():
+    pass
+metrics_file = os.environ.get("TONY_METRICS_FILE", "")
+if metrics_file:
+    telemetry.write_stats_once(metrics_file)
+print(f"first step done (pid {os.getpid()})")

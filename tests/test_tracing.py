@@ -263,3 +263,107 @@ def test_rpc_slow_conf_key_registered():
 
     assert K.fault_key("rpc.slow") == "tony.fault.rpc-slow"
     assert "tony.fault.rpc-slow" in K.registry()
+
+
+# ---------------------------------------------------------------------------
+# Cold-start decomposition (cold_start_breakdown)
+# ---------------------------------------------------------------------------
+def _x(name, ts_us, dur_us=0, task="", svc="svc", **args):
+    return {"ev": "X", "trace": "t1", "span": f"{name}@{ts_us}",
+            "parent": "", "name": name, "svc": svc, "task": task,
+            "ts_us": ts_us, "dur_us": dur_us, "args": dict(args)}
+
+
+def _cold_start_records(task="worker:0"):
+    """A synthetic but shape-faithful submit→first-step span tree
+    (timestamps in µs; total 10 s)."""
+    return [
+        _x("client.submit", 0, 10_000_000, svc="client"),
+        _x("client.stage", 100_000, 900_000, svc="client"),        # →1.0s
+        _x("task.lifecycle", 2_000_000, 7_000_000, task=task,
+           svc="coordinator"),
+        _x("pool.lease", 2_100_000, 50_000, task=task,
+           svc="coordinator", worker="w1"),
+        _x("executor.run", 3_500_000, 6_000_000, task=task,
+           svc="executor"),
+        _x("executor.localize", 3_550_000, 200_000, task=task,
+           svc="executor"),
+        _x("executor.register", 3_600_000, 900_000, task=task,
+           svc="executor"),
+        _x("executor.user_process", 5_000_000, 4_800_000, task=task,
+           svc="executor"),
+        _x("executor.first_step", 9_000_000, 1_000_000, task=task,
+           svc="executor"),
+    ]
+
+
+def test_cold_start_breakdown_phases_sum_exactly():
+    bd = tracing.cold_start_breakdown(_cold_start_records())
+    assert bd["task"] == "worker:0"
+    assert bd["total_s"] == 10.0
+    assert bd["phases"] == {"stage": 1.0, "provision": 1.0, "spawn": 1.5,
+                            "register": 1.0, "launch": 0.5,
+                            "user_boot": 5.0}
+    # the property the BENCH artifact relies on: consecutive boundary
+    # intervals — the phases sum EXACTLY to the headline
+    assert round(sum(bd["phases"].values()), 6) == bd["total_s"]
+    # raw (possibly overlapping) span durations ride along, incl. the
+    # pool adoption span
+    assert bd["span_durations"]["pool.lease"] == 0.05
+    assert bd["span_durations"]["executor.localize"] == 0.2
+
+
+def test_cold_start_breakdown_missing_phase_folds_forward():
+    """A missing intermediate span folds its time into the next phase —
+    the sum stays exact, nothing is silently dropped."""
+    recs = [r for r in _cold_start_records()
+            if r["name"] not in ("task.lifecycle", "executor.register")]
+    bd = tracing.cold_start_breakdown(recs)
+    assert "provision" not in bd["phases"]
+    assert "register" not in bd["phases"]
+    assert round(sum(bd["phases"].values()), 6) == bd["total_s"] == 10.0
+    # lifecycle's slice lands in spawn, register's in launch
+    assert bd["phases"]["spawn"] == 2.5
+    assert bd["phases"]["launch"] == 1.5
+
+
+def test_cold_start_breakdown_anchors_on_first_finishing_task():
+    """Multi-task gang: the breakdown follows the task whose first_step
+    ENDED first, ignoring the other task's boundary spans."""
+    recs = _cold_start_records(task="worker:1")
+    # worker:0 reaches its first step earlier
+    recs += [
+        _x("executor.run", 2_500_000, 6_000_000, task="worker:0",
+           svc="executor"),
+        _x("executor.register", 2_600_000, 400_000, task="worker:0",
+           svc="executor"),
+        _x("executor.user_process", 3_100_000, 4_000_000, task="worker:0",
+           svc="executor"),
+        _x("executor.first_step", 6_000_000, 1_000_000, task="worker:0",
+           svc="executor"),
+    ]
+    bd = tracing.cold_start_breakdown(recs)
+    assert bd["task"] == "worker:0"
+    assert bd["total_s"] == 7.0
+    assert bd["phases"]["spawn"] == 1.5          # 1.0 (stage end) → 2.5
+    assert round(sum(bd["phases"].values()), 6) == 7.0
+
+
+def test_cold_start_breakdown_raises_without_anchor_spans():
+    with pytest.raises(RuntimeError, match="cold-start breakdown needs"):
+        tracing.cold_start_breakdown(
+            [_x("client.submit", 0, 1_000_000, svc="client")])
+    with pytest.raises(RuntimeError, match="cold-start breakdown needs"):
+        tracing.cold_start_breakdown(
+            [_x("executor.first_step", 0, 1_000_000, task="worker:0")])
+
+
+def test_cold_start_breakdown_clamps_out_of_window_boundaries():
+    """A boundary past the first-step end (e.g. a straggler's register)
+    is clamped into the window; monotonicity and the exact sum hold."""
+    recs = _cold_start_records()
+    for r in recs:
+        if r["name"] == "executor.user_process":
+            r["ts_us"] = 11_000_000          # pathological: after the end
+    bd = tracing.cold_start_breakdown(recs)
+    assert round(sum(bd["phases"].values()), 6) == bd["total_s"] == 10.0

@@ -373,7 +373,28 @@ def _cmd_trace(args: argparse.Namespace) -> int:
               f"tony.trace.enabled=false, or predates tracing",
               file=sys.stderr)
         return 1
-    payload = tracing.to_trace_events(tracing.load_records(path))
+    records = tracing.load_records(path)
+    if args.cold_start:
+        # Per-phase submit→first-step decomposition (the bench's phase
+        # artifact, on demand for any job): consecutive boundary
+        # intervals, so the phases sum exactly to the total.
+        try:
+            bd = tracing.cold_start_breakdown(records)
+        except RuntimeError as e:
+            print(f"cold-start breakdown unavailable: {e}",
+                  file=sys.stderr)
+            return 1
+        print(f"{args.app_id}  submit -> first step: {bd['total_s']:.2f}s"
+              f"  (task {bd['task'] or '?'})")
+        for phase, secs in bd["phases"].items():
+            bar = "#" * min(60, int(60 * secs / max(bd["total_s"], 1e-9)))
+            print(f"  {phase:<10}{secs:>8.2f}s  {bar}")
+        if bd["span_durations"]:
+            print("  raw span durations (may overlap):")
+            for name, secs in sorted(bd["span_durations"].items()):
+                print(f"    {name:<28}{secs:>8.2f}s")
+        return 0
+    payload = tracing.to_trace_events(records)
     text = json.dumps(payload, indent=1)
     if args.out:
         with open(args.out, "w", encoding="utf-8") as f:
@@ -652,6 +673,101 @@ def _cmd_gcloud_gc(args: argparse.Namespace) -> int:
     return 1 if failures else 0
 
 
+def _pool_dir(args: argparse.Namespace) -> str:
+    return os.path.abspath(os.path.expanduser(
+        args.dir or os.path.join(_default_workdir(args.workdir), "pool")))
+
+
+def _cmd_pool(args: argparse.Namespace) -> int:
+    """Warm-executor-pool operations (tony_tpu/pool.py): `start` spawns
+    the daemon detached and waits for its endpoint; `status` prints the
+    fleet; `stop` asks the daemon to shut idle workers down (leased
+    executors belong to their jobs and are left alone). Point submits at
+    it with tony.pool.dir=<dir> — see the Cold start runbook in
+    docs/operations.md."""
+    import subprocess
+
+    from tony_tpu import constants
+    from tony_tpu.pool import PoolClient
+    from tony_tpu.utils import proc as procutil
+
+    pool_dir = _pool_dir(args)
+    addr_path = os.path.join(pool_dir, constants.POOL_ADDR_FILE)
+    if args.action == "start":
+        if os.path.exists(addr_path):
+            client = PoolClient(pool_dir)
+            try:
+                st = client.call("pool.status")
+                print(f"pool already running under {pool_dir} "
+                      f"({st.get('ready', '?')} ready / "
+                      f"{st.get('size', '?')} size)")
+                return 0
+            except Exception:  # noqa: BLE001 — stale addr from a dead pool
+                os.unlink(addr_path)
+            finally:
+                client.close()
+        os.makedirs(pool_dir, exist_ok=True)
+        from tony_tpu.conf.config import TonyTpuConfig
+
+        conf = TonyTpuConfig.from_layers(config_file=args.conf_file,
+                                         overrides=tuple(args.conf or []))
+        size = args.size if args.size is not None \
+            else conf.get_int(K.POOL_SIZE, 2)
+        preload = args.preload if args.preload is not None \
+            else str(conf.get(K.POOL_PRELOAD, "jax"))
+        max_age = conf.get_int(K.POOL_MAX_LEASE_AGE_S, 600)
+        jax_cache = str(conf.get(K.JAX_COMPILE_CACHE_DIR, "") or "")
+        pool_log = open(os.path.join(pool_dir, "pool.log"), "ab")
+        cmd = [sys.executable, "-m", "tony_tpu.pool", "serve",
+               "--dir", pool_dir, "--size", str(size),
+               "--preload", preload, "--max-lease-age-s", str(max_age)]
+        if jax_cache:
+            cmd += ["--jax-cache-dir", jax_cache]
+        proc = subprocess.Popen(cmd, stdout=pool_log,
+                                stderr=subprocess.STDOUT,
+                                start_new_session=True)
+        pool_log.close()
+
+        def read_addr():
+            if proc.poll() is not None:
+                raise RuntimeError(
+                    f"pool daemon exited with {proc.returncode}; see "
+                    f"{os.path.join(pool_dir, 'pool.log')}")
+            return os.path.exists(addr_path) or None
+
+        if procutil.poll_till_non_null(read_addr, interval_s=0.1,
+                                       timeout_s=60) is None:
+            print(f"pool daemon never published its endpoint under "
+                  f"{pool_dir}", file=sys.stderr)
+            return 1
+        print(f"pool running under {pool_dir} (size {size}, "
+              f"preload {preload!r}); submit with "
+              f"--conf {K.POOL_DIR}={pool_dir}")
+        return 0
+    client = PoolClient(pool_dir)
+    try:
+        if args.action == "status":
+            st = client.call("pool.status")
+            print(f"{pool_dir}  size={st['size']}  ready={st['ready']}  "
+                  f"leased={st['leased']}")
+            for w in st.get("workers", []):
+                print(f"  {w['worker']}  pid={w['pid']:<8}"
+                      f"{w['state']:<9}age={w['age_s']:.0f}s"
+                      + (f"  task={w['task']}" if w.get("task") else ""))
+            return 0
+        if args.action == "stop":
+            client.call("pool.stop")
+            print(f"pool under {pool_dir} stopping (leased executors "
+                  f"are left to their jobs)")
+            return 0
+    except Exception as e:  # noqa: BLE001
+        print(f"no reachable pool under {pool_dir}: {e}", file=sys.stderr)
+        return 1
+    finally:
+        client.close()
+    return 1
+
+
 def build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(prog="tony-tpu")
     sub = p.add_subparsers(dest="cmd", required=True)
@@ -735,6 +851,10 @@ def build_parser() -> argparse.ArgumentParser:
     tr.add_argument("app_id")
     tr.add_argument("--history-root")
     tr.add_argument("--out", help="write JSON here instead of stdout")
+    tr.add_argument("--cold-start", action="store_true",
+                    help="print the per-phase submit→first-step "
+                         "breakdown (stage/provision/spawn/register/"
+                         "launch/user_boot) instead of the full trace")
     tr.set_defaults(fn=_cmd_trace)
 
     dg = sub.add_parser(
@@ -794,6 +914,25 @@ def build_parser() -> argparse.ArgumentParser:
     gc.add_argument("--poll-interval", type=float, default=5.0,
                     help="delete-operation poll cadence in seconds")
     gc.set_defaults(fn=_cmd_gcloud_gc)
+
+    pl = sub.add_parser(
+        "pool",
+        help="warm executor pool: keep pre-spawned executors (python + "
+             "tony_tpu + jax + compile cache warm) that submits adopt "
+             "for sub-2s resubmit (tony.pool.* keys)")
+    pl.add_argument("action", choices=("start", "stop", "status"))
+    pl.add_argument("--dir", help="pool directory (default: "
+                                  "<workdir>/pool)")
+    pl.add_argument("--workdir")
+    pl.add_argument("--size", type=int, default=None,
+                    help="warm executors to keep ready "
+                         "(default: tony.pool.size)")
+    pl.add_argument("--preload", default=None,
+                    help="modules to pre-import per worker "
+                         "(default: tony.pool.preload)")
+    pl.add_argument("--conf-file")
+    pl.add_argument("--conf", action="append", metavar="K=V")
+    pl.set_defaults(fn=_cmd_pool)
     return p
 
 

@@ -138,30 +138,40 @@ class TonyTpuClient:
         return str(self.conf.get(K.STORAGE_TOKEN, "") or "") \
             or os.environ.get(STORAGE_TOKEN_ENV, "")
 
-    def _stage_bundle(self) -> None:
+    def _export_storage_token(self) -> str:
+        """Resolve the storage credential and move it into the submit
+        environment BEFORE the coordinator is spawned (the coordinator
+        inherits this env and re-exports it to executors — the
+        separate-token-file discipline of the reference,
+        TokenCache.java:44-51). Scrubbed from the config UNCONDITIONALLY:
+        the frozen config is world-readable (portal config view, events,
+        the store itself), and a token set for e.g. gs:// checkpoint
+        access must not freeze just because staging itself is local."""
+        from tony_tpu.storage.store import STORAGE_TOKEN_ENV
+
+        token = self._storage_token()
+        if token:
+            os.environ[STORAGE_TOKEN_ENV] = token
+            self.conf.unset(K.STORAGE_TOKEN)
+        return token
+
+    def _stage_bundle(self, token: str = "") -> None:
         """Stage src-dir, container resources, and the python venv where
         executors can localize them (the HDFS-upload analogue,
         ``processFinalTonyConf`` :189-228). With ``tony.storage.
         remote-store`` set, everything is PUT to the object store under the
         job prefix and the internal keys carry store URLs — no shared
         filesystem between client and task hosts is assumed. Otherwise the
-        job dir itself is the staging area (single-host path)."""
+        job dir itself is the staging area (single-host path).
+
+        The three groups (bundle tree, container resources, venv archive)
+        are independent byte-copies, so they run CONCURRENTLY: validation
+        happens up front in this thread (fail fast, before any copy
+        starts), the copies fan out to a small thread pool, and the
+        internal conf keys are set back here in submission order — the
+        frozen config never depends on pool scheduling."""
         remote = str(self.conf.get(K.REMOTE_STORE, "") or "")
         store = prefix = None
-        from tony_tpu.storage.store import STORAGE_TOKEN_ENV
-
-        token = self._storage_token()
-        if token:
-            # The credential travels by ENV, never in the config: the
-            # frozen config is world-readable (portal config view,
-            # events, the store itself). The coordinator inherits this
-            # env and re-exports it to executors — the separate-token-
-            # file discipline of the reference (TokenCache.java:44-51).
-            # Scrubbed UNCONDITIONALLY: a token set for e.g. gs://
-            # checkpoint access must not freeze just because staging
-            # itself is local.
-            os.environ[STORAGE_TOKEN_ENV] = token
-            self.conf.unset(K.STORAGE_TOKEN)
         if remote:
             from tony_tpu.storage import get_store
             from tony_tpu.storage.store import join as ujoin
@@ -169,21 +179,27 @@ class TonyTpuClient:
             store = get_store(remote, credential=token or None)
             prefix = ujoin(remote, self.app_id)
         src = str(self.conf.get(K.SRC_DIR, "") or "")
-        if src:
-            if not os.path.isdir(src):
-                raise ConfigError(f"{K.SRC_DIR}={src!r} is not a directory")
+        resources = self.conf.get_list(K.CONTAINER_RESOURCES)
+        venv = str(self.conf.get(K.PYTHON_VENV, "") or "")
+        # Fail-fast validation BEFORE any bytes move.
+        if src and not os.path.isdir(src):
+            raise ConfigError(f"{K.SRC_DIR}={src!r} is not a directory")
+        if venv and not os.path.isfile(venv):
+            raise ConfigError(
+                f"{K.PYTHON_VENV}={venv!r} is not an archive file")
+
+        def stage_src() -> str:
             if store:
                 from tony_tpu.storage.store import join as ujoin
 
                 url = ujoin(prefix, "bundle")
                 store.put_tree(src, url)
-                self.conf.set(K.INTERNAL_BUNDLE_DIR, url)
-            else:
-                bundle = os.path.join(self.job_dir, "bundle")
-                shutil.copytree(src, bundle, dirs_exist_ok=True)
-                self.conf.set(K.INTERNAL_BUNDLE_DIR, bundle)
-        resources = self.conf.get_list(K.CONTAINER_RESOURCES)
-        if resources:
+                return url
+            bundle = os.path.join(self.job_dir, "bundle")
+            shutil.copytree(src, bundle, dirs_exist_ok=True)
+            return bundle
+
+        def stage_res() -> str:
             from tony_tpu.utils.localize import stage_resources
 
             if store:
@@ -195,23 +211,44 @@ class TonyTpuClient:
             else:
                 staged = stage_resources(
                     resources, os.path.join(self.job_dir, "resources"))
-            self.conf.set(K.INTERNAL_RESOURCES, ",".join(staged))
-        venv = str(self.conf.get(K.PYTHON_VENV, "") or "")
-        if venv:
-            if not os.path.isfile(venv):
-                raise ConfigError(
-                    f"{K.PYTHON_VENV}={venv!r} is not an archive file")
+            return ",".join(staged)
+
+        def stage_venv() -> str:
             if store:
                 from tony_tpu.storage.store import join as ujoin
 
                 url = ujoin(prefix, os.path.basename(venv))
                 store.put_file(venv, url)
-                self.conf.set(K.INTERNAL_VENV, url)
-            else:
-                staged_venv = os.path.join(self.job_dir,
-                                           os.path.basename(venv))
-                shutil.copy2(venv, staged_venv)
-                self.conf.set(K.INTERNAL_VENV, staged_venv)
+                return url
+            staged_venv = os.path.join(self.job_dir,
+                                       os.path.basename(venv))
+            shutil.copy2(venv, staged_venv)
+            return staged_venv
+
+        jobs = []
+        if src:
+            jobs.append((K.INTERNAL_BUNDLE_DIR, stage_src))
+        if resources:
+            jobs.append((K.INTERNAL_RESOURCES, stage_res))
+        if venv:
+            jobs.append((K.INTERNAL_VENV, stage_venv))
+        if not jobs:
+            return
+        if len(jobs) == 1:
+            # Nothing to overlap; skip the pool machinery.
+            key, fn = jobs[0]
+            self.conf.set(key, fn())
+            return
+        from concurrent.futures import ThreadPoolExecutor
+
+        with ThreadPoolExecutor(max_workers=len(jobs),
+                                thread_name_prefix="tony-stage") as pool:
+            futures = [(key, pool.submit(fn)) for key, fn in jobs]
+            # .result() re-raises the first failure; remaining copies
+            # finish in the pool's __exit__ — a partial staging area is
+            # harmless, the job dir is per-app and about to be abandoned.
+            for key, fut in futures:
+                self.conf.set(key, fut.result())
 
     # -- lifecycle -------------------------------------------------------
     def start(self) -> int:
@@ -229,43 +266,68 @@ class TonyTpuClient:
             lst.on_application_id_received(self.app_id)
         self._submit_span = self._tracer.start_span(
             "client.submit", attrs={"app": self.app_id})
-        stage_span = self._tracer.start_span(
-            "client.stage", parent=self._submit_span)
+        frozen = os.path.join(self.job_dir, constants.FINAL_CONFIG_FILE)
+        addr_file = os.path.join(self.job_dir, "coordinator.addr")
         try:
-            self._stage_bundle()
+            # Overlap the serial prefix: the coordinator process is
+            # spawned FIRST — against a frozen-config path that does not
+            # exist yet (its __main__ polls for it, --conf-wait-s) — so
+            # its interpreter boot, imports, and backend construction run
+            # CONCURRENTLY with the client-side staging copies below.
+            # The credential export must precede the spawn (the
+            # coordinator inherits this env).
+            token = self._export_storage_token()
+            self._spawn_coordinator(frozen, addr_file)
+            stage_span = self._tracer.start_span(
+                "client.stage", parent=self._submit_span,
+                attrs={"parallel": True})
+            try:
+                self._stage_bundle(token)
+            finally:
+                stage_span.end()
+            self.conf.set(K.INTERNAL_APP_ID, self.app_id)
+            from tony_tpu.utils.version import version_info
+
+            vi = version_info()
+            self.conf.set(K.INTERNAL_VERSION, vi["version"])
+            self.conf.set(K.INTERNAL_REVISION, vi["revision"])
+            self.conf.set(K.INTERNAL_BRANCH, vi["branch"])
+            remote = str(self.conf.get(K.REMOTE_STORE, "") or "")
+            conf_url = ""
+            if remote:
+                # Executors on remote hosts fetch the frozen config itself
+                # from the store; the URL must be IN the config for the
+                # coordinator to hand out, so set it before freezing.
+                from tony_tpu.storage.store import join as ujoin
+
+                conf_url = ujoin(remote, self.app_id,
+                                 constants.FINAL_CONFIG_FILE)
+                self.conf.set(K.INTERNAL_CONF_URL, conf_url)
+            # Atomic (tmp+rename, utils/durable.py): the waiting
+            # coordinator must never read a partial config.
+            self.conf.freeze(frozen)
+            if conf_url:
+                from tony_tpu.storage import get_store
+
+                get_store(remote, credential=token or None
+                          ).put_file(frozen, conf_url)
+            return self._monitor(addr_file)
+        except RuntimeError as e:
+            # Coordinator died before/while serving (reference returns -1
+            # from monitorApplication on a failed app report, :838-892).
+            log.error("submission failed: %s", e)
+            return constants.EXIT_FAILURE
         finally:
-            stage_span.end()
-        self.conf.set(K.INTERNAL_APP_ID, self.app_id)
-        from tony_tpu.utils.version import version_info
+            # Also reached on a staging ConfigError: the already-spawned
+            # coordinator (still waiting for the config) must not leak.
+            self._cleanup()
 
-        vi = version_info()
-        self.conf.set(K.INTERNAL_VERSION, vi["version"])
-        self.conf.set(K.INTERNAL_REVISION, vi["revision"])
-        self.conf.set(K.INTERNAL_BRANCH, vi["branch"])
-        remote = str(self.conf.get(K.REMOTE_STORE, "") or "")
-        conf_url = ""
-        if remote:
-            # Executors on remote hosts fetch the frozen config itself from
-            # the store; the URL must be IN the config for the coordinator
-            # to hand out, so set it before freezing.
-            from tony_tpu.storage.store import join as ujoin
-
-            conf_url = ujoin(remote, self.app_id,
-                             constants.FINAL_CONFIG_FILE)
-            self.conf.set(K.INTERNAL_CONF_URL, conf_url)
-        frozen = self.conf.freeze(
-            os.path.join(self.job_dir, constants.FINAL_CONFIG_FILE))
-        if conf_url:
-            from tony_tpu.storage import get_store
-
-            get_store(remote, credential=self._storage_token() or None
-                      ).put_file(frozen, conf_url)
-
+    def _spawn_coordinator(self, frozen: str, addr_file: str) -> None:
         history_root = str(self.conf.get(K.HISTORY_LOCATION, "") or "") \
             or os.path.join(self.workdir, "history")
-        addr_file = os.path.join(self.job_dir, "coordinator.addr")
         cmd = [sys.executable, "-m", "tony_tpu.coordinator",
-               "--conf", frozen, "--app-id", self.app_id,
+               "--conf", frozen, "--conf-wait-s", "600",
+               "--app-id", self.app_id,
                "--history-root", history_root,
                "--workdir", os.path.join(self.job_dir, "tasks"),
                "--addr-file", addr_file,
@@ -283,15 +345,6 @@ class TonyTpuClient:
         self._coord_proc = subprocess.Popen(
             cmd, stdout=coord_log, stderr=subprocess.STDOUT, env=env)
         coord_log.close()
-        try:
-            return self._monitor(addr_file)
-        except RuntimeError as e:
-            # Coordinator died before/while serving (reference returns -1
-            # from monitorApplication on a failed app report, :838-892).
-            log.error("submission failed: %s", e)
-            return constants.EXIT_FAILURE
-        finally:
-            self._cleanup()
 
     def _connect(self, addr_file: str) -> RpcClient:
         """Poll for the coordinator endpoint (the RM-report analogue)."""
@@ -306,8 +359,14 @@ class TonyTpuClient:
                     return json.load(f)
             return None
 
+        # Generous window: since the overlapped-submit change the
+        # coordinator only binds its port AFTER the client finishes
+        # staging and freezes the config, so big remote stagings push the
+        # address file out by minutes. A dead coordinator is still
+        # detected within one 0.1 s poll (read_addr raises), so the long
+        # timeout only bounds the pathological silent-hang case.
         addr = procutil.poll_till_non_null(read_addr, interval_s=0.1,
-                                           timeout_s=60)
+                                           timeout_s=600)
         if addr is None:
             raise RuntimeError("coordinator address never appeared")
         tls = None

@@ -149,5 +149,12 @@ class Backend(abc.ABC):
         slices (cluster/tpu.py lease invariant)."""
         return False
 
+    def set_tracer(self, tracer) -> None:
+        """Give the backend the job's tracer so launch-path work it does
+        on the coordinator's behalf (warm-pool leases) lands in the span
+        tree. Default: kept but unused — emitting spans stays optional
+        per backend."""
+        self.tracer = tracer
+
     def stop(self) -> None:
         """Release backend resources."""

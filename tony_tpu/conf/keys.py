@@ -559,6 +559,50 @@ FAULT_USER_SLOW_STEP = _key(
     "spec are delayed by 'amt:X' seconds, driving the task's rate below "
     "the gang median — the straggler-policing drill. Combine with the "
     "'task:<job>:<idx>' filter to slow a single gang member.")
+FAULT_POOL_LEASE = _key(
+    "tony.fault.pool-lease", "", str,
+    "Fail the backend's warm-pool lease attempt before the RPC (refused "
+    "lease / unreachable daemon shape); the launch must degrade to a "
+    "cold spawn, never a job failure.")
+FAULT_POOL_STALE = _key(
+    "tony.fault.pool-stale", "", str,
+    "Simulate the pool daemon's stale-generation lease refusal (a "
+    "superseded epoch trying to lease); the launch degrades to a cold "
+    "spawn. The daemon also enforces the REAL check from the generation "
+    "carried in each lease.")
+FAULT_POOL_ADOPT = _key(
+    "tony.fault.pool-adopt", "", str,
+    "Kill a granted lease at adoption time (leased executor dead before "
+    "the task starts); the backend discards the lease at the daemon — "
+    "a dirty lease is never reused — and cold-spawns.")
+
+# --- warm executor pool (tony_tpu/pool.py) --------------------------------
+POOL_DIR = _key(
+    "tony.pool.dir", "", str,
+    "Directory of a running warm-executor pool (tony-tpu pool start). "
+    "When set, the local backend tries to ADOPT a pre-warmed executor "
+    "(Python up, tony_tpu + jax imported, compile cache mounted) via a "
+    "pool.lease RPC before cold-spawning; any pool failure degrades to "
+    "the cold path. Empty = no pool. Do NOT point jobs at a pool started "
+    "under different credentials or execution env — warm workers carry "
+    "the environment of their spawn time (see docs/operations.md).")
+POOL_SIZE = _key(
+    "tony.pool.size", 2, int,
+    "Warm executors the pool daemon keeps ready. Each lease consumes one "
+    "permanently (used/crashed workers are discarded, never re-pooled); "
+    "the daemon replenishes in the background.")
+POOL_MAX_LEASE_AGE_S = _key(
+    "tony.pool.max-lease-age-s", 600, int,
+    "Hygiene ceiling on warm-worker age: a worker older than this is "
+    "never leased and is recycled by the daemon (bounds credential/env "
+    "drift between pool start and adoption — a rotated storage token or "
+    "changed execution env reaches new workers within this window).")
+POOL_PRELOAD = _key(
+    "tony.pool.preload", "jax", str,
+    "Comma-separated modules each warm worker imports while idle (on top "
+    "of the always-preloaded executor stack). 'jax' also initializes the "
+    "backend — the multi-second cold-start slice the pool exists to "
+    "hide. Empty = interpreter + tony_tpu only.")
 
 # --- portal ---------------------------------------------------------------
 PORTAL_PORT = _key(
@@ -651,7 +695,7 @@ _JOB_KEY_RE: Pattern[str] = re.compile(
 _RESERVED_NON_JOB_SEGMENTS = {
     "application", "task", "coordinator", "client", "history", "tpu", "portal",
     "keep-failed-task-dirs", "internal", "fault", "rpc", "trace", "metrics",
-    "diagnosis",
+    "diagnosis", "pool",
 }
 
 

@@ -54,6 +54,11 @@ TRACE_PARENT_ENV = "TONY_TRACE_PARENT"
 TASK_ID = "TONY_TASK_ID"              # "<jobtype>:<index>"
 TASK_COMMAND = "TONY_TASK_COMMAND"    # user command for this task
 EXECUTOR_CONF = "TONY_EXECUTOR_CONF"  # path to the frozen final config
+# Warm-executor-pool adoption (tony_tpu/pool.py): set in the lease env by
+# the pool daemon so an adopted executor can mark its spans (register span
+# adopted=true, run span pooled=<worker id>) — the trace-visible proof a
+# submit skipped the cold spawn. Absent on cold-spawned executors.
+POOL_WORKER_ID = "TONY_POOL_WORKER_ID"
 
 # Global-rank contract for the JAX runtime (computed over the whole gang).
 GLOBAL_RANK = "TONY_GLOBAL_RANK"
@@ -135,6 +140,14 @@ METRICS_COUNTERS_FILE = "metrics.counters.json"
 # category, blamed task, evidence, causal timeline. Atomically replaced;
 # readers treat a torn/absent file as "recompute from the bundle".
 INCIDENT_FILE = "incident.json"
+# Warm-executor-pool daemon endpoint (tony_tpu/pool.py): host/port/token
+# JSON in the pool dir, 0600 like the coordinator address file. Backends
+# try a pool.lease against it before cold-spawning; absent file = no pool.
+POOL_ADDR_FILE = "pool.addr"
+# Per-task exit report a POOLED executor writes into its task workdir at
+# exit ({"exit_code": N}): the leased process is the pool daemon's child,
+# not the backend's, so poll_completions reads this instead of waitpid.
+POOL_EXIT_FILE = "pool-exit.json"
 EVENTS_SUFFIX = ".jhist.jsonl"
 INPROGRESS_SUFFIX = ".jhist.jsonl.inprogress"
 HISTORY_INTERMEDIATE = "intermediate"

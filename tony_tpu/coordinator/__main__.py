@@ -29,7 +29,11 @@ def _make_backend(conf, workdir):
 
     kind = str(conf.get(K.APPLICATION_BACKEND, "local"))
     if kind == "local":
-        return LocalProcessBackend(workdir)
+        # Warm-executor-pool seam (tony_tpu/pool.py): with tony.pool.dir
+        # set, launches try a pool.lease before cold-spawning.
+        pool_dir = os.path.expanduser(
+            str(conf.get(K.POOL_DIR, "") or ""))
+        return LocalProcessBackend(workdir, pool_dir=pool_dir)
     if kind == "tpu-slice":
         from tony_tpu.cluster.tpu import (FakeSliceProvisioner,
                                           StaticSshProvisioner,
@@ -91,6 +95,15 @@ def main(argv=None) -> int:
         format="%(asctime)s %(levelname)s %(name)s: %(message)s")
     p = argparse.ArgumentParser(prog="tony-tpu-coordinator")
     p.add_argument("--conf", required=True, help="frozen tony-final.json")
+    p.add_argument("--conf-wait-s", type=float, default=0.0,
+                   help="poll up to this many seconds for --conf to "
+                        "appear before loading it. The client spawns the "
+                        "coordinator BEFORE staging finishes (overlapped "
+                        "submit: interpreter boot + imports + backend "
+                        "construction run concurrently with the bundle "
+                        "copies) and freezes the config last — atomically, "
+                        "so a partial file is never visible. 0 = legacy "
+                        "fail-fast when the file is missing.")
     p.add_argument("--app-id", required=True)
     p.add_argument("--history-root", required=True)
     p.add_argument("--workdir", required=True,
@@ -105,6 +118,17 @@ def main(argv=None) -> int:
                         "crash recovery; see docs/operations.md)")
     args = p.parse_args(argv)
 
+    if args.conf_wait_s > 0 and not os.path.exists(args.conf):
+        from tony_tpu.utils import proc as procutil
+
+        found = procutil.poll_till_non_null(
+            lambda: os.path.exists(args.conf) or None,
+            interval_s=0.05, timeout_s=args.conf_wait_s)
+        if found is None:
+            logging.getLogger(__name__).error(
+                "frozen config %s never appeared within %.0fs — the "
+                "client died mid-staging?", args.conf, args.conf_wait_s)
+            return constants.EXIT_FAILURE
     conf = TonyTpuConfig.load_final(args.conf)
     backend = _make_backend(conf, args.workdir)
     try:

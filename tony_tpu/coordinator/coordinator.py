@@ -230,6 +230,13 @@ class Coordinator:
         mode = str(conf.get(K.TRACE_RPC_SPANS, "significant") or "")
         self._rpc_span_mode = mode if mode in ("all", "significant",
                                                "off") else "significant"
+        # Launch-path spans from the backend (pool.lease adoption) join
+        # the same tree — the backend parents them under the task
+        # lifecycle span id it finds in the launch env.
+        try:
+            backend.set_tracer(self.tracer)
+        except Exception:  # noqa: BLE001 — tracing is never load-bearing
+            pass
         self._run_span = tracing.NULL_SPAN
         self._epoch_span = tracing.NULL_SPAN
         self._rendezvous_span: Optional[object] = None

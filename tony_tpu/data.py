@@ -103,14 +103,18 @@ class ShardedBatchIterator:
                 local)
         return jax.tree.map(to_global, local, self.shardings)
 
-    def _worker_loop(self) -> None:
-        # Snapshot this generation's queue/event/step: a worker that
-        # outlives a close()+restart (join timeout) must keep talking to
-        # ITS queue, never the successor's — and must not mutate the shared
-        # step counter either: a late `self._step += 1` from an abandoned
-        # worker would make the restarted one silently skip a batch
-        # (ADVICE r5).
-        stop, q, step = self._stop_evt, self._q, self._step
+    def _worker_loop(self, stop: threading.Event, q: "queue.Queue",
+                     step: int) -> None:
+        # This generation's queue/event/step arrive as ARGUMENTS, bound
+        # by __next__ at Thread construction: a worker that outlives a
+        # close()+restart (join timeout) must keep talking to ITS queue,
+        # never the successor's — and must not read or mutate the shared
+        # step counter either (ADVICE r5: a late `self._step += 1` from
+        # an abandoned worker made the restarted one silently skip a
+        # batch). Snapshotting inside the loop body was not enough: an
+        # abandoned worker that had not yet been SCHEDULED when the
+        # restart happened would snapshot the successor's state and feed
+        # duplicate batches into the new queue.
         while not stop.is_set():
             try:
                 item = self._assemble(step)
@@ -141,6 +145,7 @@ class ShardedBatchIterator:
             self._q = queue.Queue(maxsize=self.prefetch)
             self._worker = threading.Thread(
                 target=self._worker_loop, name="tony-data-prefetch",
+                args=(self._stop_evt, self._q, self._step),
                 daemon=True)
             self._worker.start()
         item = self._q.get()

@@ -280,6 +280,11 @@ class TaskExecutor:
                 or int(signal.SIGUSR1)
         except ValueError:
             self._dump_signal = int(signal.SIGUSR1)
+        # Warm-pool adoption marker (tony_tpu/pool.py): stamped into the
+        # lease env by the pool daemon; empty on cold-spawned executors.
+        # Drives the adopted=true span attributes — nothing else differs:
+        # an adopted executor is indistinguishable to the coordinator.
+        self._pool_worker = e.get(constants.POOL_WORKER_ID, "")
         self.hostname = e.get("TONY_ADVERTISED_HOST") or socket.gethostname()
         try:
             socket.getaddrinfo(self.hostname, None)
@@ -548,43 +553,64 @@ class TaskExecutor:
         """Localize the staged job bundle, container resources, and venv
         into this task's working dir (reference ``Utils.extractResources``
         :710-723 unzipping the HDFS-localized src/venv archives, and YARN
-        resource localization per ``LocalizableResource``)."""
-        from tony_tpu.storage.store import is_url
+        resource localization per ``LocalizableResource``).
 
+        Cold-start posture: runs in a BACKGROUND thread overlapped with
+        port setup + the registration barrier (run() joins it before the
+        user process launches), fetches resources concurrently, and skips
+        content-unchanged files via the workdir manifest
+        (utils/localize.py) — a retry epoch re-localizing into the same
+        task dir pays ~nothing."""
+        from tony_tpu.storage.store import is_url
+        from tony_tpu.utils import localize as loc
+
+        workdir = os.getcwd()
+        manifest = loc.load_manifest(workdir)
         bundle = str(self.conf.get(K.INTERNAL_BUNDLE_DIR, "") or "")
         if bundle and is_url(bundle):
             from tony_tpu.storage import get_store
 
-            get_store(bundle).get_tree(bundle, os.getcwd())
+            get_store(bundle).get_tree(bundle, workdir)
         elif bundle and os.path.isdir(bundle):
             import shutil
-            shutil.copytree(bundle, os.getcwd(), dirs_exist_ok=True)
+
+            sig = f"__bundle__|{loc.tree_signature(bundle)}"
+            if manifest.get("__bundle__") != sig:
+                shutil.copytree(bundle, workdir, dirs_exist_ok=True)
+                manifest["__bundle__"] = sig
+            else:
+                log.debug("bundle localization skip (content unchanged)")
         resources = self.conf.get_list(K.INTERNAL_RESOURCES)
         if resources:
-            from tony_tpu.utils.localize import localize_resources
-
-            localize_resources(resources, os.getcwd())
+            loc.localize_resources(resources, workdir, manifest=manifest)
         venv = str(self.conf.get(K.INTERNAL_VENV, "") or "")
         if venv and is_url(venv):
             from tony_tpu.storage import get_store
 
-            local = os.path.join(os.getcwd(), os.path.basename(venv))
+            local = os.path.join(workdir, os.path.basename(venv))
             get_store(venv).get_file(venv, local)
             venv = local
         if venv and os.path.isfile(venv):
             import shutil
 
-            venv_dir = os.path.join(os.getcwd(), "venv")
-            os.makedirs(venv_dir, exist_ok=True)
-            shutil.unpack_archive(venv, venv_dir)
-            # Archived venvs lose the executable bit on their binaries when
-            # zipped; restore it so venv/bin/python is actually runnable.
-            bin_dir = os.path.join(venv_dir, "bin")
-            if os.path.isdir(bin_dir):
-                for f in os.listdir(bin_dir):
-                    p = os.path.join(bin_dir, f)
-                    if os.path.isfile(p):
-                        os.chmod(p, os.stat(p).st_mode | 0o755)
+            venv_sig = f"__venv__|{loc.file_content_hash(venv)}"
+            venv_dir = os.path.join(workdir, "venv")
+            if manifest.get("__venv__") == venv_sig \
+                    and os.path.isdir(venv_dir):
+                log.debug("venv localization skip (content unchanged)")
+            else:
+                os.makedirs(venv_dir, exist_ok=True)
+                shutil.unpack_archive(venv, venv_dir)
+                manifest["__venv__"] = venv_sig
+                # Archived venvs lose the executable bit on their binaries
+                # when zipped; restore it so venv/bin/python is runnable.
+                bin_dir = os.path.join(venv_dir, "bin")
+                if os.path.isdir(bin_dir):
+                    for f in os.listdir(bin_dir):
+                        p = os.path.join(bin_dir, f)
+                        if os.path.isfile(p):
+                            os.chmod(p, os.stat(p).st_mode | 0o755)
+        loc.save_manifest(workdir, manifest)
 
     # -- run ------------------------------------------------------------
     def run(self) -> int:
@@ -601,18 +627,35 @@ class TaskExecutor:
         import atexit
         atexit.register(self._flush_trace)
         self._run_span = self.tracer.start_span(
-            "executor.run", parent=self._trace_parent, task=self.task_id)
+            "executor.run", parent=self._trace_parent, task=self.task_id,
+            attrs={"pooled": self._pool_worker} if self._pool_worker
+            else None)
         # Every RPC this executor makes carries the trace context, so
         # coordinator-side RPC spans stitch under this run span.
         self._trace_ctx = (self.tracer.trace_id, self._run_span.span_id) \
             if self.tracer.enabled else None
         self.client.trace_context = self._trace_ctx
+        # Localization overlaps the registration barrier: the staged
+        # bytes only need to be in place before the USER process starts,
+        # and the gang barrier routinely idles for seconds waiting on
+        # peers — run() joins this thread (and re-raises its failure)
+        # right after the barrier opens, before the runtime env is built.
         localize_span = self.tracer.start_span(
             "executor.localize", parent=self._run_span, task=self.task_id)
-        try:
-            self._localize_bundle()
-        finally:
-            localize_span.end()
+        localize_err: list = []
+
+        def _localize_bg() -> None:
+            try:
+                self._localize_bundle()
+            except BaseException as e:  # noqa: BLE001 — re-raised at join
+                localize_err.append(e)
+            finally:
+                localize_span.end(error=str(localize_err[0])[:200]
+                                  if localize_err else "")
+
+        localize_thread = threading.Thread(
+            target=_localize_bg, name="tony-localize", daemon=True)
+        localize_thread.start()
         self.setup_ports()
         metrics_file = os.path.join(os.getcwd(), "user-metrics.json")
         self._metrics_file = metrics_file
@@ -638,7 +681,9 @@ class TaskExecutor:
             metrics_file=metrics_file)
 
         register_span = self.tracer.start_span(
-            "executor.register", parent=self._run_span, task=self.task_id)
+            "executor.register", parent=self._run_span, task=self.task_id,
+            attrs={"adopted": True, "pool_worker": self._pool_worker}
+            if self._pool_worker else None)
         try:
             cluster_spec = self.register_and_get_cluster_spec()
         except FencedError as e:
@@ -651,10 +696,21 @@ class TaskExecutor:
             self._run_span.end(barrier_timeout=True)
             self._flush_trace()
             return constants.EXIT_FAILURE
+        log.info("cluster spec: %s", cluster_spec)
+        # The barrier is open; the staged bytes must now actually be in
+        # place (and a localization failure must fail THIS task the same
+        # way it did when localization ran serially before registration).
+        localize_thread.join()
+        if localize_err:
+            hb.stop()
+            log.error("bundle localization failed for %s: %s",
+                      self.task_id, localize_err[0])
+            self._run_span.end(localize_error=str(localize_err[0])[:200])
+            self._flush_trace()
+            return constants.EXIT_FAILURE
         # First flush: registration/localization spans reach the span log
         # even if this executor is later SIGKILLed mid-training.
         self._flush_trace()
-        log.info("cluster spec: %s", cluster_spec)
 
         framework = str(self.conf.get(K.APPLICATION_FRAMEWORK, "jax"))
         runtime = get_runtime(framework)

@@ -31,6 +31,17 @@ site                      where it fires
                           ``amt:`` seconds before it is sent — injected
                           control-plane latency that never drops a frame
                           (exercises trace spans + latency histograms)
+``pool.lease``            backend warm-pool adoption, before the lease RPC
+                          — the lease-refused/daemon-unreachable shape;
+                          the backend must cold-spawn instead
+``pool.stale``            backend warm-pool adoption, before the lease RPC
+                          — simulates the daemon's stale-generation lease
+                          refusal (a zombie epoch trying to lease); the
+                          backend must cold-spawn instead
+``pool.adopt``            backend warm-pool adoption, after a granted
+                          lease — the leased-executor-dead-on-adoption
+                          shape; the backend must discard the lease and
+                          cold-spawn instead
 ========================  =====================================================
 
 Spec grammar (the value of ``tony.fault.<site>`` conf keys, or one
@@ -84,7 +95,8 @@ FAULTS_ENV = "TONY_FAULTS"
 SITES = ("rpc.connect", "rpc.send", "rpc.slow", "heartbeat",
          "executor.spawn", "storage.put", "storage.get", "checkpoint.save",
          "coordinator.crash", "executor.reregister",
-         "user.hang", "user.slow_step")
+         "user.hang", "user.slow_step",
+         "pool.lease", "pool.stale", "pool.adopt")
 
 
 class InjectedFault(ConnectionError):

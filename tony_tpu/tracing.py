@@ -390,3 +390,104 @@ def to_trace_events(records: List[Dict[str, Any]]) -> Dict[str, Any]:
     unclosed = [str(r.get("name", "?")) for r in opens.values()]
     return {"traceEvents": events, "displayTimeUnit": "ms",
             "traceId": trace_id, "unclosedSpans": unclosed}
+
+
+# ---------------------------------------------------------------------------
+# Cold-start decomposition: the submit→first-step critical path as phases
+# ---------------------------------------------------------------------------
+#: (phase, span name, edge) boundary schedule along the critical path. Each
+#: phase runs from the previous boundary to this span's start/end, so the
+#: phase durations are CONSECUTIVE and sum exactly to the headline
+#: submit→first-step latency — the property that lets a BENCH artifact
+#: attribute a regression to one phase without re-running anything.
+_COLD_START_BOUNDARIES = (
+    # client-side staging (bundle copytree / store PUTs / venv)
+    ("stage", "client.stage", "end"),
+    # coordinator interpreter boot + backend/slice provisioning + schedule
+    ("provision", "task.lifecycle", "start"),
+    # executor process spawn + python interpreter + tony_tpu import
+    # (the phase a warm-pool lease collapses to ~0)
+    ("spawn", "executor.run", "start"),
+    # registration + gang barrier (bundle localization overlaps this
+    # since the parallel-localize change; its own duration is reported
+    # separately under span_durations)
+    ("register", "executor.register", "end"),
+    # runtime env build + port release + user-process exec
+    ("launch", "executor.user_process", "start"),
+    # user interpreter + jax import + compile + first real step
+    ("user_boot", "executor.first_step", "end"),
+)
+
+
+def cold_start_breakdown(records: List[Dict[str, Any]]) -> Dict[str, Any]:
+    """Decompose ``client.submit → executor.first_step`` into per-phase
+    durations, straight from a job's span records.
+
+    Anchors on the FIRST ``executor.first_step`` span (by end time) and
+    that task's own lifecycle/executor spans, so multi-task gangs and
+    retry epochs report the path of the task that actually reached its
+    first step first. Raises RuntimeError when the anchor spans are
+    missing — the same loud-on-regression posture as the bench's span
+    check. Returns::
+
+        {"total_s": float,            # == sum(phases.values()), exact
+         "task": "worker:0",
+         "phases": {phase: seconds, ...},     # ordered, consecutive
+         "span_durations": {name: seconds}}   # raw (possibly overlapping)
+    """
+    payload = to_trace_events(records)
+    events = [e for e in payload["traceEvents"] if e.get("ph") == "X"]
+
+    def _task(e: Dict[str, Any]) -> str:
+        return str((e.get("args") or {}).get("task", "") or "")
+
+    submits = [e for e in events if e["name"] == "client.submit"]
+    firsts = [e for e in events if e["name"] == "executor.first_step"]
+    if not submits or not firsts:
+        raise RuntimeError(
+            f"cold-start breakdown needs client.submit and "
+            f"executor.first_step spans (have: "
+            f"{sorted({e['name'] for e in events})})")
+    submit = min(submits, key=lambda e: e["ts"])
+    first = min(firsts, key=lambda e: e["ts"] + e.get("dur", 0))
+    task = _task(first)
+
+    def _boundary(name: str, edge: str) -> Optional[int]:
+        # Prefer the anchor task's span; fall back to task-less spans
+        # (client.stage has no task). First occurrence wins — a retry
+        # epoch's second lifecycle span is not this cold start.
+        cands = [e for e in events if e["name"] == name
+                 and _task(e) in (task, "")]
+        if not cands:
+            return None
+        e = min(cands, key=lambda c: c["ts"])
+        return int(e["ts"] + (e.get("dur", 0) if edge == "end" else 0))
+
+    t0 = int(submit["ts"])
+    phases: Dict[str, float] = {}
+    prev = t0
+    end = int(first["ts"] + first.get("dur", 0))
+    for phase, span_name, edge in _COLD_START_BOUNDARIES:
+        b = _boundary(span_name, edge)
+        if b is None:
+            # A missing intermediate span folds its time into the next
+            # phase instead of losing it (the sum must stay exact).
+            continue
+        b = max(min(b, end), prev)   # clamp: monotonic, inside the window
+        phases[phase] = round((b - prev) / 1e6, 4)
+        prev = b
+    # Anything after the last known boundary still belongs to the total.
+    if end > prev:
+        phases["user_boot"] = round(
+            phases.get("user_boot", 0.0) + (end - prev) / 1e6, 4)
+    durations: Dict[str, float] = {}
+    for name in ("client.stage", "executor.localize", "executor.register",
+                 "executor.user_process", "executor.first_step",
+                 "pool.lease", "gang.rendezvous"):
+        cands = [e for e in events if e["name"] == name
+                 and _task(e) in (task, "")]
+        if cands:
+            e = min(cands, key=lambda c: c["ts"])
+            durations[name] = round(e.get("dur", 0) / 1e6, 4)
+    return {"total_s": round((end - t0) / 1e6, 4), "task": task,
+            "phases": phases, "span_durations": durations}
