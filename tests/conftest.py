@@ -8,6 +8,7 @@ against host-local virtual devices so CI needs no hardware (SURVEY.md §4.1).
 import os
 import signal
 import sys
+import tempfile
 
 import pytest
 
@@ -48,6 +49,43 @@ jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
 
 # Make `import tony_tpu` work no matter where pytest is invoked from.
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# ---------------------------------------------------------------------------
+# Lock sanitizer (tony_tpu/devtools/sanitizer.py): the WHOLE tier-1 suite
+# runs with every tony_tpu-allocated lock watched for lock-order cycles
+# and hold-while-blocking hazards; pytest_sessionfinish below fails the
+# run on any finding. Subprocesses (executors, coordinators, pool
+# workers) inherit the env vars and dump their own findings into the
+# shared directory at exit. Opt out with TONY_LOCK_SANITIZER=0.
+# Enabled BEFORE the jax import: patching is cheap either way (non-tony
+# allocation sites get raw primitives), but tony_tpu's own module-level
+# locks must be constructed after the factories are in place.
+# ---------------------------------------------------------------------------
+if os.environ.get("TONY_LOCK_SANITIZER", "") != "0":
+    os.environ["TONY_LOCK_SANITIZER"] = "1"
+    os.environ.setdefault(
+        "TONY_LOCK_SANITIZER_DIR",
+        tempfile.mkdtemp(prefix="tony-sanitizer-"))
+    from tony_tpu.devtools import sanitizer as _sanitizer
+
+    _sanitizer.maybe_enable_from_env()
+else:
+    _sanitizer = None
+
+
+def pytest_sessionfinish(session, exitstatus):
+    """Tier-1 acceptance gate: zero lock-order cycles, zero
+    hold-while-blocking hazards across the whole suite — this process
+    AND every sanitized subprocess the e2e drills spawned."""
+    if _sanitizer is None or not _sanitizer.enabled():
+        return
+    reports = _sanitizer.collect_reports()
+    bad = [r for r in reports if r.get("cycles") or r.get("hazards")]
+    if bad:
+        print("\n=== LOCK SANITIZER FINDINGS "
+              "(tony_tpu/devtools/sanitizer.py) ===")
+        print(_sanitizer.format_report(bad))
+        session.exitstatus = 1
 
 
 # ---------------------------------------------------------------------------

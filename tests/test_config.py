@@ -34,13 +34,14 @@ def test_registry_defaults_are_typed():
 def test_defaults_md_matches_registry():
     """``conf/defaults.md`` must be exactly the registry's rendered table —
     the keys↔defaults-file parity test (reference
-    ``TestTonyConfigurationFields.java:17-45``). Regenerate with
-    ``python -m tony_tpu.conf.keys``."""
-    path = os.path.join(os.path.dirname(os.path.abspath(K.__file__)),
-                        "defaults.md")
-    with open(path, encoding="utf-8") as f:
-        assert f.read() == K.defaults_markdown(), \
-            "defaults.md is stale — run `python -m tony_tpu.conf.keys`"
+    ``TestTonyConfigurationFields.java:17-45``). Thin wrapper: the single
+    implementation of the invariant is tonylint's ``defaults-md`` rule;
+    regenerate with ``python -m tony_tpu.conf.keys``."""
+    from tony_tpu.devtools.tonylint import run_lint
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    findings, _ = run_lint(repo, rules=["defaults-md"])
+    assert findings == [], "\n".join(str(f) for f in findings)
 
 
 def test_version_info_triple():

@@ -26,6 +26,15 @@ the coordinator's rendezvous (replacing four env-var dialects with one).
 
 __version__ = "0.1.0"
 
+# With TONY_LOCK_SANITIZER=1 in the environment, arm the lock sanitizer
+# BEFORE any tony_tpu module allocates a lock (telemetry below has
+# module-level locks), so executor/coordinator/pool subprocesses of a
+# sanitized run join the lock-order/hazard verdict; no-op — one env read
+# — everywhere else.
+from tony_tpu.devtools import sanitizer as _sanitizer  # noqa: E402
+
+_sanitizer.maybe_enable_from_env()
+
 from tony_tpu import constants  # noqa: F401
 from tony_tpu.conf.config import TonyTpuConfig  # noqa: F401
 

@@ -123,11 +123,14 @@ class CheckpointManager:
             p = os.path.join(root, rel.replace("/", os.sep))
             files[rel] = {"sha256": _hash_file(p),
                           "size": os.path.getsize(p)}
-        tmp = os.path.join(root, MANIFEST_NAME + ".tmp")
-        with open(tmp, "w", encoding="utf-8") as f:
-            json.dump({"step": int(step), "files": files}, f,
-                      sort_keys=True)
-        os.replace(tmp, os.path.join(root, MANIFEST_NAME))
+        # The manifest is the verified-restore contract: it must never be
+        # adoptable half-written, and it must survive the host crash that
+        # the restore is for — full atomic_write discipline.
+        from tony_tpu.utils.durable import atomic_write
+
+        atomic_write(os.path.join(root, MANIFEST_NAME),
+                     json.dumps({"step": int(step), "files": files},
+                                sort_keys=True).encode("utf-8"))
 
     def _flush_manifests(self) -> None:
         """Write manifests for every step whose save is now durable.

@@ -678,6 +678,22 @@ def _pool_dir(args: argparse.Namespace) -> str:
         args.dir or os.path.join(_default_workdir(args.workdir), "pool")))
 
 
+def _cmd_lint(args: argparse.Namespace) -> int:
+    """`tony-tpu lint` — the static invariant checker (tonylint)."""
+    from tony_tpu.devtools import tonylint
+
+    argv: List[str] = []
+    if args.list_rules:
+        argv.append("--list")
+    if args.json:
+        argv.append("--json")
+    if args.root:
+        argv += ["--root", args.root]
+    for rule in args.rule or []:
+        argv += ["--rule", rule]
+    return tonylint.main(argv)
+
+
 def _cmd_pool(args: argparse.Namespace) -> int:
     """Warm-executor-pool operations (tony_tpu/pool.py): `start` spawns
     the daemon detached and waits for its endpoint; `status` prints the
@@ -933,6 +949,22 @@ def build_parser() -> argparse.ArgumentParser:
     pl.add_argument("--conf-file")
     pl.add_argument("--conf", action="append", metavar="K=V")
     pl.set_defaults(fn=_cmd_pool)
+
+    ln = sub.add_parser(
+        "lint",
+        help="run tonylint, the project invariant checker: conf-key / "
+             "fault-site / event-type / rpc-parity registries plus the "
+             "durable-write, clock, span, thread and lock disciplines "
+             "(docs/development.md). Exits nonzero on findings.")
+    ln.add_argument("--json", action="store_true",
+                    help="emit findings as JSON")
+    ln.add_argument("--rule", action="append", metavar="RULE",
+                    help="run only this rule id (repeatable)")
+    ln.add_argument("--root", default=None,
+                    help="repo root to lint (default: this install)")
+    ln.add_argument("--list", dest="list_rules", action="store_true",
+                    help="list rule ids and exit")
+    ln.set_defaults(fn=_cmd_lint)
     return p
 
 

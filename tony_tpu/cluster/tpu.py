@@ -58,6 +58,8 @@ import threading
 import time
 from typing import Dict, List, Optional, Sequence, Tuple
 
+from tony_tpu.devtools import sanitizer
+from tony_tpu.utils import durable
 from tony_tpu.cluster.base import (Backend, TaskLaunchSpec,
                                    build_executor_argv)
 
@@ -297,8 +299,8 @@ class SshHostChannel(HostChannel):
                 # executor's ssh client being gone says nothing about the
                 # USER group (the dead-executor case is exactly when the
                 # pgid file matters), and KILL on dead groups is a no-op.
-                deadline = time.time() + grace_s
-                while (time.time() < deadline
+                deadline = time.monotonic() + grace_s
+                while (time.monotonic() < deadline
                        and handle["popen"].poll() is None):
                     time.sleep(0.1)
 
@@ -339,8 +341,11 @@ class SshHostChannel(HostChannel):
         # can race (e.g. a fetch thread abandoned by a join timeout vs a
         # later retry), and two writers interleaving into the same
         # .fetch-tmp would corrupt the very file the atomic-replace
-        # protects. dict.setdefault is atomic under the GIL.
-        with handle.setdefault("fetch_lock", threading.Lock()):
+        # protects. dict.setdefault is atomic under the GIL. io_lock:
+        # this lock EXISTS to hold across the blocking scp/ssh fetch —
+        # only fetchers of the same handle contend — so the lock
+        # sanitizer's hold-while-blocking check does not apply.
+        with handle.setdefault("fetch_lock", sanitizer.io_lock()):
             self._fetch_logs_locked(handle)
 
     def _fetch_logs_locked(self, handle) -> None:
@@ -400,7 +405,8 @@ class SshHostChannel(HostChannel):
             # it with a bad fetch would destroy the log.
             if ok:
                 try:
-                    os.replace(tmp, local)
+                    durable.fsync_path(tmp)
+                    durable.durable_replace(tmp, local)
                 except OSError:
                     ok = False
             if not ok:

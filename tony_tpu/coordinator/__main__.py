@@ -20,6 +20,7 @@ from tony_tpu.cluster.local import LocalProcessBackend
 from tony_tpu.conf.config import TonyTpuConfig
 from tony_tpu.coordinator.coordinator import Coordinator
 from tony_tpu.coordinator.session import SessionStatus
+from tony_tpu.utils.durable import atomic_write
 
 
 def _make_backend(conf, workdir):
@@ -145,19 +146,14 @@ def main(argv=None) -> int:
     coord.rpc.start()
     host, port = coord.rpc.address
     # The file carries the RPC auth token: it must be 0600 from its very
-    # first byte, so open the temp file with O_EXCL|0600 before writing
-    # rather than chmod-ing after the rename.
-    tmp = args.addr_file + ".tmp"
-    try:
-        os.unlink(tmp)  # stale leftover from a crashed previous run
-    except FileNotFoundError:
-        pass
-    fd = os.open(tmp, os.O_WRONLY | os.O_CREAT | os.O_EXCL, 0o600)
-    with os.fdopen(fd, "w", encoding="utf-8") as f:
-        json.dump({"host": host, "port": port,
-                   "token": coord.rpc_token or "",
-                   "tls_cert": coord.tls_cert}, f)
-    os.replace(tmp, args.addr_file)
+    # first byte (atomic_write's mode applies to the temp file, no
+    # chmod-after window), and executors re-resolve it during
+    # coordinator-loss recovery — a torn addr file would strand them.
+    atomic_write(args.addr_file,
+                 json.dumps({"host": host, "port": port,
+                             "token": coord.rpc_token or "",
+                             "tls_cert": coord.tls_cert}).encode("utf-8"),
+                 mode=0o600)
 
     status = coord.run()
     return 0 if status == SessionStatus.SUCCEEDED else constants.EXIT_FAILURE

@@ -44,6 +44,7 @@ from tony_tpu.diagnosis.exitcodes import describe_exit
 from tony_tpu.events.events import Event, EventHandler, EventType
 from tony_tpu.events import history
 from tony_tpu.rpc.wire import FencedError, RpcServer
+from tony_tpu.utils import durable
 
 log = logging.getLogger(__name__)
 
@@ -64,11 +65,11 @@ class _RpcService:
     def __init__(self, coord: "Coordinator"):
         self._c = coord
 
-    def get_task_infos(self) -> List[dict]:
-        return [t.to_info() for t in self._c.session.all_tasks()]
-
-    def get_cluster_spec(self, task_id: str) -> Optional[dict]:
-        return self._c.session.get_cluster_spec()
+    # NOTE: the reference's getTaskInfos/getClusterSpec RPCs are gone on
+    # purpose (tonylint rpc-parity: dead surface). register_worker_spec
+    # returns the cluster spec once the barrier opens, and
+    # get_application_report carries per-task info — nothing ever called
+    # the standalone methods.
 
     def register_worker_spec(self, task_id: str, host: str, port: int,
                              session_id: int = -1) -> Optional[dict]:
@@ -329,8 +330,7 @@ class Coordinator:
     #: the span log; 'all' traces them anyway, 'off' traces nothing).
     _PERIODIC_RPC = frozenset((
         "task_executor_heartbeat", "metrics.push", "metrics.get",
-        "metrics.live", "get_application_report", "get_task_infos",
-        "trace.push"))
+        "metrics.live", "get_application_report", "trace.push"))
 
     def _on_rpc_request(self, method: str, seconds: float,
                         ok: bool) -> None:
@@ -436,11 +436,8 @@ class Coordinator:
             self.metrics.gauge("tony_tasks", {**app, "status": status},
                                help="Tasks by status.").set(n)
         text = self.metrics.render()
-        tmp = f"{self._prom_path}.tmp.{os.getpid()}"
         try:
-            with open(tmp, "w", encoding="utf-8") as f:
-                f.write(text)
-            os.replace(tmp, self._prom_path)
+            durable.atomic_write(self._prom_path, text.encode("utf-8"))
         except OSError as e:
             log.debug("metrics.prom write failed: %s", e)
         self.metrics.save_counters(self._counters_path)
@@ -1468,8 +1465,8 @@ class Coordinator:
         # relaunching while it lives trips the slice backend's
         # one-gang-per-lease invariant ("lost hosts while its gang is
         # still running") — a race observed under CI load.
-        deadline = time.time() + 10
-        while time.time() < deadline:
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
             self.backend.poll_completions()
             if not self.backend.gang_active():
                 break

@@ -1,0 +1,14 @@
+"""Developer tooling: machine-checkable invariants for the orchestrator.
+
+Two halves, one discipline (docs/development.md):
+
+- ``tonylint`` — an AST-based static pass over the ``tony_tpu`` package
+  that enforces the project's implicit registries (conf keys, fault
+  sites, event types, RPC surface) and coding disciplines (durable
+  writes, monotonic clocks, span/thread hygiene, no blocking under
+  coordinator locks). Run it with ``tony-tpu lint``; it also runs inside
+  tier-1 (``tests/test_lint.py``) and as its own CI job.
+- ``sanitizer`` — a runtime lock sanitizer (env flag
+  ``TONY_LOCK_SANITIZER=1``) that records the lock-order graph and
+  hold-while-blocking hazards across the whole tier-1 suite.
+"""

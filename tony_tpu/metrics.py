@@ -296,11 +296,10 @@ class MetricsRegistry:
             payload = {name: {json.dumps(key): c.value
                               for key, c in fam.items()}
                        for name, fam in self._counters.items()}
-        tmp = f"{path}.tmp.{os.getpid()}"
         try:
-            with open(tmp, "w", encoding="utf-8") as f:
-                json.dump(payload, f)
-            os.replace(tmp, path)
+            from tony_tpu.utils.durable import atomic_write
+
+            atomic_write(path, json.dumps(payload).encode("utf-8"))
         except OSError:
             pass
 

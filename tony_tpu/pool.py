@@ -80,11 +80,14 @@ class PoolError(RuntimeError):
     cold spawn path."""
 
 
-def _atomic_json(path: str, obj: dict) -> None:
-    tmp = f"{path}.tmp.{os.getpid()}"
-    with open(tmp, "w", encoding="utf-8") as f:
-        json.dump(obj, f)
-    os.replace(tmp, path)
+def _atomic_json(path: str, obj: dict, mode: int = 0o644) -> None:
+    """Durable JSON drop: these files are the daemon↔worker handoff
+    protocol (lease grant, adoption ack, exit report) — a torn write
+    adopted as a valid lease or exit report corrupts a real job, so they
+    get the full atomic_write discipline, not just tmp+rename."""
+    from tony_tpu.utils.durable import atomic_write
+
+    atomic_write(path, json.dumps(obj).encode("utf-8"), mode=mode)
 
 
 def _read_json(path: str) -> Optional[dict]:
@@ -140,11 +143,12 @@ def _worker_main(worker_dir: str, preload: str) -> int:
     logging.basicConfig(
         level=logging.INFO,
         format="%(asctime)s %(levelname)s %(name)s: %(message)s")
-    started = time.time()
+    started_ts = time.time()          # wall anchor for the record only
+    t0 = time.monotonic()
     loaded = _preload(preload)
     _atomic_json(os.path.join(worker_dir, READY_FILE), {
-        "pid": os.getpid(), "started_ts": started,
-        "warm_after_s": round(time.time() - started, 3),
+        "pid": os.getpid(), "started_ts": started_ts,
+        "warm_after_s": round(time.monotonic() - t0, 3),
         "preloaded": loaded})
     lease_path = os.path.join(worker_dir, LEASE_FILE)
     shutdown_path = os.path.join(worker_dir, SHUTDOWN_FILE)
@@ -272,16 +276,9 @@ class PoolDaemon:
         addr_path = os.path.join(self.pool_dir, constants.POOL_ADDR_FILE)
         # 0600 from the first byte — the file carries the RPC token
         # (same discipline as the coordinator address file).
-        tmp = addr_path + ".tmp"
-        try:
-            os.unlink(tmp)
-        except FileNotFoundError:
-            pass
-        fd = os.open(tmp, os.O_WRONLY | os.O_CREAT | os.O_EXCL, 0o600)
-        with os.fdopen(fd, "w", encoding="utf-8") as f:
-            json.dump({"host": host, "port": port, "token": self.token,
-                       "pid": os.getpid(), "size": self.size}, f)
-        os.replace(tmp, addr_path)
+        _atomic_json(addr_path,
+                     {"host": host, "port": port, "token": self.token,
+                      "pid": os.getpid(), "size": self.size}, mode=0o600)
         log.info("pool daemon up at %s:%d (%d warm executors, preload=%r)",
                  host, port, self.size, self.preload)
 

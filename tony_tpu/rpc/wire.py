@@ -455,7 +455,15 @@ class RpcClient:
         self._id = 0
         self._lock = threading.Lock()
 
-    def _connect(self) -> socket.socket:
+    def _connect(self) -> Tuple[socket.socket, bytes, bytes, int]:
+        """Dial + hello handshake, touching NO shared client state —
+        call() runs this OUTSIDE the frame lock (connect can block for
+        the full connect timeout; holding the lock through it would park
+        every other caller thread — a sanitizer hold-while-blocking
+        hazard) and installs the result under the lock.
+
+        Returns (socket, combined nonce, client nonce, peer generation).
+        """
         faults.check("rpc.connect")
         sock = socket.create_connection(self._addr,
                                         timeout=self._connect_timeout_s)
@@ -481,7 +489,6 @@ class RpcClient:
         if not isinstance(hello, dict) or "nonce" not in hello:
             sock.close()
             raise RpcError("peer is not a tony-rpc server (no hello)")
-        self._check_peer_generation(int(hello.get("g", 0) or 0), sock)
         if self._token is not None and hello.get("tony-rpc") != 3:
             # A v2 server verifies MACs over its nonce alone; our dual-nonce
             # MACs would fail there with a misleading "bad frame MAC". Name
@@ -494,13 +501,9 @@ class RpcClient:
         # MAC both ways, so recorded responses from an old connection can
         # never satisfy this one (ADVICE r4: the hello alone gave the
         # client no replay protection).
-        self._client_nonce = os.urandom(16)
-        self._nonce = hello["nonce"] + self._client_nonce
-        self._hello_pending = True
-        # Request ids double as the anti-replay sequence and reset with
-        # each connection's fresh nonce.
-        self._id = 0
-        return sock
+        client_nonce = os.urandom(16)
+        return (sock, hello["nonce"] + client_nonce, client_nonce,
+                int(hello.get("g", 0) or 0))
 
     def _check_peer_generation(self, peer_gen: int,
                                sock: Optional[socket.socket] = None) -> None:
@@ -526,21 +529,48 @@ class RpcClient:
 
     def call(self, method: str, **args: Any) -> Any:
         last_err: Optional[Exception] = None
-        with self._lock:
-            for attempt in range(self._max_retries):
-                try:
+        # The lock serializes frames on the shared socket, per ATTEMPT —
+        # never across a sleep. Holding it through the backoff (the old
+        # shape) parked every other caller behind one caller's outage;
+        # the lock sanitizer (devtools/sanitizer.py) flags exactly that
+        # hold-while-blocking hazard.
+        for attempt in range(self._max_retries):
+            slow = faults.fire_amount("rpc.slow")
+            if slow:
+                # Injected control-plane latency: the frame still goes
+                # through, just late — lands in the latency histograms
+                # and trace spans, never in a retry. Before the timed
+                # send, and before the lock: a slow wire must not block
+                # other callers' frames.
+                time.sleep(slow)
+            try:
+                # Dial outside the lock (see _connect). The unlocked
+                # read of _sock can race another caller — the loser's
+                # fresh socket is closed at install time below.
+                conn = self._connect() if self._sock is None else None
+                with self._lock:
+                    if conn is not None:
+                        sock, nonce, client_nonce, peer_gen = conn
+                        if self._sock is None:
+                            self._check_peer_generation(peer_gen, sock)
+                            self._sock = sock
+                            self._nonce = nonce
+                            self._client_nonce = client_nonce
+                            self._hello_pending = True
+                            # Request ids double as the anti-replay
+                            # sequence; reset with the fresh nonce.
+                            self._id = 0
+                        else:
+                            sock.close()    # raced: reuse the winner's
                     if self._sock is None:
-                        self._sock = self._connect()
+                        # Concurrent caller closed the connection between
+                        # our unlocked check and the lock: retry cleanly.
+                        raise ConnectionResetError(
+                            "connection closed by a concurrent caller")
                     # A dropped frame surfaces as a connection error and
                     # rides the same reconnect+backoff path a real reset
                     # takes (tony_tpu/faults.py site table).
                     faults.check("rpc.send")
-                    slow = faults.fire_amount("rpc.slow")
-                    if slow:
-                        # Injected control-plane latency: the frame still
-                        # goes through, just late — lands in the latency
-                        # histograms and trace spans, never in a retry.
-                        time.sleep(slow)
                     t_call = time.monotonic()
                     self._id += 1
                     req = {"id": self._id, "method": method, "args": args}
@@ -585,16 +615,16 @@ class RpcClient:
                         except Exception:  # noqa: BLE001 — observability only
                             pass
                     return resp.get("result")
-                except (AuthError, FencedError):
-                    # Both are terminal verdicts about THIS peer/process
-                    # pair — retrying cannot change either.
-                    self._close_locked()
-                    raise
-                except (ConnectionError, OSError) as e:
-                    last_err = e
-                    self._close_locked()
-                    if attempt < self._max_retries - 1:
-                        time.sleep(self._retry_policy.delay_s(attempt))
+            except (AuthError, FencedError):
+                # Both are terminal verdicts about THIS peer/process
+                # pair — retrying cannot change either.
+                self.close()
+                raise
+            except (ConnectionError, OSError) as e:
+                last_err = e
+                self.close()
+                if attempt < self._max_retries - 1:
+                    time.sleep(self._retry_policy.delay_s(attempt))
         if isinstance(last_err, socket.timeout):
             raise RpcTimeout(
                 f"rpc {method} to {self._addr} timed out after "

@@ -55,6 +55,7 @@ from urllib.parse import quote, unquote, urlparse
 
 from tony_tpu import faults
 from tony_tpu.retry import RetryPolicy, call_with_retry
+from tony_tpu.utils import durable
 from tony_tpu.utils.gcp import GcpBearer
 
 STORAGE_TOKEN_ENV = "TONY_STORAGE_TOKEN"
@@ -489,7 +490,11 @@ class GcsStore(Store):
             except OSError:
                 pass
             raise
-        os.replace(tmp, local_path)
+        # Promote the finished download durably: the content-hash skip
+        # manifest (utils/localize.py) may later trust this file by
+        # size+mtime alone, so a torn rename must never look localized.
+        durable.fsync_path(tmp)
+        durable.durable_replace(tmp, local_path)
 
     def exists(self, url: str) -> bool:
         bucket, key = _split_gs(url)
@@ -609,7 +614,9 @@ class FakeGcsStore(Store):
         os.makedirs(os.path.dirname(path), exist_ok=True)
         tmp = path + ".tmp-up"
         shutil.copy2(local_path, tmp)
-        os.replace(tmp, path)   # object visibility is atomic, like GCS
+        # Object visibility is atomic AND durable, like a real GCS PUT.
+        durable.fsync_path(tmp)
+        durable.durable_replace(tmp, path)
 
     def get_file(self, url: str, local_path: str) -> None:
         _, _, path = self._obj_path(url)

@@ -190,11 +190,10 @@ def write_stats_once(path: str) -> bool:
         return False
     stats["ts"] = time.time()
     stats["pid"] = os.getpid()
-    tmp = f"{path}.tmp.{os.getpid()}"
     try:
-        with open(tmp, "w", encoding="utf-8") as f:
-            json.dump(stats, f)
-        os.replace(tmp, path)
+        from tony_tpu.utils.durable import atomic_write
+
+        atomic_write(path, json.dumps(stats).encode("utf-8"))
         return True
     except OSError:
         return False

@@ -122,6 +122,19 @@ class Span:
                 (time.monotonic() - self._t0_mono) * 1e6)
         self._tracer._end_span(self, max(int(end_us), self.start_us), attrs)
 
+    # Context-manager form: `with tracer.start_span("x") as span:` closes
+    # the span on every exit path, error included — the shape the
+    # span-leak lint rule (devtools/tonylint.py) prefers. An explicitly
+    # end()ed span inside the block stays ended (end is once-only).
+    def __enter__(self) -> "Span":
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> None:
+        if exc_type is not None and not self._done:
+            self.end(error=f"{exc_type.__name__}: {exc}"[:200])
+        else:
+            self.end()
+
 
 class _NullSpan:
     """Returned by a disabled tracer: every write is a no-op, so call
@@ -132,6 +145,12 @@ class _NullSpan:
     attrs: Dict[str, Any] = {}
 
     def end(self, end_us: Optional[int] = None, **attrs: Any) -> None:
+        pass
+
+    def __enter__(self) -> "_NullSpan":
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> None:
         pass
 
 

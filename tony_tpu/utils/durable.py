@@ -47,13 +47,17 @@ def fsync_dir(path: str) -> None:
         os.close(fd)
 
 
-def atomic_write(path: str, data: bytes) -> None:
+def atomic_write(path: str, data: bytes, mode: int = 0o644) -> None:
     """Atomically (re)place ``path`` with ``data``: temp file in the same
-    directory → write → flush+fsync → rename → directory fsync."""
+    directory → write → flush+fsync → rename → directory fsync.
+
+    ``mode`` applies from the temp file's very first byte (no chmod-after
+    window) — pass 0o600 for secret-bearing artifacts like the
+    coordinator/pool address files, which carry the RPC auth token."""
     d = os.path.dirname(os.path.abspath(path))
     os.makedirs(d, exist_ok=True)
     tmp = os.path.join(d, f".{os.path.basename(path)}.tmp.{os.getpid()}")
-    fd = os.open(tmp, os.O_WRONLY | os.O_CREAT | os.O_TRUNC, 0o644)
+    fd = os.open(tmp, os.O_WRONLY | os.O_CREAT | os.O_TRUNC, mode)
     try:
         with os.fdopen(fd, "wb") as f:
             f.write(data)
@@ -74,6 +78,23 @@ def durable_replace(src: str, dst: str) -> None:
     in-progress → final history file flip)."""
     os.replace(src, dst)
     fsync_dir(os.path.dirname(os.path.abspath(dst)))
+
+
+def fsync_path(path: str) -> None:
+    """fsync an already-written file by path. For stream-written temp
+    files (downloads, copies) promote with ``fsync_path(tmp)`` +
+    ``durable_replace(tmp, dst)`` — the same two-fsync shape as
+    ``atomic_write`` without buffering the payload in memory."""
+    try:
+        fd = os.open(path, os.O_RDONLY)
+    except OSError:
+        return
+    try:
+        os.fsync(fd)
+    except OSError:
+        pass
+    finally:
+        os.close(fd)
 
 
 def fsync_file(f: IO) -> None:

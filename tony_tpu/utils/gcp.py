@@ -38,13 +38,16 @@ class GcpBearer:
         self._root = (metadata_root or METADATA_ROOT).rstrip("/")
 
     def token(self) -> Optional[str]:
-        if self._token and time.time() < self._expiry - 60:
+        # Expiry deadlines live on the MONOTONIC clock: expires_in is a
+        # relative duration, and an NTP step must not make a live token
+        # look expired (or worse, a stale one look fresh).
+        if self._token and time.monotonic() < self._expiry - 60:
             return self._token
         env_tok = os.environ.get("GOOGLE_OAUTH_ACCESS_TOKEN")
         if env_tok:
             self._token, self._expiry = env_tok, float("inf")
             return self._token
-        if time.time() < self._anon_until:
+        if time.monotonic() < self._anon_until:
             return None
         try:
             req = urlrequest.Request(self._root + _TOKEN_PATH,
@@ -52,10 +55,11 @@ class GcpBearer:
             with urlrequest.urlopen(req, timeout=5) as r:
                 body = json.loads(r.read().decode())
             self._token = body.get("access_token")
-            self._expiry = time.time() + float(body.get("expires_in", 300))
+            self._expiry = time.monotonic() + float(
+                body.get("expires_in", 300))
         except Exception:  # noqa: BLE001 — off-GCP: anonymous
             self._token = None
-            self._anon_until = time.time() + 300
+            self._anon_until = time.monotonic() + 300
         return self._token
 
     def invalidate(self) -> None:

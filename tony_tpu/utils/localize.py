@@ -176,10 +176,13 @@ def load_manifest(workdir: str) -> Dict[str, str]:
 
 def save_manifest(workdir: str, manifest: Dict[str, str]) -> None:
     try:
-        tmp = os.path.join(workdir, MANIFEST_FILE + ".tmp")
-        with open(tmp, "w", encoding="utf-8") as f:
-            json.dump(manifest, f, sort_keys=True)
-        os.replace(tmp, os.path.join(workdir, MANIFEST_FILE))
+        from tony_tpu.utils.durable import atomic_write
+
+        # Durable, not just atomic: the manifest vouches for localized
+        # content by hash/signature — it must never claim files whose own
+        # writes a crash could still lose.
+        atomic_write(os.path.join(workdir, MANIFEST_FILE),
+                     json.dumps(manifest, sort_keys=True).encode("utf-8"))
     except OSError as e:  # the manifest is an optimization, never a failure
         log.debug("localization manifest write failed: %s", e)
 
