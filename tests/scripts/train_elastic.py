@@ -1,0 +1,168 @@
+"""Elastic training stand-in for the shrink-and-continue e2e drills.
+
+Simulates a checkpointing, step-synchronous SPMD gang without needing
+cross-process collectives:
+
+- The CHIEF (dense rank 0) owns the checkpoint: after completing step s
+  it waits until every gang member's sample log shows step s, then
+  atomically publishes ``ckpt.json`` = {"step": s, "loss": L}. Every
+  other rank waits for ``ckpt.step >= s-1`` before starting step s —
+  bounded lockstep, like a real per-step collective.
+- Loss is a pure function of the step count (the recovery-drill decay),
+  so a run interrupted by any number of resizes lands on EXACTLY the
+  uninterrupted golden curve iff no step was lost or double-counted.
+- Each rank consumes its ``process_batch_slice`` rows of the global
+  batch per step (tony_tpu.data — the elastic re-split under test) and
+  appends ``step world start stop`` to ``samples.<stable-index>``. On
+  (re)start it RESUMES from the checkpoint: recompute the loss, truncate
+  its own sample/loss logs past the checkpoint step (superseded partial
+  steps are re-run at the new world size), continue.
+- SIGTERM = the resize drain (or teardown): optionally sleep
+  TONY_TEST_DRAIN_DELAY (the mid-resize-crash drill needs a wide drain
+  window), then exit 143 — the checkpoint-and-park contract.
+
+The harness asserts: the loss log equals the golden curve once per step
+(continuity, zero burned epochs), and for every step EXACTLY ONE world
+size's records tile the global batch with no overlap (no sample dropped
+or duplicated across the re-splits).
+"""
+
+import json
+import os
+import signal
+import sys
+import time
+
+
+def _read_ckpt(path):
+    try:
+        with open(path, encoding="utf-8") as f:
+            return json.load(f)
+    except (OSError, ValueError):
+        return None
+
+
+def _write_ckpt(path, step, loss):
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "w", encoding="utf-8") as f:
+        json.dump({"step": step, "loss": loss}, f)
+    os.replace(tmp, path)
+
+
+def _truncate_log(path, keep_step):
+    """Drop records past the resume point: superseded partial steps are
+    re-run (at the new world size) — exactly once in the final log."""
+    if not os.path.exists(path):
+        return
+    kept = []
+    with open(path, encoding="utf-8") as f:
+        for line in f:
+            parts = line.split()
+            try:
+                if parts and int(parts[0]) <= keep_step:
+                    kept.append(line)
+            except ValueError:
+                continue
+    with open(path, "w", encoding="utf-8") as f:
+        f.writelines(kept)
+
+
+def _loss_at(step):
+    loss = 100.0
+    for k in range(1, step + 1):
+        loss = loss / (1.0 + 0.1 * k)
+    return loss
+
+
+def main() -> int:
+    from tony_tpu.data import process_batch_slice
+
+    total = int(os.environ.get("TONY_TEST_TOTAL_STEPS", "30"))
+    dt = float(os.environ.get("TONY_TEST_STEP_SECONDS", "0.25"))
+    gb = int(os.environ.get("TONY_TEST_GLOBAL_BATCH", "24"))
+    outdir = os.environ["TONY_TEST_ELASTIC_DIR"]
+    drain_delay = float(os.environ.get("TONY_TEST_DRAIN_DELAY", "0"))
+    rank = int(os.environ["TASK_INDEX"])          # dense rank
+    world = int(os.environ["TASK_NUM"])           # current gang size
+    ident = os.environ.get("TONY_TASK_INDEX", str(rank))  # stable index
+    members = [m for m in os.environ.get(
+        "TONY_GANG_MEMBERS", "").split(",") if m != ""]
+    if not members:
+        members = [str(i) for i in range(world)]
+
+    def on_term(signum, frame):
+        if drain_delay:
+            time.sleep(drain_delay)
+        # os._exit, not sys.exit: jax's XLA thread pools can abort the
+        # interpreter during ordinary teardown ("terminate called
+        # without an active exception"), which would turn the drain's
+        # 143 into a spurious 134/USER_ERROR. All writes below are
+        # already closed (context managers) when this fires.
+        os._exit(143)
+
+    signal.signal(signal.SIGTERM, on_term)
+
+    ckpt_path = os.path.join(outdir, "ckpt.json")
+    samples_path = os.path.join(outdir, f"samples.{ident}")
+    loss_path = os.path.join(outdir, "loss.log")
+
+    ckpt = _read_ckpt(ckpt_path)
+    start = int(ckpt["step"]) + 1 if ckpt else 1
+    loss = _loss_at(start - 1)
+    _truncate_log(samples_path, start - 1)
+    if rank == 0:
+        _truncate_log(loss_path, start - 1)
+
+    deadline = time.monotonic() + 120.0           # wedge-proof
+    for step in range(start, total + 1):
+        # step-synchronous gang: wait for the chief's previous publish
+        while rank != 0:
+            c = _read_ckpt(ckpt_path)
+            if (int(c["step"]) if c else 0) >= step - 1:
+                break
+            if time.monotonic() > deadline:
+                print(f"rank {rank} wedged waiting for ckpt {step - 1}",
+                      file=sys.stderr)
+                return 2
+            time.sleep(0.02)
+        time.sleep(dt)
+        loss = loss / (1.0 + 0.1 * step)
+        rows = process_batch_slice(gb, rank=rank, world=world)
+        with open(samples_path, "a", encoding="utf-8") as f:
+            f.write(f"{step} {world} {rows.start} {rows.stop}\n")
+        if rank == 0:
+            # publish only once EVERY member completed the step — the
+            # checkpoint never runs ahead of the slowest rank, so a
+            # resume point is always a fully-covered step.
+            for m in members:
+                mpath = os.path.join(outdir, f"samples.{m}")
+                while True:
+                    done = False
+                    try:
+                        with open(mpath, encoding="utf-8") as f:
+                            done = any(
+                                ln.split() and ln.split()[0] == str(step)
+                                for ln in f)
+                    except OSError:
+                        pass
+                    if done:
+                        break
+                    if time.monotonic() > deadline:
+                        print(f"chief wedged waiting for member {m} "
+                              f"step {step}", file=sys.stderr)
+                        return 2
+                    time.sleep(0.02)
+            with open(loss_path, "a", encoding="utf-8") as f:
+                f.write(f"{step} {loss:.12g}\n")
+            _write_ckpt(ckpt_path, step, loss)
+        deadline = time.monotonic() + 120.0
+    with open(os.path.join(outdir, f"result.{ident}"), "w",
+              encoding="utf-8") as f:
+        f.write(f"{total} {loss:.12g}\n")
+    return 0
+
+
+if __name__ == "__main__":
+    # os._exit for the same reason as the TERM handler: a clean exit 0
+    # must not be corrupted into 134 by XLA's C++ teardown race.
+    os._exit(main())

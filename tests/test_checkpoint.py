@@ -269,6 +269,78 @@ def test_async_saves_get_manifests_on_wait(tmp_path):
         mgr.close()
 
 
+# ---------------------------------------------------------------------------
+# Reshard-on-restore: a manifest saved at one mesh shape restored onto
+# another (the elastic shrink/grow path — coordinator/elastic.py)
+# ---------------------------------------------------------------------------
+def _mesh_dp_tp(dp, tp):
+    from jax.sharding import Mesh
+
+    import numpy as _np
+
+    devs = _np.asarray(jax.devices()[:dp * tp]).reshape(dp, tp)
+    return Mesh(devs, ("dp", "tp"))
+
+
+def _sharded_tree(mesh, scale=1.0):
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    w = jnp.arange(4 * 12, dtype=jnp.float32).reshape(4, 12) * scale
+    b = jnp.arange(12, dtype=jnp.float32) * scale
+    return {
+        "w": jax.device_put(w, NamedSharding(mesh, P("dp", "tp"))),
+        "b": jax.device_put(b, NamedSharding(mesh, P("tp"))),
+        "step": jax.device_put(jnp.asarray(7, jnp.int32),
+                               NamedSharding(mesh, P())),
+    }
+
+
+@pytest.mark.parametrize("dp,tp", [(2, 4), (2, 3), (2, 2)])
+def test_reshard_on_restore_matrix(tmp_path, dp, tp):
+    """THE elastic resharding contract: state saved at mesh (2,4) loads
+    bitwise-identically into (2,3)/(2,2)/(2,4) layouts — params land on
+    the new mesh's shardings, and the manifest's saved-mesh note makes
+    the cross-shape restore observable."""
+    src_mesh = _mesh_dp_tp(2, 4)
+    tree = _sharded_tree(src_mesh)
+    with CheckpointManager(str(tmp_path / "c"), async_save=False) as mgr:
+        assert mgr.save(7, tree, force=True, mesh=src_mesh)
+        mgr.wait()
+        assert mgr.saved_mesh_shape(7) == {"dp": 2, "tp": 4}
+        dst_mesh = _mesh_dp_tp(dp, tp)
+        like = _sharded_tree(dst_mesh, scale=0.0)   # target shardings
+        restored = mgr.restore(7, like, mesh=dst_mesh)
+        if (dp, tp) == (2, 4):
+            assert mgr.last_restore_resharded is None
+        else:
+            assert mgr.last_restore_resharded == (
+                {"dp": 2, "tp": 4}, {"dp": dp, "tp": tp})
+        for key in ("w", "b", "step"):
+            # gather and compare bitwise against the source values
+            np.testing.assert_array_equal(np.asarray(restored[key]),
+                                          np.asarray(tree[key]))
+            assert restored[key].sharding == like[key].sharding
+
+
+def test_reshard_in_memory_helper():
+    """parallel.sharding.reshard: re-lay live state onto a smaller
+    mesh's shardings without a round-trip through disk."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    from tony_tpu.parallel.sharding import reshard
+
+    src = _mesh_dp_tp(2, 4)
+    dst = _mesh_dp_tp(2, 2)
+    tree = _sharded_tree(src)
+    sh = {"w": NamedSharding(dst, P("dp", "tp")),
+          "b": NamedSharding(dst, P("tp")),
+          "step": NamedSharding(dst, P())}
+    out = reshard(tree, sh)
+    for key in ("w", "b", "step"):
+        np.testing.assert_array_equal(np.asarray(out[key]),
+                                      np.asarray(tree[key]))
+        assert out[key].sharding == sh[key]
+
+
 def test_checkpoint_save_fault_site(tmp_path):
     from tony_tpu import faults
 

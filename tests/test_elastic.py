@@ -1,0 +1,329 @@
+"""Elastic gang resizing units (coordinator/elastic.py + the membership
+model in session/journal/data): absorb policy, drain→remesh→barrier op
+state, membership-generation fencing, journal replay of mid-resize
+crashes, dense-rank re-splitting. The live drills are in
+tests/test_e2e_elastic.py (slow)."""
+
+import json
+import os
+
+import pytest
+
+from tony_tpu import faults
+from tony_tpu.conf import keys as K
+from tony_tpu.conf.config import TonyTpuConfig
+from tony_tpu.coordinator import journal
+from tony_tpu.coordinator.elastic import (BARRIER, DRAIN, ElasticManager,
+                                          ResizeRefused)
+from tony_tpu.coordinator.session import Session, TaskStatus
+
+pytestmark = pytest.mark.faults
+
+
+def _conf(workers=8, **overrides):
+    conf = TonyTpuConfig()
+    conf.set("tony.worker.instances", workers)
+    conf.set(K.ELASTIC_ENABLED, True)
+    conf.set(K.ELASTIC_MIN_TASKS, 2)
+    for k, v in overrides.items():
+        conf.set(k, v)
+    return conf
+
+
+def _session(conf, registered=True):
+    s = Session(conf)
+    if registered:
+        for t in s.all_tasks():
+            s.register_worker(t.task_id, "h", 1000 + t.index)
+    return s
+
+
+def _manager(conf, now=None):
+    clock = {"t": 0.0}
+
+    def now_fn():
+        return clock["t"]
+
+    el = ElasticManager(conf, now_fn=now_fn)
+    el.established = True
+    return el, clock
+
+
+# ---------------------------------------------------------------------------
+# Session membership model
+# ---------------------------------------------------------------------------
+def test_resize_job_shrink_keeps_survivor_indices_sparse():
+    conf = _conf(workers=8)
+    s = _session(conf)
+    # hosts 3 and 4 died
+    for i in (3, 4):
+        s.tasks[f"worker:{i}"].status = TaskStatus.KILLED
+    members = [0, 1, 2, 5, 6, 7]
+    fresh = s.resize_job("worker", members)
+    assert fresh == []                       # all members survive
+    assert s.members("worker") == members    # sparse, identity-stable
+    assert s.jobs["worker"].instances == 6
+    # cluster spec lists members in DENSE-RANK order: position == rank
+    spec = s.get_cluster_spec()
+    assert spec["worker"] == [f"h:{1000 + i}" for i in members]
+
+
+def test_resize_job_replaces_terminal_member_with_fresh_task():
+    conf = _conf(workers=4)
+    s = _session(conf)
+    s.tasks["worker:2"].status = TaskStatus.KILLED
+    fresh = s.resize_job("worker", [0, 1, 2, 3])
+    assert [t.task_id for t in fresh] == ["worker:2"]
+    assert s.tasks["worker:2"].status == TaskStatus.NEW
+    assert not s.tasks["worker:2"].registered
+
+
+def test_resize_job_grow_back_adds_new_tasks():
+    conf = _conf(workers=8)
+    s = _session(conf)
+    s.resize_job("worker", [0, 1, 2, 5, 6, 7])
+    fresh = s.resize_job("worker", [0, 1, 2, 3, 4, 5, 6, 7])
+    assert sorted(t.index for t in fresh) == [3, 4]
+    assert s.jobs["worker"].instances == 8
+
+
+# ---------------------------------------------------------------------------
+# Absorb policy
+# ---------------------------------------------------------------------------
+def test_may_absorb_infra_loss_of_nonchief_member():
+    conf = _conf(workers=8)
+    el, _ = _manager(conf)
+    s = _session(conf)
+    t = s.tasks["worker:3"]
+    assert el.may_absorb(t, "INFRA_TRANSIENT", s)
+    assert el.may_absorb(t, "PREEMPTION", s)
+
+
+def test_absorb_refused_for_chief_user_error_and_below_min():
+    conf = _conf(workers=8)
+    el, _ = _manager(conf)
+    s = _session(conf)
+    # chief (worker:0) is never absorbable
+    assert not el.may_absorb(s.tasks["worker:0"], "INFRA_TRANSIENT", s)
+    # a deterministic user crash must not silently shrink the gang
+    assert not el.may_absorb(s.tasks["worker:3"], "USER_ERROR", s)
+    # below min-tasks: refuse (min 2, only 2 live post-loss of a 3-gang)
+    small = _session(_conf(workers=2))
+    assert not el.may_absorb(small.tasks["worker:1"],
+                             "INFRA_TRANSIENT", small)
+    # not established yet → ordinary rendezvous failure
+    el2 = ElasticManager(conf)
+    assert not el2.may_absorb(s.tasks["worker:3"], "INFRA_TRANSIENT", s)
+    # disabled entirely
+    off = ElasticManager(TonyTpuConfig())
+    off.established = True
+    assert not off.may_absorb(s.tasks["worker:3"], "INFRA_TRANSIENT", s)
+
+
+# ---------------------------------------------------------------------------
+# The resize op: drain → remesh → barrier
+# ---------------------------------------------------------------------------
+def test_op_drain_ack_and_directives():
+    conf = _conf(workers=4)
+    el, _ = _manager(conf)
+    s = _session(conf)
+    s.tasks["worker:3"].status = TaskStatus.KILLED
+    live = [t for t in s.all_tasks() if not t.status.terminal]
+    op = el.begin([0, 1, 2], live, "lost worker:3")
+    assert el.resizing and op.mgen == 2 and op.phase == DRAIN
+    assert op.awaiting == {"worker:0", "worker:1", "worker:2"}
+    # directives re-sent every beat while draining, deduped by mgen
+    d = el.directive_for("worker:1")
+    assert d["action"] == "drain" and d["mgen"] == 2
+    assert d["members"] == [0, 1, 2]
+    assert el.directive_for("worker:1")["mgen"] == 2   # re-sent
+    assert el.directive_for("worker:3") is None        # not a participant
+    assert not el.drain_complete
+    for tid in ("worker:0", "worker:1", "worker:2"):
+        assert el.ack_registration(tid, 2)
+    assert el.drain_complete
+    el.mark_remeshed()
+    assert el.op.phase == BARRIER
+    assert el.directive_for("worker:0") is None        # drain is over
+    done = el.finish()
+    assert done.mgen == 2 and not el.resizing
+
+
+def test_second_loss_mid_drain_supersedes_with_smaller_membership():
+    conf = _conf(workers=4)
+    el, _ = _manager(conf)
+    s = _session(conf)
+    live = [t for t in s.all_tasks()]
+    op1 = el.begin([0, 1, 2], live, "lost worker:3")
+    assert el.ack_registration("worker:1", op1.mgen)
+    # worker:2 dies during the drain → supersede (mgen bumps again);
+    # the parked worker:1 must re-park under the NEW generation.
+    s.tasks["worker:2"].status = TaskStatus.KILLED
+    assert el.may_absorb(s.tasks["worker:2"], "INFRA_TRANSIENT", s)
+    live2 = [t for t in s.all_tasks() if not t.status.terminal]
+    op2 = el.begin([0, 1], live2, "lost worker:2 mid-drain")
+    assert op2.mgen == op1.mgen + 1
+    assert op2.started == op1.started      # one bounded disturbance
+    assert op2.awaiting == {"worker:0", "worker:1"}
+    assert not el.ack_registration("worker:1", op1.mgen)  # stale mgen
+    assert el.ack_registration("worker:1", op2.mgen)
+    # a release directive goes to live non-members
+    s2 = _session(_conf(workers=4))
+    el2, _ = _manager(_conf(workers=4))
+    el2.begin([0, 1], s2.all_tasks(), "operator shrink")
+    assert el2.directive_for("worker:3")["action"] == "release"
+    assert el2.is_released("worker:3")
+
+
+def test_release_ack_via_note_task_gone_and_timeout():
+    conf = _conf(workers=3)
+    el, clock = _manager(conf)
+    s = _session(conf)
+    el.begin([0, 1], s.all_tasks(), "shrink")
+    el.note_task_gone("worker:2")
+    assert not el.is_released("worker:2")
+    assert not el.timed_out()
+    clock["t"] += el.barrier_timeout_s + 1
+    assert el.timed_out()
+    el.abandon()
+    assert not el.resizing
+
+
+def test_plan_explicit_shrinks_high_indices_and_grows_lowest_free():
+    conf = _conf(workers=8)
+    el, _ = _manager(conf)
+    s = _session(conf)
+    assert el.plan_explicit(6, s) == [0, 1, 2, 3, 4, 5]
+    with pytest.raises(ResizeRefused):
+        el.plan_explicit(1, s)               # below min-tasks (2)
+    with pytest.raises(ResizeRefused):
+        el.plan_explicit(8, s)               # already at 8
+    s.resize_job("worker", [0, 1, 2, 5, 6, 7])
+    assert el.plan_explicit(8, s) == [0, 1, 2, 3, 4, 5, 6, 7]
+    el.begin([0, 1], s.all_tasks(), "x")
+    with pytest.raises(ResizeRefused):       # one op at a time
+        el.plan_explicit(4, s)
+
+
+def test_plan_explicit_refused_when_disabled_or_unestablished():
+    off = ElasticManager(TonyTpuConfig())
+    with pytest.raises(ResizeRefused):
+        off.plan_explicit(2, _session(_conf(workers=4)))
+    el = ElasticManager(_conf(workers=4))
+    with pytest.raises(ResizeRefused):       # never established
+        el.plan_explicit(2, _session(_conf(workers=4)))
+
+
+# ---------------------------------------------------------------------------
+# Membership-generation fencing
+# ---------------------------------------------------------------------------
+def test_fencing_semantics():
+    conf = _conf(workers=4)
+    el, _ = _manager(conf)
+    # unknown task (removed by a shrink) is ALWAYS fenced
+    assert el.fences_frame(False, 1)
+    # pre-elastic caller (-1) is compat-accepted
+    assert el.fences_frame(True, -1) is None
+    # current generation accepted
+    assert el.fences_frame(True, el.mgen) is None
+    # stale generation with no resize in flight → fenced
+    el.mgen = 3
+    assert el.fences_frame(True, 1)
+    # ...but EXPECTED while a resize runs (the directive may be in flight)
+    s = _session(conf)
+    el.begin([0, 1, 2], s.all_tasks(), "x")
+    assert el.fences_frame(True, 1) is None
+
+
+# ---------------------------------------------------------------------------
+# Journal: resize records and mid-resize replay
+# ---------------------------------------------------------------------------
+def _replay_records(tmp_path, recs):
+    path = os.path.join(str(tmp_path), "j.jsonl")
+    with open(path, "w") as f:
+        for r in recs:
+            f.write(json.dumps(r) + "\n")
+    return journal.replay(path)
+
+
+def test_replay_applied_resize_prunes_removed_tasks(tmp_path):
+    st = _replay_records(tmp_path, [
+        {"t": "gen", "generation": 1},
+        {"t": "epoch", "session": 0, "infra_used": 0, "preempt_used": 0},
+        {"t": "job_scheduled", "job": "worker", "session": 0},
+        *[{"t": "register", "task": f"worker:{i}", "host": "h",
+           "port": 1000 + i, "session": 0} for i in range(4)],
+        {"t": "resize", "job": "worker", "mgen": 2,
+         "members": [0, 1, 3], "phase": "start", "session": 0,
+         "reason": "lost worker:2"},
+        {"t": "resize", "job": "worker", "mgen": 2,
+         "members": [0, 1, 3], "phase": "applied", "session": 0},
+    ])
+    assert st.elastic_mgen == 2
+    assert st.applied_members == {"worker": [0, 1, 3]}
+    assert st.inflight_job == ""             # applied completes the start
+    assert "worker:2" not in st.tasks
+    assert set(st.tasks) == {"worker:0", "worker:1", "worker:3"}
+
+
+def test_replay_inflight_resize_survives_crash(tmp_path):
+    st = _replay_records(tmp_path, [
+        {"t": "gen", "generation": 1},
+        {"t": "epoch", "session": 0, "infra_used": 0, "preempt_used": 0},
+        {"t": "resize", "job": "worker", "mgen": 2, "members": [0, 1],
+         "phase": "start", "session": 0, "reason": "lost worker:2"},
+    ])
+    assert st.inflight_job == "worker"
+    assert st.inflight_mgen == 2
+    assert st.inflight_members == [0, 1]
+    assert "lost worker:2" in st.inflight_reason
+
+
+def test_replay_epoch_clears_membership_but_not_mgen(tmp_path):
+    st = _replay_records(tmp_path, [
+        {"t": "gen", "generation": 1},
+        {"t": "epoch", "session": 0, "infra_used": 0, "preempt_used": 0},
+        {"t": "resize", "job": "worker", "mgen": 3, "members": [0, 1],
+         "phase": "applied", "session": 0},
+        {"t": "epoch", "session": 1, "infra_used": 1, "preempt_used": 0},
+    ])
+    assert st.elastic_mgen == 3              # fences stay monotonic
+    assert st.applied_members == {}          # new epoch = configured size
+    assert st.inflight_job == ""
+
+
+# ---------------------------------------------------------------------------
+# Satellites: fault sites, conf keys, data re-split
+# ---------------------------------------------------------------------------
+def test_new_fault_sites_registered_and_parse():
+    for site in ("host.loss", "resize.barrier", "resize.remesh"):
+        assert site in faults.SITES
+        assert K.fault_key(site) in K.registry()
+    inj = faults.FaultInjector({"host.loss": "after:2,task:worker:3"})
+    rule = inj.rules["host.loss"]
+    assert rule.after == 2 and rule.task == "worker:3"
+
+
+def test_process_batch_slice_explicit_rank_world():
+    from tony_tpu.data import process_batch_slice
+
+    # the elastic re-split: same 24-row global batch at worlds 8 and 6
+    rows8 = [process_batch_slice(24, rank=r, world=8) for r in range(8)]
+    rows6 = [process_batch_slice(24, rank=r, world=6) for r in range(6)]
+    for rows in (rows8, rows6):
+        covered = [i for s in rows for i in range(s.start, s.stop)]
+        assert covered == list(range(24))    # exact tile, no dup, no gap
+    with pytest.raises(ValueError):
+        process_batch_slice(24, rank=6, world=6)
+    with pytest.raises(ValueError):
+        process_batch_slice(25, rank=0, world=6)
+
+
+def test_mesh_respec_keeps_model_axes():
+    from tony_tpu.parallel.mesh import MeshSpec
+
+    spec = MeshSpec(dp=2, tp=4).resolve(8)
+    smaller = spec.respec(4)
+    assert smaller.tp == 4 and smaller.dp == 1
+    with pytest.raises(ValueError):
+        spec.respec(6)                       # 6 not divisible by tp=4

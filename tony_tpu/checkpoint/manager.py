@@ -67,6 +67,13 @@ class CheckpointManager:
         # Steps saved but not yet checksummed: manifests are written only
         # once the (async) save is durable — wait()/close()/restore().
         self._pending_manifest: set = set()
+        # step → {axis: size} mesh shape noted at save time; lands in the
+        # step's manifest so restore can tell "same layout" from
+        # "reshard" (elastic resize: restore onto a different mesh).
+        self._mesh_note: Dict[int, Dict[str, int]] = {}
+        # (saved_shape, current_shape) of the last restore that crossed
+        # mesh shapes; None when the layouts matched (or were unknown).
+        self.last_restore_resharded: Optional[tuple] = None
         self._mgr = ocp.CheckpointManager(
             directory,
             options=ocp.CheckpointManagerOptions(
@@ -75,11 +82,26 @@ class CheckpointManager:
                 enable_async_checkpointing=async_save,
             ))
 
-    def save(self, step: int, state: Any, force: bool = False) -> bool:
+    @staticmethod
+    def _mesh_shape(mesh: Any) -> Optional[Dict[str, int]]:
+        """{axis: size} of a jax Mesh (or an already-shaped mapping)."""
+        if mesh is None:
+            return None
+        shape = getattr(mesh, "shape", mesh)
+        try:
+            return {str(k): int(v) for k, v in dict(shape).items()}
+        except (TypeError, ValueError):
+            return None
+
+    def save(self, step: int, state: Any, force: bool = False,
+             mesh: Any = None) -> bool:
         """Queue an (async) save; returns False when skipped by the
         save_interval_steps policy. Every accepted step is registered for
         a manifest, written once the save is durable (wait/close/next
-        restore — async writes must never be checksummed mid-flight)."""
+        restore — async writes must never be checksummed mid-flight).
+        ``mesh`` (optional) notes the device-mesh shape in the manifest
+        so a restore onto a DIFFERENT mesh — the elastic shrink/grow
+        path — is detected and logged as a reshard."""
         faults.check("checkpoint.save")
         self._busy = True
         try:
@@ -91,6 +113,9 @@ class CheckpointManager:
             self._run_deferred_preemption()
         if saved:
             self._pending_manifest.add(int(step))
+            shape = self._mesh_shape(mesh)
+            if shape:
+                self._mesh_note[int(step)] = shape
         return saved
 
     # -- integrity ------------------------------------------------------
@@ -123,14 +148,16 @@ class CheckpointManager:
             p = os.path.join(root, rel.replace("/", os.sep))
             files[rel] = {"sha256": _hash_file(p),
                           "size": os.path.getsize(p)}
+        doc: Dict[str, Any] = {"step": int(step), "files": files}
+        if step in self._mesh_note:
+            doc["mesh"] = self._mesh_note[step]
         # The manifest is the verified-restore contract: it must never be
         # adoptable half-written, and it must survive the host crash that
         # the restore is for — full atomic_write discipline.
         from tony_tpu.utils.durable import atomic_write
 
         atomic_write(os.path.join(root, MANIFEST_NAME),
-                     json.dumps({"step": int(step), "files": files},
-                                sort_keys=True).encode("utf-8"))
+                     json.dumps(doc, sort_keys=True).encode("utf-8"))
 
     def _flush_manifests(self) -> None:
         """Write manifests for every step whose save is now durable.
@@ -186,12 +213,50 @@ class CheckpointManager:
                 return int(step)
         return None
 
+    def saved_mesh_shape(self, step: int) -> Optional[Dict[str, int]]:
+        """The {axis: size} mesh shape noted in a step's manifest at save
+        time (None: no manifest, or saved by a build/caller that noted
+        none)."""
+        try:
+            with open(self.manifest_path(step), encoding="utf-8") as f:
+                shape = json.load(f).get("mesh")
+        except (OSError, ValueError):
+            return None
+        if not isinstance(shape, dict):
+            return None
+        try:
+            return {str(k): int(v) for k, v in shape.items()}
+        except (TypeError, ValueError):
+            return None
+
+    def _note_reshard(self, step: int, mesh: Any) -> None:
+        """Record whether this restore crossed mesh shapes (elastic
+        resize: a manifest saved at (dp=2,tp=4) restored onto
+        (dp=2,tp=3)). The re-layout itself is orbax's StandardRestore
+        honouring the target shardings — this is the observable."""
+        self.last_restore_resharded = None
+        current = self._mesh_shape(mesh)
+        if current is None:
+            return
+        saved = self.saved_mesh_shape(step)
+        if saved is None:
+            return
+        if saved != current:
+            self.last_restore_resharded = (saved, current)
+            log.warning(
+                "checkpoint step %d: resharding on restore — saved at "
+                "mesh %s, restoring onto %s (elastic re-mesh)", step,
+                saved, current)
+
     def restore(self, step: Optional[int], like: Any,
-                verify: bool = True) -> Any:
+                verify: bool = True, mesh: Any = None) -> Any:
         """Restore ``step`` (or the newest GOOD step when None) with the
         shardings of ``like`` — pass the freshly-initialized state (or an
         eval_shape of it with NamedSharding leaves) so every shard lands
-        on its device.
+        on its device. ``mesh`` (optional, the CURRENT mesh) is compared
+        against the shape noted in the step's manifest: a mismatch is
+        the elastic reshard-on-restore path, logged and recorded in
+        ``last_restore_resharded``.
 
         With ``step=None`` and ``verify`` (the default), candidates are
         tried newest-first: a step whose manifest verifies is restored; a
@@ -214,6 +279,7 @@ class CheckpointManager:
                 raise IOError(
                     f"checkpoint step {step} failed integrity "
                     f"verification ({self.manifest_path(step)})")
+            self._note_reshard(step, mesh)
             return self._mgr.restore(
                 step, args=self._ocp.args.StandardRestore(target))
         self.wait()          # flushes pending manifests too
@@ -241,6 +307,7 @@ class CheckpointManager:
                                 cand, e)
                 continue
             try:
+                self._note_reshard(cand, mesh)
                 out = self._mgr.restore(
                     cand, args=self._ocp.args.StandardRestore(target))
                 if cand != candidates[0]:

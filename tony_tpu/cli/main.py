@@ -180,6 +180,39 @@ def _coordinator_rpc(app_id: str, workdir: Optional[str]):
                      max_retries=2, retry_sleep_s=0.5, tls=tls)
 
 
+def _cmd_resize(args: argparse.Namespace) -> int:
+    """Elastic resize of a RUNNING job's gang (coordinator/elastic.py):
+    shrink drains the survivors at a step barrier and re-meshes —
+    releasing the highest indices — grow re-admits members through the
+    same barrier. Requires tony.elastic.enabled on the job; refused
+    below tony.elastic.min-tasks."""
+    rpc = _coordinator_rpc(args.app_id, args.workdir)
+    if rpc is None:
+        print(f"no coordinator address for {args.app_id} under "
+              f"{_default_workdir(args.workdir)} (job finished? wrong "
+              f"--workdir?) — resize needs a live job", file=sys.stderr)
+        return 1
+    try:
+        res = rpc.call("resize_application", size=args.size,
+                       job=args.job or "")
+    except Exception as e:  # noqa: BLE001
+        print(f"resize failed (coordinator gone?): {e}", file=sys.stderr)
+        return 1
+    finally:
+        rpc.close()
+    if not isinstance(res, dict) or not res.get("ok"):
+        msg = res.get("message", "refused") if isinstance(res, dict) \
+            else str(res)
+        print(f"resize refused: {msg}", file=sys.stderr)
+        return 1
+    print(res.get("message", "resize accepted"))
+    print(f"members: {res.get('members')}")
+    print(f"watch it land with `tony-tpu top {args.app_id}` "
+          f"(gang=/mgen= columns) or `tony-tpu events {args.app_id}` "
+          f"(GANG_RESIZED)")
+    return 0
+
+
 def _cmd_status(args: argparse.Namespace) -> int:
     """Live application report from a running job's coordinator
     (reference: the client's status poll surface, ``TonyClient.java:838``;
@@ -198,6 +231,19 @@ def _cmd_status(args: argparse.Namespace) -> int:
             if report.get("recovered"):
                 print(f"recovered: yes (coordinator generation "
                       f"{report.get('generation', '?')})")
+            gang = report.get("gang_size") or {}
+            if gang:
+                sizes = "  ".join(f"{j}×{n}"
+                                  for j, n in sorted(gang.items()))
+                el = report.get("elastic") or {}
+                suffix = ""
+                if el:
+                    suffix = f"  (mgen {el.get('mgen', '?')}"
+                    if el.get("resizing"):
+                        suffix += (f", RESIZING to "
+                                   f"{el.get('target_size', '?')}")
+                    suffix += ")"
+                print(f"gang:     {sizes}{suffix}")
             if report.get("failure_reason"):
                 print(f"reason:   {report['failure_reason']}")
             if report.get("failure_domain"):
@@ -297,9 +343,17 @@ def _render_top(snap: dict) -> str:
     """One frame of the `tony-tpu top` live view from a metrics.live
     snapshot: per-task utilization + heartbeat age + a steps/s sparkline
     (the coordinator's ring-buffer series)."""
+    gang = snap.get("gang_size") or {}
+    gang_col = "  gang=" + ",".join(
+        f"{j}×{n}" for j, n in sorted(gang.items())) if gang else ""
+    el = snap.get("elastic") or {}
+    mgen_col = f"  mgen={el.get('mgen')}" if el else ""
+    if el.get("resizing"):
+        mgen_col += f" (resizing->{el.get('target_size', '?')})"
     lines = [f"{snap.get('app_id', '?')}  status={snap.get('status', '?')}"
              f"  epoch={snap.get('session_id', '?')}"
-             f"  generation={snap.get('generation', '?')}",
+             f"  generation={snap.get('generation', '?')}"
+             f"{gang_col}{mgen_col}",
              f"{'TASK':<14}{'STATUS':<11}{'STEPS':>8}{'STEPS/S':>9}"
              f"{'MFU':>7}{'HBM':>10}{'RSS':>10}{'HB AGE':>8}  "
              f"{'STATE':<11}TREND"]
@@ -836,6 +890,20 @@ def build_parser() -> argparse.ArgumentParser:
                     help="override tony.history.location from the frozen "
                          "config")
     rc.set_defaults(fn=_cmd_recover)
+
+    rz = sub.add_parser(
+        "resize",
+        help="elastically resize a running job's gang — shrink drains "
+             "and re-meshes without restarting (no burned epochs), grow "
+             "re-admits members live (tony.elastic.* keys)")
+    rz.add_argument("app_id")
+    rz.add_argument("size", type=int, help="new gang size")
+    rz.add_argument("--job", default="",
+                    help="jobtype to resize (default: the configured "
+                         "tony.elastic.jobtype)")
+    rz.add_argument("--workdir", help="client workdir the job was "
+                                      "submitted from (default ~/.tony-tpu)")
+    rz.set_defaults(fn=_cmd_resize)
 
     st = sub.add_parser("status",
                         help="live report for a running job (falls back "

@@ -327,6 +327,44 @@ TASK_STRAGGLER_RESTART = _key(
     "a fresh process/host beats a gang crawling at the straggler's "
     "pace. Leave off unless step rates are expected to be uniform.")
 
+# --- elastic gangs (coordinator/elastic.py) -------------------------------
+ELASTIC_ENABLED = _key(
+    "tony.elastic.enabled", False, bool,
+    "Elastic gang resizing: on host loss / preemption of a task of the "
+    "elastic jobtype (or an explicit `tony-tpu resize`), the coordinator "
+    "drains the survivors at a step barrier (a RESIZE directive rides the "
+    "heartbeat response; user processes checkpoint-and-park via their "
+    "save-on-SIGTERM handlers), re-meshes the gang at the new cardinality "
+    "under a bumped, fenced membership generation, and training continues "
+    "the SAME epoch from the last checkpoint — a bounded pause instead of "
+    "a restart-with-replay. Off (default): host loss fails the epoch into "
+    "the ordinary retry machinery.")
+ELASTIC_JOBTYPE = _key(
+    "tony.elastic.jobtype", "worker", str,
+    "The jobtype whose gang is elastic (exactly one; the chief member — "
+    "index 0 / the `chief` jobtype — is never shrunk away, and its loss "
+    "is NOT absorbable: chief failure keeps its fail-the-epoch policy).")
+ELASTIC_MIN_TASKS = _key(
+    "tony.elastic.min-tasks", 1, int,
+    "Floor on the elastic gang's size: a shrink (host-loss absorption or "
+    "explicit resize) below this is refused — the loss then falls through "
+    "to the ordinary failure-domain retry machinery. Size it to the "
+    "smallest gang whose per-task memory still fits the resharded model.")
+ELASTIC_DRAIN_GRACE_S = _key(
+    "tony.elastic.drain-grace-s", 15, int,
+    "TERM→KILL window for draining a survivor's user process at a resize: "
+    "the save-on-SIGTERM handler (checkpoint/manager.py "
+    "install_preemption_handler) gets this long to make its final save "
+    "durable before the executor escalates. Exported to executors as "
+    "the user-process kill grace for resize drains.")
+ELASTIC_BARRIER_TIMEOUT_S = _key(
+    "tony.elastic.barrier-timeout-s", 120, int,
+    "Bound on a whole resize operation: drain of the survivors plus the "
+    "re-registration barrier at the new cardinality. A resize that "
+    "cannot complete inside this window fails the epoch INFRA_TRANSIENT "
+    "into the ordinary retry machinery (which relaunches at the "
+    "configured size) — a stuck resize must not hang the job forever.")
+
 # --- tracing / live metrics (tony_tpu/tracing.py, tony_tpu/metrics.py) ---
 TRACE_ENABLED = _key(
     "tony.trace.enabled", True, bool,
@@ -575,6 +613,25 @@ FAULT_POOL_ADOPT = _key(
     "Kill a granted lease at adoption time (leased executor dead before "
     "the task starts); the backend discards the lease at the daemon — "
     "a dirty lease is never reused — and cold-spawns.")
+FAULT_HOST_LOSS = _key(
+    "tony.fault.host-loss", "", str,
+    "Simulate sudden host death from inside the executor: a firing "
+    "SIGKILLs the user process group and hard-exits the executor "
+    "(os._exit 137) — everything on the 'host' dies at once, the shape "
+    "elastic shrink-and-continue must absorb. The call counter is "
+    "heartbeats, so 'task:worker:2,after:20' kills one virtual host a "
+    "deterministic ~20 beats in.")
+FAULT_RESIZE_BARRIER = _key(
+    "tony.fault.resize-barrier", "", str,
+    "Fail the post-remesh re-registration barrier of an elastic resize "
+    "(checked once per resize, right after the new topology is applied): "
+    "the resize aborts into an INFRA_TRANSIENT epoch failure — the "
+    "ordinary retry machinery relaunches at the configured size.")
+FAULT_RESIZE_REMESH = _key(
+    "tony.fault.resize-remesh", "", str,
+    "Fail the application of an elastic resize's new topology (checked "
+    "once per resize, before the member set is rebuilt): the resize "
+    "aborts into an INFRA_TRANSIENT epoch failure.")
 
 # --- warm executor pool (tony_tpu/pool.py) --------------------------------
 POOL_DIR = _key(
@@ -695,7 +752,7 @@ _JOB_KEY_RE: Pattern[str] = re.compile(
 _RESERVED_NON_JOB_SEGMENTS = {
     "application", "task", "coordinator", "client", "history", "tpu", "portal",
     "keep-failed-task-dirs", "internal", "fault", "rpc", "trace", "metrics",
-    "diagnosis", "pool",
+    "diagnosis", "pool", "elastic",
 }
 
 

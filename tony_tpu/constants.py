@@ -24,6 +24,17 @@ METRICS_PORT = "TONY_METRICS_PORT"    # metrics RPC port on the coordinator
 # Coordinator generation this executor was launched under (crash-recovery
 # fencing, rpc/wire.py): adopted upward on reconnect, rejected downward.
 COORDINATOR_GENERATION = "TONY_COORDINATOR_GENERATION"
+# Membership generation of the gang topology this executor was launched
+# under (elastic resize fencing, coordinator/elastic.py): bumped on every
+# applied resize; survivors adopt the new value from the RESIZE directive
+# riding the heartbeat response, and frames carrying a stale value with no
+# resize in flight are fenced — a zombie from a pre-resize topology must
+# not corrupt the re-meshed gang.
+MEMBERSHIP_GEN = "TONY_MEMBERSHIP_GEN"
+# Sorted member indices of this executor's jobtype gang at launch/adoption
+# time (comma-separated), exported to the user process so elastic-aware
+# training loops can map their stable task index to a dense rank.
+GANG_MEMBERS = "TONY_GANG_MEMBERS"
 # Path to the coordinator's address file (host/port/token JSON). Executors
 # re-resolve the coordinator from it after a restart (the recovered
 # coordinator binds a fresh ephemeral port and rewrites the file); only

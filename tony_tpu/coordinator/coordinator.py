@@ -35,6 +35,8 @@ from tony_tpu.metrics import MetricsRegistry
 from tony_tpu.conf.config import TonyTpuConfig
 from tony_tpu.conf import keys as K
 from tony_tpu.coordinator import journal, liveness
+from tony_tpu.coordinator.elastic import (BARRIER, DRAIN, ElasticManager,
+                                          ResizeRefused)
 from tony_tpu.coordinator.journal import SessionJournal
 from tony_tpu.coordinator.liveness import ProgressTracker
 from tony_tpu.coordinator.scheduler import GangScheduler
@@ -72,9 +74,11 @@ class _RpcService:
     # the standalone methods.
 
     def register_worker_spec(self, task_id: str, host: str, port: int,
-                             session_id: int = -1) -> Optional[dict]:
+                             session_id: int = -1,
+                             mgen: int = -1) -> Optional[dict]:
         return self._c.register_worker_spec(task_id, host, port,
-                                            session_id=session_id)
+                                            session_id=session_id,
+                                            mgen=mgen)
 
     def register_tensorboard_url(self, task_id: str, url: str) -> bool:
         return self._c.register_tensorboard_url(task_id, url)
@@ -91,9 +95,14 @@ class _RpcService:
         return self._c.final_status.value
 
     def task_executor_heartbeat(self, task_id: str, session_id: int = -1,
-                                progress: Optional[dict] = None):
+                                progress: Optional[dict] = None,
+                                mgen: int = -1):
         return self._c.heartbeat(task_id, session_id=session_id,
-                                 progress=progress)
+                                 progress=progress, mgen=mgen)
+
+    def resize_application(self, size: int, job: str = "") -> dict:
+        """Operator-initiated elastic resize (`tony-tpu resize`)."""
+        return self._c.resize_application(int(size), job=str(job or ""))
 
     def get_application_report(self) -> dict:
         return self._c.application_report()
@@ -155,9 +164,22 @@ class Coordinator:
         # every RPC frame (rpc/wire.py) — the split-brain fence.
         self.generation = (st.generation + 1) if st else 1
         self.session = Session(conf, session_id=st.session_id if st else 0)
+        # Elastic membership (coordinator/elastic.py): None when the knob
+        # is off — every elastic branch below is `self.elastic is not
+        # None` gated, so non-elastic jobs pay nothing.
+        self.elastic = ElasticManager(conf) \
+            if conf.get_bool(K.ELASTIC_ENABLED) else None
         if st is not None:
             for job_name in sorted(st.scheduled_jobs):
                 self.session.mark_job_scheduled(job_name)
+            if self.elastic is not None:
+                self.elastic.mgen = max(self.elastic.mgen,
+                                        st.elastic_mgen)
+                # The last APPLIED resize is the matrix the journal's
+                # task records describe — rebuild it before folding them.
+                for job_name, members in st.applied_members.items():
+                    if job_name in self.session.jobs:
+                        self.session.resize_job(job_name, members)
             for task_id, tr in st.tasks.items():
                 self.session.restore_task(
                     task_id, TaskStatus(tr.status),
@@ -435,6 +457,18 @@ class Coordinator:
         for status, n in counts.items():
             self.metrics.gauge("tony_tasks", {**app, "status": status},
                                help="Tasks by status.").set(n)
+        for name, job in self.session.jobs.items():
+            self.metrics.gauge(
+                "tony_gang_size", {**app, "job": name},
+                help="Current task count of the jobtype's gang — "
+                     "changes live on an elastic resize.").set(
+                job.instances)
+        if self.elastic is not None:
+            self.metrics.gauge(
+                "tony_membership_generation", app,
+                help="Elastic membership generation (bumps on every "
+                     "resize; the topology fence).").set(
+                self.elastic.mgen)
         text = self.metrics.render()
         try:
             durable.atomic_write(self._prom_path, text.encode("utf-8"))
@@ -474,9 +508,15 @@ class Coordinator:
             if last is not None:
                 row["heartbeat_age_s"] = round(now - last, 3)
             tasks.append(row)
-        return {"app_id": self.app_id, "generation": self.generation,
+        snap = {"app_id": self.app_id, "generation": self.generation,
                 "session_id": self.session.session_id,
-                "status": self.session.status.value, "tasks": tasks}
+                "status": self.session.status.value,
+                "gang_size": {name: job.instances
+                              for name, job in self.session.jobs.items()},
+                "tasks": tasks}
+        if self.elastic is not None:
+            snap["elastic"] = self.elastic.snapshot()
+        return snap
 
     def ingest_trace_records(self, records) -> int:
         return self.tracer.write_records(records)
@@ -522,6 +562,13 @@ class Coordinator:
             # Lets the executor RE-resolve a restarted coordinator (it
             # rewrites this file with its fresh ephemeral port).
             env[constants.COORDINATOR_ADDR_FILE] = self.addr_file
+        if self.elastic is not None:
+            # Topology fence: frames from this executor carry the
+            # membership generation it was launched under; survivors
+            # adopt newer generations from the RESIZE directive.
+            env[constants.MEMBERSHIP_GEN] = str(self.elastic.mgen)
+            env.setdefault(constants.TASK_KILL_GRACE_ENV,
+                           str(self.elastic.drain_grace_s))
         if self.tracer.enabled:
             # Trace context: the executor's spans parent under this
             # task's lifecycle span, stitching one tree per job.
@@ -580,67 +627,100 @@ class Coordinator:
         return conf_url.rsplit("/", 1)[0] + "/profile"
 
     def _launch_job(self, job_name: str) -> None:
-        job = self.session.jobs[job_name]
         # Widen the rendezvous barrier to this gang BEFORE any instance can
         # register, so a fast first instance never sees a spec missing its
         # peers (reference adds numExpectedTasks at schedule time,
         # ``TonySession.addNumExpectedTask`` :197).
         self.session.mark_job_scheduled(job_name)
         self.journal.job_scheduled(job_name, self.session.session_id)
-        for i in range(job.instances):
+        for i in self.session.members(job_name):
             task = self.session.get_task(f"{job_name}:{i}")
             if task is None or task.status != TaskStatus.NEW:
                 continue
-            # Write-ahead: journal the SCHEDULED transition before the
-            # backend spawn. A crash in between recovers a task the
-            # journal says was launched but that never registers — the
-            # re-registration grace expires into a normal retry epoch,
-            # never a duplicate launch over a live executor.
-            self.journal.task(task.task_id, TaskStatus.SCHEDULED.value,
-                              self.session.session_id)
-            # Lifecycle span opens BEFORE the env is built so the
-            # executor inherits it as its trace parent.
-            if task.task_id not in self._task_spans:
-                self._task_spans[task.task_id] = self.tracer.start_span(
-                    "task.lifecycle", parent=self._epoch_span,
-                    task=task.task_id, attrs={"job": job_name})
-            spec = TaskLaunchSpec(
-                task_id=task.task_id, job_name=job_name, index=i,
-                command=job.command, env=self._task_env(task),
-                vcores=job.vcores, memory=job.memory, chips=job.chips,
-                node_pool=job.node_pool, docker_image=job.docker_image)
-            try:
-                task.handle = self.backend.launch_task(spec)
-            except Exception as e:  # noqa: BLE001 — e.g. SliceProvisionError
-                # An unlaunchable gang is an INFRA_TRANSIENT session
-                # failure (subject to the normal retry budget), not a
-                # coordinator crash — the analogue of an unserviceable
-                # container request.
-                log.error("launch of %s failed: %s", task.task_id, e)
-                self._end_task_span(task.task_id, error=str(e))
-                self.session.fail(f"launch of {task.task_id} failed: {e}",
-                                  FailureDomain.INFRA_TRANSIENT)
+            if not self._launch_task(task):
                 return
-            # Each gang launch restarts the registration-timeout clock; the
-            # timeout gates on launched-but-unregistered tasks (scoped like
-            # the barrier), so a long-running earlier DAG stage can't trip it.
-            self._schedule_start = time.monotonic()
-            task.status = TaskStatus.SCHEDULED
-            self.events.emit(Event(EventType.TASK_STARTED, {
-                "task": task.task_id, "session_id": self.session.session_id}))
+
+    def _launch_task(self, task: Task) -> bool:
+        """Launch ONE task (gang launch and elastic relaunch/grow share
+        this path). Returns False when the backend spawn failed and the
+        session was failed INFRA_TRANSIENT."""
+        job = self.session.jobs[task.job_name]
+        # Write-ahead: journal the SCHEDULED transition before the
+        # backend spawn. A crash in between recovers a task the
+        # journal says was launched but that never registers — the
+        # re-registration grace expires into a normal retry epoch,
+        # never a duplicate launch over a live executor.
+        self.journal.task(task.task_id, TaskStatus.SCHEDULED.value,
+                          self.session.session_id)
+        # Lifecycle span opens BEFORE the env is built so the
+        # executor inherits it as its trace parent.
+        if task.task_id not in self._task_spans:
+            self._task_spans[task.task_id] = self.tracer.start_span(
+                "task.lifecycle", parent=self._epoch_span,
+                task=task.task_id, attrs={"job": task.job_name})
+        spec = TaskLaunchSpec(
+            task_id=task.task_id, job_name=task.job_name, index=task.index,
+            command=job.command, env=self._task_env(task),
+            vcores=job.vcores, memory=job.memory, chips=job.chips,
+            node_pool=job.node_pool, docker_image=job.docker_image)
+        try:
+            task.handle = self.backend.launch_task(spec)
+        except Exception as e:  # noqa: BLE001 — e.g. SliceProvisionError
+            # An unlaunchable gang is an INFRA_TRANSIENT session
+            # failure (subject to the normal retry budget), not a
+            # coordinator crash — the analogue of an unserviceable
+            # container request.
+            log.error("launch of %s failed: %s", task.task_id, e)
+            self._end_task_span(task.task_id, error=str(e))
+            self.session.fail(f"launch of {task.task_id} failed: {e}",
+                              FailureDomain.INFRA_TRANSIENT)
+            return False
+        # Each gang launch restarts the registration-timeout clock; the
+        # timeout gates on launched-but-unregistered tasks (scoped like
+        # the barrier), so a long-running earlier DAG stage can't trip it.
+        self._schedule_start = time.monotonic()
+        task.status = TaskStatus.SCHEDULED
+        self.events.emit(Event(EventType.TASK_STARTED, {
+            "task": task.task_id, "session_id": self.session.session_id}))
+        return True
 
     # ------------------------------------------------------------------
     # RPC-surface behaviour
     # ------------------------------------------------------------------
+    def _check_membership(self, task_id: str, mgen,
+                          for_register: bool = False) -> None:
+        """Topology fence (coordinator/elastic.py): reject frames from a
+        pre-resize topology. A registration for a task the matrix no
+        longer holds — or holds only as a terminal corpse being replaced
+        — is a zombie member of a world that no longer exists."""
+        el = self.elastic
+        if el is None or task_id.partition(":")[0] != el.job:
+            return
+        t = self.session.get_task(task_id)
+        known = t is not None and not (for_register and t.status.terminal)
+        reason = el.fences_frame(known, mgen)
+        if reason is not None:
+            raise FencedError(f"task {task_id}: {reason}")
+
     def register_worker_spec(self, task_id: str, host: str, port: int,
-                             session_id: int = -1) -> Optional[dict]:
+                             session_id: int = -1,
+                             mgen: int = -1) -> Optional[dict]:
         """Gang barrier: record the spec, return the full cluster spec only
         once ALL tasks registered (reference ApplicationMaster.java:841-889).
         Serves initial registration AND post-recovery re-registration —
         the latter is the same call with the executor's existing
-        task_id/host/port, fenced by session epoch."""
+        task_id/host/port, fenced by session epoch — AND a drained
+        survivor's PARK during an elastic resize (same call again, now
+        carrying the new membership generation)."""
         self._check_epoch(task_id, session_id)
+        self._check_membership(task_id, mgen, for_register=True)
         ok = self.session.register_worker(task_id, host, port)
+        if ok and self.elastic is not None \
+                and self.elastic.ack_registration(task_id, mgen):
+            log.info("resize: %s parked under membership generation %s "
+                     "(%d still draining)", task_id, mgen,
+                     len(self.elastic.op.awaiting)
+                     if self.elastic.op else 0)
         if ok:
             if task_id not in self._task_spans and self.tracer.enabled:
                 # Post-recovery re-adoption: the original lifecycle span
@@ -665,7 +745,24 @@ class Coordinator:
                 task_id, task_id.partition(":")[0],
                 steps_hint=self._recovered_steps.pop(task_id, None))
             self._maybe_test_worker_termination(task_id)
-        return self.session.get_cluster_spec()
+        el = self.elastic
+        if el is not None and el.resizing and el.op is not None \
+                and el.op.phase == DRAIN:
+            # The barrier stays CLOSED while the drain runs: lost tasks
+            # keep their registered flag from their first life, so the
+            # raw spec would otherwise open with the OLD topology and a
+            # parked survivor would relaunch at the stale world size.
+            return None
+        spec = self.session.get_cluster_spec()
+        if spec is not None and el is not None:
+            # Elastic metadata rides the spec under a reserved key the
+            # executor pops before the runtimes see it: the current
+            # membership generation (survivors adopt it) and the member
+            # indices (dense-rank mapping for sparse post-shrink gangs).
+            spec["__elastic__"] = {
+                "mgen": el.mgen,
+                "members": {el.job: self.session.members(el.job)}}
+        return spec
 
     def _maybe_test_worker_termination(self, task_id: str) -> None:
         """TEST_WORKER_TERMINATION hook: once the chief registers, kill one
@@ -711,14 +808,17 @@ class Coordinator:
         return 0
 
     def heartbeat(self, task_id: str, session_id: int = -1,
-                  progress: Optional[dict] = None):
+                  progress: Optional[dict] = None, mgen: int = -1):
         """Liveness refresh + progress-beacon intake. The return value
         doubles as the coordinator→executor directive channel: normally
         True (wire-compatible with pre-progress executors), or a dict
         carrying ``{"dump": True}`` exactly once after a hang verdict —
         the executor then signals the user process group so its
-        pre-registered faulthandler dumps all-thread stacks."""
+        pre-registered faulthandler dumps all-thread stacks — and/or
+        ``{"resize": {...}}`` while an elastic drain runs (re-sent every
+        beat; the executor dedups on the membership generation)."""
         self._check_epoch(task_id, session_id)
+        self._check_membership(task_id, mgen)
         with self._hb_lock:
             if task_id in self._last_hb:
                 self._last_hb[task_id] = time.monotonic()
@@ -728,8 +828,15 @@ class Coordinator:
         self._observe_beacon(task_id, progress)
         if self.progress.observe(task_id, progress):
             self._maybe_journal_progress(task_id)
+        resp: Dict[str, object] = {}
         if self.progress.should_dump(task_id):
-            return {"ok": True, "dump": True}
+            resp["dump"] = True
+        if self.elastic is not None:
+            directive = self.elastic.directive_for(task_id)
+            if directive is not None:
+                resp["resize"] = directive
+        if resp:
+            return {"ok": True, **resp}
         return True
 
     def _maybe_journal_progress(self, task_id: str) -> None:
@@ -817,7 +924,7 @@ class Coordinator:
             if last is not None:
                 info["last_heartbeat_age_s"] = round(hb_now - last, 3)
             tasks.append(info)
-        return {
+        report = {
             "app_id": self.app_id,
             "status": status.value,
             "failure_reason": self.session.failure_reason or self._stop_reason,
@@ -829,8 +936,13 @@ class Coordinator:
             "retries_left": retries_left,
             "preemption_retries_left": preempt_left,
             "tb_url": self.tb_url,
+            "gang_size": {name: job.instances
+                          for name, job in self.session.jobs.items()},
             "tasks": tasks,
         }
+        if self.elastic is not None:
+            report["elastic"] = self.elastic.snapshot()
+        return report
 
     def request_stop(self, reason: str) -> None:
         self._stop_reason = reason
@@ -849,9 +961,16 @@ class Coordinator:
         if t is None or t.status.terminal:
             return
         self.progress.forget(task_id)
-        self.session.on_task_completed(
-            task_id, exit_code,
-            domain_hint=self.backend.completion_domain(task_id))
+        domain_hint = self.backend.completion_domain(task_id)
+        if exit_code != 0 and self._absorb_task_loss(
+                t, exit_code, domain_hint,
+                reason=f"exited {exit_code} ({describe_exit(exit_code)})"):
+            # Elastic absorption: the loss became a shrink (or folded
+            # into the in-flight resize) — the session failure policy
+            # never sees it.
+            return
+        self.session.on_task_completed(task_id, exit_code,
+                                       domain_hint=domain_hint)
         self._end_task_span(task_id, exit_code=exit_code,
                             status=t.status.value)
         self.journal.task(
@@ -878,9 +997,8 @@ class Coordinator:
                 payload["exit_detail"] = str(diag["exit_detail"])
         self.events.emit(Event(EventType.TASK_FINISHED, payload))
         if self.scheduler is not None and t.tracked:
-            job = self.session.jobs[t.job_name]
             done = [self.session.get_task(f"{t.job_name}:{i}")
-                    for i in range(job.instances)]
+                    for i in self.session.members(t.job_name)]
             if all(x is not None and x.status == TaskStatus.SUCCEEDED
                    for x in done):
                 self.journal.job_completed(t.job_name,
@@ -896,6 +1014,211 @@ class Coordinator:
                     f"jobtype {t.job_name} failed with unlaunched dependent "
                     f"jobtypes; DAG cannot make progress (task {task_id} "
                     f"exit {exit_code})", t.failure_domain)
+
+    # ------------------------------------------------------------------
+    # Elastic resizing (coordinator/elastic.py)
+    # ------------------------------------------------------------------
+    def _absorb_task_loss(self, t: Task, exit_code: int,
+                          domain_hint: Optional[str], reason: str,
+                          hb_age_s: Optional[float] = None,
+                          kill: bool = False) -> bool:
+        """Try to absorb a dying elastic-gang member as a shrink instead
+        of an epoch failure. Terminalizes the task (WITHOUT the session
+        failure policy), emits its TASK_FINISHED with ``resize: true``
+        (the diagnosis engine must not blame a deliberate resize), and
+        starts — or folds into — the resize op. Returns False when the
+        policy says this loss is a real failure (chief, USER_ERROR,
+        below min-tasks, elasticity off): the caller then takes the
+        ordinary failure path."""
+        from tony_tpu.coordinator.session import classify_exit
+
+        el = self.elastic
+        if el is None:
+            return False
+        domain = classify_exit(exit_code, domain_hint) \
+            or FailureDomain.INFRA_TRANSIENT
+        released = el.is_released(t.task_id)
+        if not released and not el.may_absorb(t, domain.value,
+                                              self.session):
+            return False
+        task_id = t.task_id
+        t.status = (TaskStatus.KILLED
+                    if exit_code == constants.EXIT_KILLED
+                    else TaskStatus.FAILED)
+        t.exit_code = exit_code
+        t.failure_domain = domain
+        with self._hb_lock:
+            self._last_hb.pop(task_id, None)
+        self.progress.forget(task_id)
+        self._end_task_span(task_id, exit_code=exit_code,
+                            resized_out=True)
+        self.journal.task(task_id, t.status.value,
+                          self.session.session_id, exit_code=exit_code,
+                          domain=domain.value)
+        if kill and t.handle is not None:
+            # Heartbeat-expiry shape: the EXECUTOR vanished but its user
+            # tree may live on — reap it off the monitor loop (kill_task
+            # blocks through its grace window).
+            threading.Thread(
+                target=self.backend.kill_task, args=(t.handle,),
+                kwargs={"grace_s": 0.0}, daemon=True,
+                name=f"resize-reap-{task_id}").start()
+        logs = self.backend.task_log_paths(task_id)
+        payload = {
+            "task": task_id, "exit_code": exit_code,
+            "status": t.status.value,
+            "exit_detail": describe_exit(exit_code),
+            "failure_domain": domain.value,
+            "reason": reason,
+            "resize": True,
+            "metrics": self.metrics_store.get(task_id, {}),
+            "logs": list(logs) if logs else [],
+            "session_id": self.session.session_id}
+        if hb_age_s is not None:
+            payload["last_heartbeat_age_s"] = round(hb_age_s, 3)
+        self.events.emit(Event(EventType.TASK_FINISHED, payload))
+        if released:
+            el.note_task_gone(task_id)
+            return True
+        if el.resizing and el.op is not None:
+            # Second loss during the drain: supersede the op with the
+            # smaller membership (mgen bumps again; parked survivors
+            # adopt it through the directive channel).
+            members = [m for m in el.op.members if m != t.index]
+            log.warning("resize: member %s lost mid-drain — superseding "
+                        "to %d member(s)", task_id, len(members))
+        else:
+            members = [x.index for x in self.session.all_tasks()
+                       if x.job_name == el.job and not x.status.terminal]
+        self._start_resize(members,
+                           f"absorbed loss of {task_id}: {reason}")
+        return True
+
+    def _start_resize(self, members, reason: str) -> None:
+        """Begin (or supersede) a resize op: journal the start record
+        write-ahead, emit the timeline event, and let the drain
+        directives ride the next heartbeats."""
+        el = self.elastic
+        live = [t for t in self.session.all_tasks()
+                if t.job_name == el.job and not t.status.terminal]
+        op = el.begin(sorted(members), live, reason)
+        self.journal.resize(el.job, op.mgen, op.members, "start",
+                            self.session.session_id, reason=reason)
+        self.events.emit(Event(EventType.GANG_RESIZED, {
+            "job": el.job, "phase": "started", "mgen": op.mgen,
+            "members": list(op.members), "from": op.size_before,
+            "to": len(op.members), "reason": reason,
+            "session_id": self.session.session_id}))
+        log.warning("resize: %s -> %d member(s) under membership "
+                    "generation %d (%s); draining %d, releasing %d",
+                    el.job, len(op.members), op.mgen, reason,
+                    len(op.awaiting), len(op.release))
+
+    def _elastic_tick(self) -> None:
+        """Advance the resize state machine (monitor-loop cadence):
+        drain done → apply the re-mesh; barrier reopened → finish; the
+        whole op is bounded by tony.elastic.barrier-timeout-s."""
+        el = self.elastic
+        if el is None or not el.resizing:
+            return
+        if el.timed_out():
+            op = el.abandon()
+            self.session.fail(
+                f"elastic resize to {len(op.members)} member(s) did not "
+                f"complete within {el.barrier_timeout_s}s "
+                f"(phase {op.phase}, still draining "
+                f"{sorted(op.awaiting)})",
+                FailureDomain.INFRA_TRANSIENT)
+            return
+        op = el.op
+        if op.phase == DRAIN and el.drain_complete:
+            self._apply_remesh()
+        elif op.phase == BARRIER and self.session.all_registered():
+            done = el.finish()
+            duration_s = round(time.monotonic() - done.started, 3)
+            self.events.emit(Event(EventType.GANG_RESIZED, {
+                "job": el.job, "phase": "completed", "mgen": done.mgen,
+                "members": list(done.members), "from": done.size_before,
+                "to": len(done.members), "reason": done.reason,
+                "duration_s": duration_s,
+                "session_id": self.session.session_id}))
+            log.warning("resize: %s re-meshed at %d member(s) "
+                        "(mgen %d) in %.1fs — training continues in the "
+                        "SAME epoch", el.job, len(done.members),
+                        done.mgen, duration_s)
+
+    def _apply_remesh(self) -> None:
+        """All survivors parked (or dead): rebuild the member set at the
+        new cardinality, journal it write-ahead, launch replacements /
+        grow-back tasks, and reopen the barrier."""
+        el = self.elastic
+        op = el.op
+        try:
+            faults.check("resize.remesh")
+        except faults.InjectedFault as e:
+            el.abandon()
+            self.session.fail(f"elastic re-mesh failed: {e}",
+                              FailureDomain.INFRA_TRANSIENT)
+            return
+        member_set = set(op.members)
+        for t in self.session.all_tasks():
+            if t.job_name != el.job or t.index in member_set:
+                continue
+            # Removed from the topology: close its trace/liveness state;
+            # a released executor that ignored its directive is reaped
+            # off-loop (its straggling frames are fenced as non-members).
+            self._end_task_span(t.task_id, resized_out=True)
+            with self._hb_lock:
+                self._last_hb.pop(t.task_id, None)
+            self.progress.forget(t.task_id)
+            el.note_task_gone(t.task_id)
+            if t.handle is not None and not t.status.terminal:
+                threading.Thread(
+                    target=self.backend.kill_task, args=(t.handle,),
+                    kwargs={"grace_s": float(el.drain_grace_s)},
+                    daemon=True, name=f"resize-release-{t.task_id}"
+                ).start()
+        fresh = self.session.resize_job(el.job, op.members)
+        self.journal.resize(el.job, op.mgen, op.members, "applied",
+                            self.session.session_id, reason=op.reason)
+        for t in fresh:
+            if not self._launch_task(t):
+                el.abandon()
+                return             # session already failed INFRA_TRANSIENT
+        try:
+            faults.check("resize.barrier")
+        except faults.InjectedFault as e:
+            el.abandon()
+            self.session.fail(f"elastic resize barrier failed: {e}",
+                              FailureDomain.INFRA_TRANSIENT)
+            return
+        self._schedule_start = time.monotonic()
+        el.mark_remeshed()
+        log.warning("resize: topology applied — %s members %s (mgen %d, "
+                    "%d fresh launch(es)); waiting at the barrier",
+                    el.job, op.members, op.mgen, len(fresh))
+
+    def resize_application(self, size: int, job: str = "") -> dict:
+        """Operator-initiated resize (`tony-tpu resize <app> <n>`):
+        validated by policy, then the same drain→remesh→barrier path a
+        host-loss absorption takes."""
+        el = self.elastic
+        if el is None:
+            return {"ok": False,
+                    "message": "elasticity is disabled for this job "
+                               "(set tony.elastic.enabled=true)"}
+        if job and job != el.job:
+            return {"ok": False,
+                    "message": f"jobtype {job!r} is not the elastic "
+                               f"jobtype ({el.job})"}
+        try:
+            members = el.plan_explicit(int(size), self.session)
+        except ResizeRefused as e:
+            return {"ok": False, "message": str(e)}
+        self._start_resize(members, f"operator resize to {size}")
+        return {"ok": True, "mgen": el.mgen, "members": members,
+                "message": f"resizing {el.job} to {len(members)} "
+                           f"member(s) (membership generation {el.mgen})"}
 
     def _check_heartbeats(self) -> None:
         """Liveness monitor (reference AbstractLivelinessMonitor usage
@@ -913,6 +1236,15 @@ class Coordinator:
                 continue
             log.error("task %s missed heartbeats for %.1fs — deemed dead",
                       task_id, self._hb_expiry_s)
+            if self._absorb_task_loss(
+                    t, constants.EXIT_KILLED,
+                    FailureDomain.INFRA_TRANSIENT.value,
+                    reason=f"task {task_id} deemed dead (missed "
+                           f"heartbeats for {self._hb_expiry_s:.1f}s)",
+                    hb_age_s=hb_age_s, kill=True):
+                # Host loss absorbed: the gang shrinks and continues —
+                # no epoch failure, no retry burned.
+                continue
             # Postmortem context BEFORE the tracker forgets the task: the
             # event must let an operator tell "executor vanished" (stale
             # heartbeat age, any progress state) from "executor alive,
@@ -1280,6 +1612,11 @@ class Coordinator:
             # a stale traceback must not attach to the new gang's exits.
             self._task_diag.clear()
             self._worker_termination_done = False
+            if self.elastic is not None:
+                # The retry epoch relaunches at the CONFIGURED size; the
+                # old gang's membership (and any in-flight resize) died
+                # with it. mgen stays monotonic — zombies stay fenced.
+                self.elastic.reset_for_epoch()
         # Bump the attempt only after the fresh session is installed: a
         # concurrent application_report must never see (old FAILED session,
         # new attempt) — that combination un-masks the transient FAILED.
@@ -1343,6 +1680,36 @@ class Coordinator:
             attrs={"expected": self.session.num_expected,
                    "re_registration": True})
         self.scheduler.schedule_ready()
+        if self.elastic is not None:
+            # The pre-crash gang had completed its rendezvous (or the
+            # journal would hold no registrations worth re-adopting).
+            self.elastic.established = True
+            if st is not None and st.inflight_job == self.elastic.job \
+                    and st.inflight_members:
+                # Mid-resize crash: RE-ENTER the drain at the journaled
+                # membership generation instead of abandoning the resize
+                # — parked survivors re-register with that mgen and the
+                # op completes under the recovery grace window.
+                live = [t for t in self.session.all_tasks()
+                        if t.job_name == self.elastic.job
+                        and not t.status.terminal]
+                reason = st.inflight_reason or "resumed mid-resize"
+                op = self.elastic.begin(st.inflight_members, live,
+                                        reason, mgen=st.inflight_mgen)
+                self.journal.resize(self.elastic.job, op.mgen,
+                                    op.members, "start",
+                                    self.session.session_id,
+                                    reason=reason)
+                self.events.emit(Event(EventType.GANG_RESIZED, {
+                    "job": self.elastic.job, "phase": "started",
+                    "mgen": op.mgen, "members": list(op.members),
+                    "from": op.size_before, "to": len(op.members),
+                    "reason": reason, "resumed": True,
+                    "session_id": self.session.session_id}))
+                log.warning(
+                    "recovery: resuming in-flight resize to %d member(s) "
+                    "(mgen %d) — %d survivor(s) still to park",
+                    len(op.members), op.mgen, len(op.awaiting))
 
     def _monitor(self) -> SessionStatus:
         """Reference ``monitor()`` :581-650 — 5 s loop; 500 ms here."""
@@ -1372,6 +1739,11 @@ class Coordinator:
                 self._rendezvous_span.end(
                     registered=self.session.num_registered)
                 self._rendezvous_span = None
+                if self.elastic is not None:
+                    # Resizes only make sense against an established
+                    # gang; losses before this point are rendezvous
+                    # failures, not absorbable churn.
+                    self.elastic.established = True
             # Live-metrics export (throttled internally): keeps the
             # portal's /metrics exposition fresh while the job runs.
             self._maybe_write_prom()
@@ -1415,6 +1787,7 @@ class Coordinator:
                 self._process_completion(task_id, exit_code)
             self._check_heartbeats()
             self._check_progress()
+            self._elastic_tick()
             if self.session.status != SessionStatus.RUNNING:
                 return self.session.status
             if self.session.training_finished():

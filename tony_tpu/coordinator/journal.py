@@ -46,6 +46,7 @@ REC_REGISTER = "register"         # executor registration (host/port)
 REC_TASK = "task"                 # task state transition
 REC_VERDICT = "verdict"           # failure-domain verdict for an epoch
 REC_PROGRESS = "progress"         # throttled task step-counter checkpoint
+REC_RESIZE = "resize"             # elastic membership change (start/applied)
 
 
 class JournalError(RuntimeError):
@@ -85,6 +86,21 @@ class ReplayState:
     tasks: Dict[str, TaskRecord] = dataclasses.field(default_factory=dict)
     records: int = 0              # complete records replayed
     torn_tail: bool = False       # a torn/undecodable suffix was dropped
+    # --- elastic membership (coordinator/elastic.py) -------------------
+    # Highest membership generation journaled (monotonic across lives).
+    elastic_mgen: int = 0
+    # Member indices of the LAST applied resize per job — the matrix the
+    # recovered coordinator must rebuild (None = never resized).
+    applied_members: Dict[str, list] = dataclasses.field(
+        default_factory=dict)
+    # An in-flight resize (start with no matching applied): the recovered
+    # coordinator re-enters the drain instead of abandoning it, so a
+    # mid-resize crash completes the resize rather than restarting the
+    # job. (job, mgen, members, reason) — empty job = none.
+    inflight_job: str = ""
+    inflight_mgen: int = 0
+    inflight_members: list = dataclasses.field(default_factory=list)
+    inflight_reason: str = ""
 
 
 class SessionJournal:
@@ -148,6 +164,18 @@ class SessionJournal:
         — the journal is fsync'd and must stay control-plane-rate."""
         self.append({"t": REC_PROGRESS, "task": task_id, "steps": steps,
                      "session": session_id})
+
+    def resize(self, job: str, mgen: int, members, phase: str,
+               session_id: int, reason: str = "") -> None:
+        """Elastic membership transition. Write-ahead discipline:
+        ``phase="start"`` lands BEFORE any drain directive is issued and
+        ``phase="applied"`` BEFORE the new topology's launches, so a
+        crash anywhere inside a resize replays into either "re-enter the
+        drain" or "the new matrix, under the re-registration grace"."""
+        self.append({"t": REC_RESIZE, "job": job, "mgen": int(mgen),
+                     "members": sorted(int(m) for m in members),
+                     "phase": phase, "session": session_id,
+                     "reason": reason})
 
     def close(self) -> None:
         if self._log is not None:
@@ -219,6 +247,14 @@ def replay(path: str) -> ReplayState:
             state.scheduled_jobs.clear()
             state.completed_jobs.clear()
             state.tasks.clear()
+            # Membership belongs to the epoch's gang (a retry epoch
+            # relaunches at the configured size); the generation itself
+            # stays monotonic so old-topology zombies stay fenced.
+            state.applied_members.clear()
+            state.inflight_job = ""
+            state.inflight_members = []
+            state.inflight_reason = ""
+            state.inflight_mgen = 0
         elif t == REC_JOB_SCHEDULED:
             if int(rec.get("session", 0) or 0) == state.session_id:
                 state.scheduled_jobs.add(str(rec.get("job", "")))
@@ -254,6 +290,32 @@ def replay(path: str) -> ReplayState:
                 tr.steps = float(rec.get("steps", -1.0))
             except (TypeError, ValueError):
                 pass
+        elif t == REC_RESIZE:
+            if int(rec.get("session", 0) or 0) != state.session_id:
+                continue
+            job = str(rec.get("job", "") or "")
+            mgen = int(rec.get("mgen", 0) or 0)
+            members = [int(m) for m in rec.get("members", []) or []]
+            state.elastic_mgen = max(state.elastic_mgen, mgen)
+            if rec.get("phase") == "applied":
+                state.applied_members[job] = members
+                # The applied topology supersedes the removed tasks'
+                # folded state AND any in-flight start it completes.
+                state.tasks = {
+                    tid: tr for tid, tr in state.tasks.items()
+                    if tid.partition(":")[0] != job
+                    or int(tid.rpartition(":")[2]) in members}
+                if state.inflight_job == job \
+                        and state.inflight_mgen <= mgen:
+                    state.inflight_job = ""
+                    state.inflight_members = []
+                    state.inflight_reason = ""
+                    state.inflight_mgen = 0
+            else:                  # "start": a resize is in flight
+                state.inflight_job = job
+                state.inflight_mgen = mgen
+                state.inflight_members = members
+                state.inflight_reason = str(rec.get("reason", "") or "")
         elif t == REC_VERDICT:
             pass                   # forensic record; no folded state
         else:

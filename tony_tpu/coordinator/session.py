@@ -149,6 +149,7 @@ class Session:
         self.fail_on_worker_failure = conf.get_bool(
             K.APPLICATION_FAIL_ON_WORKER_FAILURE)
         self._lock = threading.RLock()
+        self._untracked = untracked
         self.tasks: Dict[str, Task] = {}
         for job in self.jobs.values():
             for i in range(job.instances):
@@ -177,6 +178,45 @@ class Session:
 
     def tracked_tasks(self) -> List[Task]:
         return [t for t in self.tasks.values() if t.tracked]
+
+    def members(self, job_name: str) -> List[int]:
+        """Sorted member indices of a jobtype's gang. Dense
+        ``range(instances)`` until an elastic resize makes it sparse
+        (coordinator/elastic.py): a shrink keeps SURVIVOR indices — task
+        identity is stable across resizes; only the dense rank (a task's
+        position in this list) changes."""
+        with self._lock:
+            return sorted(t.index for t in self.tasks.values()
+                          if t.job_name == job_name)
+
+    def resize_job(self, job_name: str, members) -> List[Task]:
+        """Apply an elastic membership change: the jobtype's gang becomes
+        exactly ``members`` (indices). Live tasks already in the set are
+        kept (their executors are parked at the barrier and re-register);
+        indices without a live task get a FRESH Task (returned for the
+        caller to launch — lost hosts being replaced, or grow-back);
+        indices outside the set are dropped from the matrix (their
+        executors were released and any stragglers are fenced as
+        non-members). ``jobs[job].instances`` tracks the new cardinality
+        so TASK_NUM and the quota surfaces stay truthful."""
+        with self._lock:
+            job = self.jobs[job_name]
+            wanted = sorted(set(int(m) for m in members))
+            for t in [t for t in self.tasks.values()
+                      if t.job_name == job_name]:
+                if t.index not in wanted:
+                    del self.tasks[t.task_id]
+            fresh: List[Task] = []
+            for i in wanted:
+                tid = f"{job_name}:{i}"
+                t = self.tasks.get(tid)
+                if t is None or t.status.terminal:
+                    nt = Task(job_name, i, session_id=self.session_id,
+                              tracked=job_name not in self._untracked)
+                    self.tasks[tid] = nt
+                    fresh.append(nt)
+            job.instances = len(wanted)
+            return fresh
 
     def is_chief(self, job_name: str, index: int) -> bool:
         """Reference ``TonySession.isChief`` :364 — the ``chief`` jobtype if it
@@ -225,13 +265,16 @@ class Session:
             if not self.all_registered():
                 return None
             spec: Dict[str, List[str]] = {}
-            for job_name, job in self.jobs.items():
+            for job_name in self.jobs:
                 if job_name not in self.scheduled_jobs:
                     continue
-                members = [self.tasks[f"{job_name}:{i}"].spec
-                           for i in range(job.instances)]
-                if members:
-                    spec[job_name] = members
+                # Dense-rank order over the (possibly sparse, post-resize)
+                # member indices: list position IS the dense rank the
+                # runtimes build JAX_PROCESS_ID / TF_CONFIG from.
+                addrs = [self.tasks[f"{job_name}:{i}"].spec
+                         for i in self.members(job_name)]
+                if addrs:
+                    spec[job_name] = addrs
             return spec
 
     # -- mutations --------------------------------------------------------

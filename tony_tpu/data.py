@@ -35,14 +35,26 @@ from jax.sharding import Mesh, NamedSharding
 from tony_tpu.parallel.mesh import batch_sharding as global_batch_sharding
 
 
-def process_batch_slice(global_batch: int) -> slice:
-    """This process's contiguous row range of the global batch."""
-    n = jax.process_count()
+def process_batch_slice(global_batch: int, rank: Optional[int] = None,
+                        world: Optional[int] = None) -> slice:
+    """This process's contiguous row range of the global batch.
+
+    ``rank``/``world`` default to the jax distributed runtime; pass them
+    explicitly for elastic gangs (coordinator/elastic.py): after a
+    resize the executor re-exports the DENSE rank and world
+    (TASK_INDEX/TASK_NUM, TONY_GLOBAL_RANK/TONY_GLOBAL_WORLD) and the
+    same global batch re-splits across the surviving ranks — every row
+    of every step is consumed by exactly one process at whatever world
+    size executed that step, so a shrink drops no sample and duplicates
+    none."""
+    n = int(world) if world is not None else jax.process_count()
+    i = int(rank) if rank is not None else jax.process_index()
+    if not 0 <= i < n:
+        raise ValueError(f"rank {i} outside world of {n}")
     if global_batch % n:
         raise ValueError(
             f"global batch {global_batch} not divisible by process count {n}")
     per = global_batch // n
-    i = jax.process_index()
     return slice(i * per, (i + 1) * per)
 
 

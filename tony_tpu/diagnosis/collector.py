@@ -69,6 +69,10 @@ class TaskIncident:
     metrics: Dict[str, Any] = dataclasses.field(default_factory=dict)
     hung: bool = False
     straggler: bool = False
+    #: this TASK_FINISHED was an elastic-resize absorption (host loss
+    #: shrunk-and-continued, or a released member) — deliberate
+    #: elasticity, not the job's failure; blame rules skip these.
+    resized: bool = False
 
     @property
     def failed(self) -> bool:
@@ -103,7 +107,8 @@ class IncidentBundle:
         timestamps: among failed tasks, the one whose failure instant is
         earliest — in a gang, every failure after the first is usually
         collateral (peers dying on a broken collective)."""
-        failed = [t for t in self.tasks.values() if t.failed]
+        failed = [t for t in self.tasks.values()
+                  if t.failed and not t.resized]
         if not failed:
             return None
         return min(failed, key=lambda t: (
@@ -268,6 +273,8 @@ def _fold_events(bundle: IncidentBundle) -> None:
                 t.progress = p["progress"]
             if isinstance(p.get("metrics"), dict):
                 t.metrics = p["metrics"]
+            if p.get("resize"):
+                t.resized = True
         elif ev.type == "TASK_HUNG":
             task_of(ev).hung = True
         elif ev.type == "TASK_STRAGGLER":

@@ -33,14 +33,16 @@ PREEMPTION = "PREEMPTION"
 INFRA_STORM = "INFRA_STORM"
 COORDINATOR_LOSS = "COORDINATOR_LOSS"
 PORT_RENDEZVOUS = "PORT_RENDEZVOUS"
+GANG_RESIZE = "GANG_RESIZE"
 UNKNOWN = "UNKNOWN"
 
 #: verdict precedence, most specific first: explicit verdicts the
 #: control plane already made, then backend attribution, then log-shape
 #: heuristics, then the fallback.
 CATEGORY_PRECEDENCE = (
-    COORDINATOR_LOSS, HANG, STRAGGLER_CASCADE, PREEMPTION, OOM_HBM,
-    OOM_RSS, PORT_RENDEZVOUS, INFRA_STORM, USER_TRACEBACK, UNKNOWN)
+    COORDINATOR_LOSS, GANG_RESIZE, HANG, STRAGGLER_CASCADE, PREEMPTION,
+    OOM_HBM, OOM_RSS, PORT_RENDEZVOUS, INFRA_STORM, USER_TRACEBACK,
+    UNKNOWN)
 
 
 @dataclasses.dataclass
@@ -178,12 +180,51 @@ def _straggler(b: IncidentBundle) -> Optional[Finding]:
         details={"stragglers": sorted(by_task)})
 
 
+@_rule("elastic-resize", GANG_RESIZE, ("GANG_RESIZED", "TASK_FINISHED"))
+def _elastic_resize(b: IncidentBundle) -> Optional[Finding]:
+    """Distinguish "the gang shrank and continued" (deliberate
+    elasticity — NOT the failure; other rules skip the absorbed task
+    exits via their ``resized`` flag) from "the job died mid-resize"
+    (drain/barrier never completed): only the latter takes the verdict,
+    with the incomplete resize as the evidence."""
+    resized = b.events_of("GANG_RESIZED")
+    if not resized:
+        return None
+    started = [e for e in resized if e.payload.get("phase") == "started"]
+    completed = [e for e in resized
+                 if e.payload.get("phase") == "completed"]
+    reason = (b.failure_reason or "").lower()
+    mid_resize = "resize" in reason or len(completed) < len(started)
+    if not mid_resize:
+        # Every resize completed: absorbed losses are routine
+        # elasticity. Let the real cause (if any) take the verdict.
+        return None
+    last = started[-1].payload if started else {}
+    ev = [f"events: GANG_RESIZED started mgen={last.get('mgen')} "
+          f"{last.get('from')}->{last.get('to')} "
+          f"({last.get('reason')}) never completed"]
+    if b.failure_reason:
+        ev.append(f"failure_reason: {b.failure_reason}")
+    absorbed = sorted(t.task_id for t in b.tasks.values() if t.resized)
+    if absorbed:
+        ev.append(f"absorbed member loss(es): {absorbed}")
+    return Finding(
+        GANG_RESIZE, "elastic-resize",
+        "the job failed while an elastic resize was in flight — the "
+        "drain or the post-remesh barrier never completed (the retry "
+        "epoch relaunches at the configured size)",
+        blamed_task=_blame(b), confidence=0.85, evidence=ev,
+        details={"mgen": last.get("mgen"), "target": last.get("to")})
+
+
 @_rule("preemption", PREEMPTION, ("TASK_FINISHED", "APPLICATION_FINISHED"))
 def _preemption(b: IncidentBundle) -> Optional[Finding]:
     """Backend-attributed preemption (host reclaimed, spot notice, 143
-    save-on-TERM exits) — authoritative when the domain says so."""
+    save-on-TERM exits) — authoritative when the domain says so. Losses
+    a resize absorbed are deliberate elasticity, not this verdict."""
     preempted = [t for t in b.tasks.values()
-                 if t.failed and t.failure_domain == "PREEMPTION"]
+                 if t.failed and t.failure_domain == "PREEMPTION"
+                 and not t.resized]
     if not preempted and b.failure_domain != "PREEMPTION":
         return None
     blamed = min(preempted, key=lambda t: t.failure_us or t.finished_ms
@@ -242,7 +283,8 @@ def _oom_rss(b: IncidentBundle) -> Optional[Finding]:
     log raise the confidence."""
     for t in sorted(b.tasks.values(),
                     key=lambda x: x.failure_us or x.finished_ms * 1000):
-        if not t.failed or t.hung or t.failure_domain == "PREEMPTION":
+        if not t.failed or t.hung or t.resized \
+                or t.failure_domain == "PREEMPTION":
             continue
         texts = [(t.traceback, "traceback")] + \
             [(b.log_tails.get(p, ""), p) for p in t.logs]
@@ -304,7 +346,8 @@ def _vanished(b: IncidentBundle) -> Optional[Finding]:
     """Heartbeat-expiry kill: the EXECUTOR (not just the user process)
     went silent — host death, network partition, or a wedged VM."""
     gone = [t for t in b.tasks.values()
-            if t.failed and t.last_heartbeat_age_s is not None
+            if t.failed and not t.resized
+            and t.last_heartbeat_age_s is not None
             and ("deemed dead" in t.reason
                  or t.last_heartbeat_age_s >= 1.0 and not t.hung
                  and not t.reason)]

@@ -53,6 +53,16 @@ class EventType(str, enum.Enum):
     # blamed task, the rule that fired, and the incident.json path —
     # downstream tooling reads the verdict without re-running the engine.
     JOB_DIAGNOSED = "JOB_DIAGNOSED"
+    # Elastic gang resize (coordinator/elastic.py): the gang's membership
+    # changed WITHOUT restarting the job — host-loss absorption, an
+    # explicit `tony-tpu resize`, or grow-back. Emitted with
+    # phase="started" when the drain begins and phase="completed" when
+    # the re-meshed gang's barrier reopens; payload carries the jobtype,
+    # the bumped membership generation, the member indices, the from/to
+    # sizes and the trigger reason. A deliberate resize on the timeline —
+    # the diagnosis engine must not read its absorbed task exits as the
+    # job's failure.
+    GANG_RESIZED = "GANG_RESIZED"
 
 
 @dataclasses.dataclass

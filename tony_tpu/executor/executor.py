@@ -90,7 +90,9 @@ class Heartbeater(threading.Thread):
                  orphan_deadline_s: float = 120.0,
                  on_orphaned: Optional[Callable[[str], None]] = None,
                  progress_fn: Optional[Callable[[], Optional[dict]]] = None,
-                 on_dump: Optional[Callable[[], None]] = None):
+                 on_dump: Optional[Callable[[], None]] = None,
+                 mgen_fn: Optional[Callable[[], int]] = None,
+                 on_resize: Optional[Callable[[dict], None]] = None):
         super().__init__(name="tony-heartbeater", daemon=True)
         self._client = client
         self._task_id = task_id
@@ -105,6 +107,12 @@ class Heartbeater(threading.Thread):
         # carry the coordinator's dump directive for a hung verdict.
         self._progress_fn = progress_fn
         self._on_dump = on_dump
+        # Elastic membership (coordinator/elastic.py): every beat carries
+        # the executor's CURRENT membership generation (the topology
+        # fence) and the response may carry a RESIZE directive — drain
+        # (checkpoint-and-park) or release.
+        self._mgen_fn = mgen_fn
+        self._on_resize = on_resize
         self._misses = 0
         # _stop_evt, not _stop: threading.Thread has a private _stop()
         # method; shadowing it with an Event breaks Thread.join().
@@ -125,6 +133,21 @@ class Heartbeater(threading.Thread):
                 # as if the executor were wedged — the coordinator's
                 # liveness monitor is what must notice.
                 continue
+            if faults.fire("host.loss"):
+                # Sudden whole-host death: everything on the "host" dies
+                # at once — the user process group AND this executor,
+                # with no teardown and no exit report. The shape elastic
+                # shrink-and-continue must absorb (the call counter is
+                # heartbeats, so after:N places it deterministically).
+                log.critical("FAULT host.loss: SIGKILLing the user "
+                             "process group and hard-exiting")
+                p = _user_proc[0] if _user_proc else None
+                if p is not None and p.poll() is None:
+                    try:
+                        os.killpg(p.pid, signal.SIGKILL)
+                    except (ProcessLookupError, PermissionError):
+                        pass
+                os._exit(137)
             progress = None
             if self._progress_fn is not None:
                 try:
@@ -132,10 +155,12 @@ class Heartbeater(threading.Thread):
                 except Exception:  # noqa: BLE001 — the beat must not die
                     progress = None
             try:
-                res = self._client.call("task_executor_heartbeat",
-                                        task_id=self._task_id,
-                                        session_id=self._session_id,
-                                        progress=progress)
+                res = self._client.call(
+                    "task_executor_heartbeat",
+                    task_id=self._task_id,
+                    session_id=self._session_id,
+                    progress=progress,
+                    mgen=self._mgen_fn() if self._mgen_fn else -1)
                 self._misses = 0
                 if isinstance(res, dict) and res.get("dump") \
                         and self._on_dump is not None:
@@ -145,6 +170,13 @@ class Heartbeater(threading.Thread):
                         self._on_dump()
                     except Exception:  # noqa: BLE001 — best-effort
                         log.exception("stack-dump delivery failed")
+                if isinstance(res, dict) \
+                        and isinstance(res.get("resize"), dict) \
+                        and self._on_resize is not None:
+                    try:
+                        self._on_resize(res["resize"])
+                    except Exception:  # noqa: BLE001 — keep beating
+                        log.exception("resize directive handling failed")
             except FencedError as e:
                 self._orphan(f"fenced by a live coordinator: {e}")
                 return
@@ -234,6 +266,18 @@ class TaskExecutor:
             e.get(constants.COORDINATOR_GENERATION, "0") or 0)
         self.coordinator_addr_file = e.get(constants.COORDINATOR_ADDR_FILE,
                                            "")
+        # Elastic membership generation (coordinator/elastic.py): -1 =
+        # not an elastic job (compat-accepted by the coordinator).
+        # Survivors adopt newer generations from the RESIZE directive
+        # riding the heartbeat response; a frame carrying a stale value
+        # with no resize in flight is fenced.
+        try:
+            self.mgen = int(e.get(constants.MEMBERSHIP_GEN, "") or -1)
+        except ValueError:
+            self.mgen = -1
+        self._resize_lock = threading.Lock()
+        self._resize_directive: Optional[dict] = None
+        self._released = False
         self._rpc_max_retries = self.conf.get_int(K.RPC_MAX_RETRIES, 10)
         self._rpc_retry_sleep_s = float(
             self.conf.get(K.RPC_RETRY_SLEEP_S, 2.0) or 2.0)
@@ -366,7 +410,7 @@ class TaskExecutor:
                         host=self.hostname,
                         port=self.rendezvous_port.port
                         if self.rendezvous_port else 0,
-                        session_id=self.session_id)
+                        session_id=self.session_id, mgen=self.mgen)
         except BaseException:
             client.close()
             raise
@@ -480,6 +524,86 @@ class TaskExecutor:
         except (ProcessLookupError, PermissionError) as e:
             log.warning("stack-dump signal failed: %s", e)
 
+    # -- elastic resize (coordinator/elastic.py) -------------------------
+    def _on_resize(self, directive: dict) -> None:
+        """RESIZE directive off the heartbeat response (the dump-
+        directive pattern): the gang is re-meshing. Drain the user
+        process at a step barrier — TERM so its save-on-SIGTERM handler
+        makes one final durable save, KILL after the drain grace — and
+        leave the park/release decision to the run loop once the exit
+        lands. Re-sent every beat while the drain runs; dedup on the
+        membership generation (never act twice, never act on a stale
+        generation after adopting a newer one)."""
+        try:
+            mgen = int(directive.get("mgen", -1))
+        except (TypeError, ValueError):
+            return
+        with self._resize_lock:
+            cur = self._resize_directive
+            if mgen <= self.mgen or (
+                    cur is not None and mgen <= int(cur.get("mgen", -1))):
+                return
+            self._resize_directive = dict(directive)
+        action = str(directive.get("action", "drain"))
+        log.warning("resize directive: %s under membership generation "
+                    "%d (size %s) — draining the user process",
+                    action, mgen, directive.get("size"))
+        p = _user_proc[0] if _user_proc else None
+        if p is None or p.poll() is not None:
+            return                 # nothing to drain; the loop handles it
+        try:
+            os.killpg(p.pid, signal.SIGTERM)
+        except (ProcessLookupError, PermissionError):
+            return
+        try:
+            grace = float(directive.get("grace_s") or 0) or float(
+                os.environ.get(constants.TASK_KILL_GRACE_ENV, "15") or 15)
+        except (TypeError, ValueError):
+            grace = 15.0
+
+        def _escalate():
+            if p.poll() is None:
+                log.warning("resize drain grace (%.0fs) expired; "
+                            "SIGKILLing the user process group", grace)
+                try:
+                    os.killpg(p.pid, signal.SIGKILL)
+                except (ProcessLookupError, PermissionError):
+                    pass
+        timer = threading.Timer(grace, _escalate)
+        timer.daemon = True
+        timer.start()
+
+    def _take_resize_directive(self) -> Optional[dict]:
+        """Consume the pending directive (run loop, after a user-process
+        exit): adopting the new membership generation here makes every
+        later frame — heartbeats, the park re-registration — carry it."""
+        with self._resize_lock:
+            d, self._resize_directive = self._resize_directive, None
+        if d is not None:
+            self.mgen = max(self.mgen, int(d.get("mgen", -1)))
+        return d
+
+    def _gang_position(self, cluster_spec) -> tuple:
+        """(dense_rank, world, members) for this task under the spec's
+        elastic metadata. A post-shrink gang keeps SURVIVOR indices —
+        task identity is stable — so the wire spec lists members in
+        dense-rank order and this maps our stable index into it. Plain
+        (index, task_num, range) for non-elastic jobs."""
+        meta = cluster_spec.pop("__elastic__", None) \
+            if isinstance(cluster_spec, dict) else None
+        members = None
+        if isinstance(meta, dict):
+            try:
+                self.mgen = max(self.mgen, int(meta.get("mgen", -1)))
+            except (TypeError, ValueError):
+                pass
+            raw = (meta.get("members") or {}).get(self.job_name)
+            if raw:
+                members = sorted(int(m) for m in raw)
+        if members and self.index in members:
+            return members.index(self.index), len(members), members
+        return self.index, self.task_num, list(range(self.task_num))
+
     def _orphan_teardown(self, reason: str) -> None:
         """No coordinator will ever hear from us again (deadline expired)
         or a live one fenced us out as stale: deliver the TERM-grace-KILL
@@ -537,7 +661,7 @@ class TaskExecutor:
                 return self.client.call(
                     "register_worker_spec", task_id=self.task_id,
                     host=self.hostname, port=self.rendezvous_port.port,
-                    session_id=self.session_id)
+                    session_id=self.session_id, mgen=self.mgen)
             except FencedError:
                 # A live coordinator ruled this executor stale (old
                 # generation/epoch): polling cannot fix that — abort.
@@ -670,7 +794,9 @@ class TaskExecutor:
                 self.conf.get_int(K.TASK_ORPHAN_DEADLINE_S, 120)),
             on_orphaned=self._orphan_teardown,
             progress_fn=self._progress_beacon,
-            on_dump=self._dump_user_stacks)
+            on_dump=self._dump_user_stacks,
+            mgen_fn=lambda: self.mgen,
+            on_resize=self._on_resize)
         hb.start()
         monitor = TaskMonitor(
             self.task_id,
@@ -714,46 +840,6 @@ class TaskExecutor:
 
         framework = str(self.conf.get(K.APPLICATION_FRAMEWORK, "jax"))
         runtime = get_runtime(framework)
-        me = TaskIdentity(self.job_name, self.index, self.task_num,
-                          self.is_chief, self.rendezvous_port.port)
-        env = runtime.build_env(cluster_spec, me, self.conf)
-        # Reference-compat aliases: user scripts written against the
-        # reference read bare names (Constants.java:104-110 env contract —
-        # JOB_NAME/TASK_INDEX/... without the TONY_ prefix).
-        env.update({
-            "JOB_NAME": self.job_name,
-            "TASK_INDEX": str(self.index),
-            "TASK_NUM": str(self.task_num),
-            "IS_CHIEF": "true" if self.is_chief else "false",
-            "SESSION_ID": str(self.session_id),
-        })
-        if self.tb_port is not None:
-            env[constants.TB_PORT] = str(self.tb_port.port)
-        # The user process reports its own device stats here (it owns the
-        # chips; see tony_tpu/telemetry.py) and the monitor tails the file.
-        env[constants.METRICS_FILE] = metrics_file
-        # Hung-task diagnostics contract: `import tony_tpu` in the user
-        # process pre-registers a faulthandler all-thread stack dump on
-        # this signal; _dump_user_stacks delivers it on the coordinator's
-        # hung verdict. Respect an operator-provided override.
-        env.setdefault(constants.STACKDUMP_SIGNAL, str(self._dump_signal))
-
-        tb_proc = self._maybe_launch_tensorboard(env)
-
-        # Release-before-exec dance (reference :224-249): ephemeral ports must
-        # be free for the user process to bind; reusable ports stay held.
-        if not self.rendezvous_port.reuse:
-            self.rendezvous_port.release()
-        if self.tb_port is not None:
-            self.tb_port.release()
-
-        # Root the proc-tree walk at the executor itself: the user process
-        # is a descendant, and this root stays sampleable after the child
-        # exits (a dead child pid would zero the final sample short tasks
-        # rely on).
-        monitor._pid_fn = os.getpid
-        monitor.start()
-        self._monitor = monitor
 
         def _on_user_start(p) -> None:
             # Publish the user pgid: in-process for the signal forwarder,
@@ -769,6 +855,14 @@ class TaskExecutor:
                 log.warning("could not write %s: %s",
                             constants.USER_PGID_FILE, e)
 
+        # Root the proc-tree walk at the executor itself: the user process
+        # is a descendant, and this root stays sampleable after the child
+        # exits (a dead child pid would zero the final sample short tasks
+        # rely on). Started ONCE — it spans elastic park/relaunch cycles.
+        monitor._pid_fn = os.getpid
+        monitor.start()
+        self._monitor = monitor
+
         # Spot/preemptible TPU VMs: the metadata server's advance notice
         # becomes a SIGTERM to the user group, so save-on-preemption
         # handlers run inside the warning window (executor/preemption.py;
@@ -776,36 +870,136 @@ class TaskExecutor:
         from tony_tpu.executor.preemption import start_for_executor
         preempt_watcher = start_for_executor(_user_proc)
 
-        user_span = self.tracer.start_span(
-            "executor.user_process", parent=self._run_span,
-            task=self.task_id)
+        tb_proc = None
+        ports_released = False
+        exit_code = constants.EXIT_FAILURE
         try:
-            exit_code = procutil.execute_shell(
-                self.command,
-                timeout_s=self.conf.get_int(
-                    K.TASK_EXECUTOR_EXECUTION_TIMEOUT_S, 0),
-                env=env, on_start=_on_user_start)
-            user_span.end(exit_code=exit_code)
+            # The user process runs inside a loop because of elastic
+            # resizes (coordinator/elastic.py): a drained survivor PARKS
+            # — re-registers its existing identity under the new
+            # membership generation, waits at the barrier, and relaunches
+            # the user command at the new world size — instead of
+            # reporting an exit. Exactly one iteration for non-elastic
+            # jobs (the common case breaks at the bottom).
+            while True:
+                rank, world, members = self._gang_position(cluster_spec)
+                me = TaskIdentity(self.job_name, rank, world,
+                                  self.is_chief,
+                                  self.rendezvous_port.port)
+                env = runtime.build_env(cluster_spec, me, self.conf)
+                # Reference-compat aliases: user scripts written against
+                # the reference read bare names (Constants.java:104-110 —
+                # JOB_NAME/TASK_INDEX/... without the TONY_ prefix).
+                # TASK_INDEX/TASK_NUM are the DENSE rank and world: after
+                # a shrink the member indices are sparse, and what user
+                # data pipelines need is their position in the gang.
+                env.update({
+                    "JOB_NAME": self.job_name,
+                    "TASK_INDEX": str(rank),
+                    "TASK_NUM": str(world),
+                    "IS_CHIEF": "true" if self.is_chief else "false",
+                    "SESSION_ID": str(self.session_id),
+                })
+                env[constants.GANG_MEMBERS] = ",".join(
+                    str(m) for m in members)
+                if self.mgen >= 0:
+                    env[constants.MEMBERSHIP_GEN] = str(self.mgen)
+                if self.tb_port is not None:
+                    env[constants.TB_PORT] = str(self.tb_port.port)
+                # The user process reports its own device stats here (it
+                # owns the chips; see tony_tpu/telemetry.py) and the
+                # monitor tails the file.
+                env[constants.METRICS_FILE] = metrics_file
+                # Hung-task diagnostics contract: `import tony_tpu` in
+                # the user process pre-registers a faulthandler
+                # all-thread stack dump on this signal; _dump_user_stacks
+                # delivers it on the coordinator's hung verdict.
+                env.setdefault(constants.STACKDUMP_SIGNAL,
+                               str(self._dump_signal))
+                if tb_proc is None:
+                    tb_proc = self._maybe_launch_tensorboard(env)
+                if not ports_released:
+                    # Release-before-exec dance (reference :224-249):
+                    # ephemeral ports must be free for the user process
+                    # to bind; reusable ports stay held.
+                    if not self.rendezvous_port.reuse:
+                        self.rendezvous_port.release()
+                    if self.tb_port is not None:
+                        self.tb_port.release()
+                    ports_released = True
+                user_span = self.tracer.start_span(
+                    "executor.user_process", parent=self._run_span,
+                    task=self.task_id,
+                    attrs={"world": world, "rank": rank})
+                try:
+                    exit_code = procutil.execute_shell(
+                        self.command,
+                        timeout_s=self.conf.get_int(
+                            K.TASK_EXECUTOR_EXECUTION_TIMEOUT_S, 0),
+                        env=env, on_start=_on_user_start)
+                    user_span.end(exit_code=exit_code)
+                finally:
+                    user_span.end(aborted=True)   # no-op when ended above
+                    _user_proc[:] = []
+                    # The group is reaped (execute_shell's finally); drop
+                    # the pgid file so later backend kills can't TERM a
+                    # recycled group id while the executor lingers
+                    # through reporting/teardown (ADVICE r4: same-user
+                    # pgid reuse isn't caught by the PermissionError
+                    # guard).
+                    try:
+                        os.unlink(os.path.join(os.getcwd(),
+                                               constants.USER_PGID_FILE))
+                    except OSError:
+                        pass
+                log.info("user process for %s exited with %d",
+                         self.task_id, exit_code)
+                directive = self._take_resize_directive()
+                if directive is None or self._orphaned_reason is not None:
+                    break
+                if str(directive.get("action")) == "release":
+                    # Shrunk out of the gang: no coordinator wants this
+                    # exit — the re-meshed topology no longer holds the
+                    # task (a result report would be fenced anyway).
+                    self._released = True
+                    break
+                # PARK: re-register the existing identity under the new
+                # membership generation and wait at the barrier for the
+                # re-meshed spec — the user process relaunches at the
+                # new world size and resumes from the checkpoint.
+                log.warning("parked for resize (membership generation "
+                            "%d): re-registering %s", self.mgen,
+                            self.task_id)
+                self._beacon_steps = None
+                park_span = self.tracer.start_span(
+                    "executor.park", parent=self._run_span,
+                    task=self.task_id, attrs={"mgen": self.mgen})
+                try:
+                    cluster_spec = self.register_and_get_cluster_spec()
+                except FencedError as e:
+                    park_span.end(fenced=True)
+                    log.error("park re-registration fenced for %s: %s",
+                              self.task_id, e)
+                    hb.stop()
+                    self._run_span.end(fenced=True)
+                    self._flush_trace()
+                    return constants.EXIT_KILLED
+                park_span.end(barrier_open=cluster_spec is not None)
+                if cluster_spec is None:
+                    log.error("post-resize barrier timed out for %s",
+                              self.task_id)
+                    hb.stop()
+                    self._run_span.end(barrier_timeout=True)
+                    self._flush_trace()
+                    return constants.EXIT_FAILURE
+                self._flush_trace()
         finally:
-            user_span.end(aborted=True)   # no-op when ended above
-            _user_proc[:] = []
-            # The group is reaped (execute_shell's finally); drop the pgid
-            # file so later backend kills can't TERM a recycled group id
-            # while the executor lingers through reporting/teardown
-            # (ADVICE r4: same-user pgid reuse isn't caught by the
-            # PermissionError guard).
-            try:
-                os.unlink(os.path.join(os.getcwd(),
-                                       constants.USER_PGID_FILE))
-            except OSError:
-                pass
             if preempt_watcher is not None:
                 preempt_watcher.stop()
             monitor.stop()
             if self.rendezvous_port.reuse:
                 self.rendezvous_port.release()
             self._teardown_tensorboard(tb_proc)
-        log.info("user process for %s exited with %d", self.task_id, exit_code)
         # A short task can finish before the heartbeater's next beacon
         # poll: read the final telemetry snapshot once more so the
         # first-step span lands even for one-step jobs (the bench probe).
@@ -814,6 +1008,18 @@ class TaskExecutor:
         except Exception:  # noqa: BLE001 — diagnostics only
             pass
         self._maybe_upload_profile()
+
+        if self._released:
+            # Released by a shrink: exit quietly with the preemption
+            # shape. The coordinator absorbs the backend completion (the
+            # task left the matrix at the re-mesh) — reporting a result
+            # for a topology that no longer exists would only be fenced.
+            hb.stop()
+            log.warning("released from the gang by an elastic resize; "
+                        "exiting")
+            self._run_span.end(released=True)
+            self._flush_trace()
+            return constants.EXIT_PREEMPTED
 
         if self._orphaned_reason is not None:
             # The user process was stopped BY the orphan/fencing teardown:

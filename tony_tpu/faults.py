@@ -42,6 +42,19 @@ site                      where it fires
                           lease — the leased-executor-dead-on-adoption
                           shape; the backend must discard the lease and
                           cold-spawn instead
+``host.loss``             executor heartbeat loop: a firing SIGKILLs the
+                          user process group and hard-exits the executor
+                          (os._exit 137) — sudden whole-host death, the
+                          shape elastic shrink-and-continue absorbs;
+                          combine ``after:N``/``task:ID`` to fell one
+                          deterministic virtual host mid-run
+``resize.barrier``        coordinator elastic re-mesh, once per resize
+                          after the new topology is applied — a failed
+                          post-resize re-registration barrier; the resize
+                          aborts INFRA_TRANSIENT into the retry machinery
+``resize.remesh``         coordinator elastic re-mesh, once per resize
+                          before the member set is rebuilt — a failed
+                          topology application; same abort path
 ========================  =====================================================
 
 Spec grammar (the value of ``tony.fault.<site>`` conf keys, or one
@@ -96,7 +109,8 @@ SITES = ("rpc.connect", "rpc.send", "rpc.slow", "heartbeat",
          "executor.spawn", "storage.put", "storage.get", "checkpoint.save",
          "coordinator.crash", "executor.reregister",
          "user.hang", "user.slow_step",
-         "pool.lease", "pool.stale", "pool.adopt")
+         "pool.lease", "pool.stale", "pool.adopt",
+         "host.loss", "resize.barrier", "resize.remesh")
 
 
 class InjectedFault(ConnectionError):

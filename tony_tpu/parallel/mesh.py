@@ -80,6 +80,17 @@ class MeshSpec:
                 f"mesh {self} wants {known} devices, have {n_devices}")
         return MeshSpec(**dict(zip(MESH_AXES, sizes)))
 
+    def respec(self, n_devices: int) -> "MeshSpec":
+        """Re-solve this spec for a NEW device count — the elastic
+        shrink/grow recipe (coordinator/elastic.py): the model axes
+        (fsdp/pp/ep/sp/tp) keep their shapes so saved shards stay
+        compatible, and the pure-data axis ``dp`` absorbs the delta.
+        Raises when the fixed axes don't divide the new count (shrink
+        below the model-parallel footprint needs a different spec)."""
+        d = dict(zip(MESH_AXES, self.sizes()))
+        d["dp"] = -1
+        return MeshSpec(**d).resolve(n_devices)
+
     @classmethod
     def from_string(cls, s: str) -> "MeshSpec":
         """Parse ``"dp=2,tp=4"`` — the config-file form

@@ -62,6 +62,18 @@ def logical_sharding(mesh: Mesh, *logical_axes: str,
     return NamedSharding(mesh, spec)
 
 
+def reshard(tree: Any, shardings: Any) -> Any:
+    """Re-lay an in-memory pytree onto new shardings — the elastic
+    re-mesh path when state survives in host memory rather than on disk
+    (checkpoint restore covers the on-disk path: orbax's StandardRestore
+    re-lays-out onto whatever mesh the target shardings name).
+    ``jax.device_put`` moves each leaf shard-by-shard; cross-mesh moves
+    stage through host where devices disagree, which is exactly the
+    shrink/grow case."""
+    return jax.tree.map(lambda x, s: jax.device_put(x, s), tree,
+                        shardings)
+
+
 def param_shardings(mesh: Mesh, abstract_tree: Any,
                     rules: Sequence[Tuple[str, Any]] = DEFAULT_RULES) -> Any:
     """Map a tree of flax ``Partitioned`` metadata (from ``jax.eval_shape`` of
