@@ -442,7 +442,91 @@ def measure_token_file_point(cfg, batch, seq, steps, reps=3):
         shutil.rmtree(tmpdir, ignore_errors=True)
 
 
-def main():
+def measure_phase_point(steps=16, batch=64):
+    """Steady-state step-time attribution probe: a tiny telemetry-
+    instrumented loop (host batch build → H2D → block_until_ready'd
+    compute) through the SAME phase pipeline production jobs feed
+    (telemetry.phase → ring → phase_stats), recorded into the BENCH json
+    as per-phase seconds/step — so a future input-pipeline or dispatch
+    regression is attributable to a phase from the jsons alone
+    (`tony-tpu bench diff` compares these with the rest). Cheap by
+    design (an MLP, sub-second) and backend-agnostic: the CPU smoke run
+    records it too."""
+    import functools
+
+    import jax
+    import numpy as np
+    import optax
+
+    from tony_tpu import telemetry
+    from tony_tpu.models import MnistMLP
+    from tony_tpu.models.mlp import classification_loss
+    from tony_tpu.parallel import MeshSpec, build_mesh, init_sharded_state
+
+    telemetry._reset_phase_state()
+    mesh = build_mesh(MeshSpec())
+    model = MnistMLP(hidden=64)
+    rng = np.random.default_rng(0)
+    sample = jax.numpy.asarray(
+        rng.standard_normal((batch, 28, 28, 1), dtype=np.float32))
+    state, _ = _retry("init", lambda: init_sharded_state(
+        model, sample, optax.sgd(0.1), mesh))
+
+    @functools.partial(jax.jit, donate_argnums=0)
+    def one_step(state, x, y):
+        def loss(p):
+            return classification_loss(model.apply({"params": p}, x), y)
+        l, grads = jax.value_and_grad(loss)(state.params)
+        return state.apply_gradients(grads), l
+
+    # Warmup outside the attribution window (compile must not land in
+    # step_compute — same discipline as _time_scan).
+    x0 = jax.numpy.asarray(rng.standard_normal((batch, 28, 28, 1),
+                                               dtype=np.float32))
+    y0 = jax.numpy.asarray(rng.integers(0, 10, size=batch))
+    state, l = one_step(state, x0, y0)
+    jax.block_until_ready(l)
+    telemetry._reset_phase_state()
+    for _ in range(steps):
+        with telemetry.step():
+            with telemetry.phase("data_wait"):
+                xb = rng.standard_normal((batch, 28, 28, 1),
+                                         dtype=np.float32)
+                yb = rng.integers(0, 10, size=batch)
+            with telemetry.phase("h2d"):
+                x = jax.device_put(jax.numpy.asarray(xb))
+                y = jax.device_put(jax.numpy.asarray(yb))
+            with telemetry.phase("step_compute") as p:
+                state, l = one_step(state, x, y)
+                p.block_until_ready(l)
+    stats = telemetry.phase_stats()
+    n = max(1.0, float(stats.get("steps", 1.0)))
+    per_step = {k: round(v / n, 6)
+                for k, v in (stats.get("cum") or {}).items()}
+    from tony_tpu.profiling import classify, phase_fractions
+
+    fr = phase_fractions(stats.get("cum") or {},
+                         float(stats.get("wall_s", 0.0)))
+    return {"step_phases_s": per_step,
+            "seconds_per_step": round(
+                float(stats.get("wall_s", 0.0)) / n, 6),
+            "verdict": classify(fr)["category"] if fr else None,
+            "steps": int(n), "batch": batch}
+
+
+def main(argv=None):
+    import argparse
+
+    ap = argparse.ArgumentParser(prog="bench.py")
+    ap.add_argument("--against", default="",
+                    help="baseline bench json (raw or BENCH_r*): after "
+                         "the run, diff this run's numbers against it "
+                         "(tony-tpu bench diff) and exit nonzero on "
+                         "regression")
+    ap.add_argument("--tolerance", type=float, default=0.10,
+                    help="relative regression tolerance for --against")
+    args = ap.parse_args(argv)
+
     detail = {}
 
     # Phase 0 — BEFORE backend init (see module docstring).
@@ -568,6 +652,17 @@ def main():
             print(f"# big point failed: {e}", file=sys.stderr)
             detail["big_0p95b_remat_bf16mu"] = {"error": str(e)[:300]}
 
+    # Steady-state phase-attribution probe (any backend): the per-phase
+    # seconds/step the regression gate diffs alongside the headline.
+    if os.environ.get("TONY_BENCH_PHASES", "1") != "0":
+        try:
+            detail["phase_probe"] = _retry(
+                "phase-probe", measure_phase_point, attempts=2,
+                backoff_s=2.0)
+        except Exception as e:  # noqa: BLE001 — never kill the headline
+            print(f"# phase probe failed: {e}", file=sys.stderr)
+            detail["phase_probe"] = {"error": str(e)[:300]}
+
     kind = jax.devices()[0].device_kind if on_tpu else ""
     baseline_path = os.path.join(REPO, "BENCH_BASELINE.json")
     vs_baseline = 1.0
@@ -596,14 +691,32 @@ def main():
                          "params; d=64 measured 51.4k tok/s on this chip "
                          "— +26% is geometry, the rest software)",
     })
-    print(json.dumps({
+    doc = {
         "metric": "transformer_train_tokens_per_sec_per_chip",
         "value": headline["tokens_per_sec"],
         "unit": "tokens/s",
         "vs_baseline": round(vs_baseline, 4) if vs_baseline is not None
         else None,
         "detail": detail,
-    }))
+    }
+    print(json.dumps(doc))
+
+    if args.against:
+        # Regression gate (tony_tpu/profiling/benchdiff.py): compare
+        # this run against the given baseline json; a regression past
+        # the tolerance fails the bench run loudly — the r04→r05
+        # cold-start regression sat unnoticed precisely because nothing
+        # diffed consecutive BENCH jsons.
+        from tony_tpu.profiling import benchdiff
+
+        with open(args.against) as f:
+            base = json.load(f)
+        result = benchdiff.diff_bench(base, doc,
+                                      tolerance=args.tolerance)
+        print(benchdiff.format_report(result, args.against,
+                                      "(this run)"), file=sys.stderr)
+        if result["regressions"]:
+            sys.exit(1)
 
 
 if __name__ == "__main__":

@@ -327,3 +327,80 @@ def test_mesh_respec_keeps_model_axes():
     assert smaller.tp == 4 and smaller.dp == 1
     with pytest.raises(ValueError):
         spec.respec(6)                       # 6 not divisible by tp=4
+
+
+# ---------------------------------------------------------------------------
+# Hang absorption (PR 8 carry-over): a TASK_HUNG kill verdict on an
+# elastic member is drained out via resize like a host loss — same
+# epoch, no INFRA_TRANSIENT retry burned. Chief hangs keep the ordinary
+# fail-the-epoch hang-kill path.
+# ---------------------------------------------------------------------------
+def _hang_coord(tmp_path, sub="a"):
+    from tony_tpu.cluster.local import LocalProcessBackend
+    from tony_tpu.coordinator.coordinator import Coordinator
+
+    conf = _conf(workers=4)
+    conf.set("tony.worker.command", "true")
+    conf.set(K.TASK_PROGRESS_TIMEOUT_S, 5)
+    backend = LocalProcessBackend(str(tmp_path / f"work-{sub}"))
+    coord = Coordinator(conf, f"app_hang_{sub}", backend,
+                        str(tmp_path / "history"), user="t")
+    for i in range(4):
+        coord.register_worker_spec(f"worker:{i}", "h", 1000 + i,
+                                   session_id=0)
+    coord.elastic.established = True
+    return coord
+
+
+def _close_coord(coord):
+    coord.journal.close()
+    coord.rpc._server.server_close()
+
+
+def test_hung_elastic_member_absorbed_as_resize(tmp_path):
+    from tony_tpu.coordinator import liveness
+    from tony_tpu.coordinator.session import SessionStatus
+    from tony_tpu.events.events import EventType
+
+    coord = _hang_coord(tmp_path)
+    events = []
+    coord.events.emit = events.append
+    try:
+        coord.progress.poll = lambda: [liveness.Action(
+            liveness.HANG_KILL, "worker:2",
+            {"stalled_s": 12.0, "timeout_s": 5, "steps": 40.0})]
+        coord._check_progress()
+        t = coord.session.get_task("worker:2")
+        assert t.status.terminal
+        # absorbed: session still RUNNING, no retry budget consumed,
+        # a resize op is in flight at the shrunken membership
+        assert coord.session.status == SessionStatus.RUNNING
+        assert coord._infra_retries_used == 0
+        assert coord.elastic.resizing
+        assert coord.elastic.op.members == [0, 1, 3]
+        fin = [e for e in events if e.type == EventType.TASK_FINISHED]
+        assert fin and fin[0].payload["resize"] is True
+        assert "hung" in fin[0].payload["reason"]
+        started = [e for e in events
+                   if e.type == EventType.GANG_RESIZED]
+        assert started and started[0].payload["phase"] == "started"
+    finally:
+        _close_coord(coord)
+
+
+def test_hung_chief_keeps_ordinary_hang_kill_path(tmp_path):
+    from tony_tpu.coordinator import liveness
+    from tony_tpu.coordinator.session import SessionStatus
+
+    coord = _hang_coord(tmp_path, sub="b")
+    try:
+        coord.progress.poll = lambda: [liveness.Action(
+            liveness.HANG_KILL, "worker:0",
+            {"stalled_s": 12.0, "timeout_s": 5, "steps": 40.0})]
+        coord._check_progress()
+        # the chief is never absorbable: epoch fails into retry machinery
+        assert coord.session.status == SessionStatus.FAILED
+        assert not coord.elastic.resizing
+        assert "hung" in coord.session.failure_reason
+    finally:
+        _close_coord(coord)

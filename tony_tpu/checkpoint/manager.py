@@ -32,7 +32,7 @@ import logging
 import os
 from typing import Any, Dict, List, Optional
 
-from tony_tpu import faults
+from tony_tpu import faults, telemetry
 
 log = logging.getLogger(__name__)
 
@@ -105,9 +105,13 @@ class CheckpointManager:
         faults.check("checkpoint.save")
         self._busy = True
         try:
-            saved = self._mgr.save(
-                int(step), args=self._ocp.args.StandardSave(state),
-                force=force)
+            # Step-time attribution rides for free: whatever the (async)
+            # save enqueue blocks the training thread for IS the step's
+            # checkpoint stall — telemetry's ckpt_stall phase.
+            with telemetry.phase("ckpt_stall"):
+                saved = self._mgr.save(
+                    int(step), args=self._ocp.args.StandardSave(state),
+                    force=force)
         finally:
             self._busy = False
             self._run_deferred_preemption()
@@ -396,7 +400,10 @@ class CheckpointManager:
         durable steps then get their integrity manifest."""
         self._busy = True
         try:
-            self._mgr.wait_until_finished()
+            # A mid-training wait() is exactly the stall async
+            # checkpointing exists to avoid — attribute it.
+            with telemetry.phase("ckpt_stall"):
+                self._mgr.wait_until_finished()
             self._flush_manifests()
         finally:
             self._busy = False

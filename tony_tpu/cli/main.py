@@ -317,6 +317,72 @@ def _fmt_progress(task: dict) -> str:
     return out
 
 
+def _cmd_profile(args: argparse.Namespace) -> int:
+    """On-demand device capture from a RUNNING job: sends a PROFILE
+    directive (riding the heartbeat response) to the chosen task, which
+    arms jax.profiler at its next step boundary for N steps; polls until
+    the artifact lands in the job dir (portal /profile/<app> lists it).
+    A failed/unsupported capture reports PROFILE_FAILED and the job
+    keeps training — this command can never hurt a live job."""
+    rpc = _coordinator_rpc(args.app_id, args.workdir)
+    if rpc is None:
+        print(f"no coordinator address for {args.app_id} under "
+              f"{_default_workdir(args.workdir)} (job finished? wrong "
+              f"--workdir?) — on-demand profiling needs a live job",
+              file=sys.stderr)
+        return 1
+    try:
+        res = rpc.call("profile.start", steps=args.steps,
+                       task=args.task or "")
+        if not isinstance(res, dict) or not res.get("ok"):
+            msg = res.get("message", "refused") \
+                if isinstance(res, dict) else str(res)
+            print(f"profile refused: {msg}", file=sys.stderr)
+            return 1
+        req_id = res["id"]
+        print(f"profiling {res['task']} for {res['steps']} step(s) "
+              f"(request {req_id}) — waiting for the capture...")
+        deadline = time.monotonic() + args.timeout
+        while time.monotonic() < deadline:
+            st = rpc.call("profile.status")
+            req = next((r for r in st.get("requests", [])
+                        if r.get("id") == req_id), None)
+            if req and req.get("status") == "captured":
+                print(f"captured: {req['dir']}")
+                print("open it in TensorBoard's profile plugin or "
+                      "Perfetto; the portal lists it at "
+                      f"/profile/{args.app_id}")
+                return 0
+            if req and req.get("status") == "failed":
+                print(f"capture FAILED: {req.get('error', '?')} "
+                      f"(the job keeps training)", file=sys.stderr)
+                return 1
+            time.sleep(args.interval)
+        print(f"capture still pending after {args.timeout:.0f}s (is the "
+              f"task stepping? check `tony-tpu top {args.app_id}`)",
+              file=sys.stderr)
+        return 1
+    except Exception as e:  # noqa: BLE001
+        print(f"profile failed (coordinator gone?): {e}", file=sys.stderr)
+        return 1
+    finally:
+        rpc.close()
+
+
+def _cmd_bench(args: argparse.Namespace) -> int:
+    """`tony-tpu bench diff <base.json> <candidate.json>` — the bench
+    regression gate (tony_tpu/profiling/benchdiff.py): nonzero exit when
+    the candidate regresses any comparable metric (headline throughput,
+    cold-start phases, step phases) past the tolerance."""
+    from tony_tpu.profiling import benchdiff
+
+    argv = [args.base, args.candidate, "--tolerance",
+            str(args.tolerance)]
+    if args.json:
+        argv.append("--json")
+    return benchdiff.main(argv)
+
+
 _SPARK_BLOCKS = "▁▂▃▄▅▆▇█"
 
 
@@ -339,10 +405,33 @@ def _fmt_bytes(n) -> str:
     return "?"
 
 
+#: phase → bar glyph, in canonical draw order (tony_tpu/profiling/):
+#: d=data_wait h=h2d C=step_compute m=comms k=ckpt_stall e=eval ·=other
+_PHASE_GLYPHS = (("data_wait", "d"), ("h2d", "h"), ("step_compute", "C"),
+                 ("comms", "m"), ("ckpt_stall", "k"), ("eval", "e"),
+                 ("other", "·"))
+
+
+def _phase_bar(fractions: dict, width: int = 12) -> str:
+    """Proportional per-phase bar for a top row: 'dddCCCCCCCC·' means
+    ~25% input wait, ~67% compute, ~8% unattributed."""
+    if not fractions:
+        return ""
+    out = []
+    for name, glyph in _PHASE_GLYPHS:
+        try:
+            n = int(round(float(fractions.get(name, 0.0)) * width))
+        except (TypeError, ValueError):
+            n = 0
+        out.append(glyph * n)
+    return "".join(out)[:width + 2]
+
+
 def _render_top(snap: dict) -> str:
     """One frame of the `tony-tpu top` live view from a metrics.live
     snapshot: per-task utilization + heartbeat age + a steps/s sparkline
-    (the coordinator's ring-buffer series)."""
+    (the coordinator's ring-buffer series) + the per-phase step-time
+    attribution bar and the live bottleneck verdict."""
     gang = snap.get("gang_size") or {}
     gang_col = "  gang=" + ",".join(
         f"{j}×{n}" for j, n in sorted(gang.items())) if gang else ""
@@ -353,10 +442,14 @@ def _render_top(snap: dict) -> str:
     lines = [f"{snap.get('app_id', '?')}  status={snap.get('status', '?')}"
              f"  epoch={snap.get('session_id', '?')}"
              f"  generation={snap.get('generation', '?')}"
-             f"{gang_col}{mgen_col}",
-             f"{'TASK':<14}{'STATUS':<11}{'STEPS':>8}{'STEPS/S':>9}"
-             f"{'MFU':>7}{'HBM':>10}{'RSS':>10}{'HB AGE':>8}  "
-             f"{'STATE':<11}TREND"]
+             f"{gang_col}{mgen_col}"]
+    perf = snap.get("perf") or {}
+    if perf.get("verdict"):
+        lines.append(f"perf: {perf['verdict']} — {perf.get('summary', '')}")
+    lines.append(
+        f"{'TASK':<14}{'STATUS':<11}{'STEPS':>8}{'STEPS/S':>9}"
+        f"{'MFU':>7}{'HBM':>10}{'RSS':>10}{'HB AGE':>8}  "
+        f"{'STATE':<11}{'PHASES':<14}TREND")
     for t in snap.get("tasks", []):
         steps = t.get("steps")
         rate = t.get("steps_per_sec")
@@ -371,6 +464,7 @@ def _render_top(snap: dict) -> str:
             f"{_fmt_bytes(t.get('rss_bytes')):>10}"
             f"{(f'{hb:.1f}s' if hb is not None else '-'):>8}  "
             f"{t.get('state', '') or '-':<11}"
+            f"{_phase_bar(t.get('phases') or {}) or '-':<14}"
             f"{_sparkline(t.get('steps_per_sec_history', []))}")
     return "\n".join(lines)
 
@@ -926,6 +1020,40 @@ def build_parser() -> argparse.ArgumentParser:
     tp.add_argument("--once", action="store_true",
                     help="print one snapshot and exit (scripts/tests)")
     tp.set_defaults(fn=_cmd_top)
+
+    pf = sub.add_parser(
+        "profile",
+        help="capture a device trace from a RUNNING job without "
+             "restarting it: the target task arms jax.profiler at its "
+             "next step boundary for N steps; the artifact lands under "
+             "the job dir (portal /profile/<app>)")
+    pf.add_argument("app_id")
+    pf.add_argument("--steps", type=int, default=0,
+                    help="steps to capture (default: "
+                         "tony.profile.default-steps)")
+    pf.add_argument("--task", default="",
+                    help="task to profile, e.g. worker:1 (default: the "
+                         "chief)")
+    pf.add_argument("--workdir", help="client workdir the job was "
+                                      "submitted from (default ~/.tony-tpu)")
+    pf.add_argument("--timeout", type=float, default=120.0,
+                    help="seconds to wait for the capture (default 120)")
+    pf.add_argument("--interval", type=float, default=1.0,
+                    help="status poll cadence in seconds")
+    pf.set_defaults(fn=_cmd_profile)
+
+    bn = sub.add_parser(
+        "bench",
+        help="bench utilities: `bench diff <base.json> <candidate.json>` "
+             "compares headline + per-phase numbers with a tolerance and "
+             "exits nonzero on regression (the BENCH_r* gate)")
+    bn_sub = bn.add_subparsers(dest="bench_cmd", required=True)
+    bd = bn_sub.add_parser("diff", help="compare two bench jsons")
+    bd.add_argument("base")
+    bd.add_argument("candidate")
+    bd.add_argument("--tolerance", type=float, default=0.10)
+    bd.add_argument("--json", action="store_true")
+    bd.set_defaults(fn=_cmd_bench)
 
     tr = sub.add_parser(
         "trace",

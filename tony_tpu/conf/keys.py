@@ -394,6 +394,27 @@ METRICS_EXPORT_INTERVAL_S = _key(
     "into <job_dir>/metrics.prom (the portal /metrics scrape source) and "
     "snapshots counters for recovery. Control-plane-rate, not per-step.")
 
+# --- on-demand device profiling (tony_tpu/telemetry.py capture agent) -----
+PROFILE_ENABLED = _key(
+    "tony.profile.enabled", True, bool,
+    "On-demand device profiling: `tony-tpu profile <app>` rides a "
+    "PROFILE directive on the heartbeat response, the target task arms "
+    "jax.profiler at its next step boundary for N steps, and the trace "
+    "artifact lands under <job_dir>/profile/ (portal /profile/<app>). "
+    "Off = profile.start RPCs are refused (the static chief-only "
+    "tony.application.profiler-enabled contract is unaffected).")
+PROFILE_DEFAULT_STEPS = _key(
+    "tony.profile.default-steps", 5, int,
+    "Steps one on-demand capture brackets when `tony-tpu profile` is "
+    "invoked without --steps. Captures start and stop at step "
+    "boundaries, so N steps means N whole steps of device timeline.")
+PROFILE_MAX_ARTIFACTS = _key(
+    "tony.profile.max-artifacts", 8, int,
+    "Ceiling on on-demand trace artifacts per job: profile.start is "
+    "refused once <job_dir>/profile holds this many ondemand-* capture "
+    "dirs (device traces are tens of MB each; an unbounded poll loop "
+    "must not fill the history volume). Delete old dirs to make room.")
+
 # --- automatic failure diagnosis (tony_tpu/diagnosis/) --------------------
 DIAGNOSIS_ENABLED = _key(
     "tony.diagnosis.enabled", True, bool,
@@ -632,6 +653,12 @@ FAULT_RESIZE_REMESH = _key(
     "Fail the application of an elastic resize's new topology (checked "
     "once per resize, before the member set is rebuilt): the resize "
     "aborts into an INFRA_TRANSIENT epoch failure.")
+FAULT_PROFILE_CAPTURE = _key(
+    "tony.fault.profile-capture", "", str,
+    "Fail an on-demand device capture at the step boundary that would "
+    "arm jax.profiler (unsupported runtime / profiler crash shape): the "
+    "task reports PROFILE_FAILED on its next beat and training "
+    "continues — capture must never kill or stall the job.")
 
 # --- warm executor pool (tony_tpu/pool.py) --------------------------------
 POOL_DIR = _key(
@@ -752,7 +779,7 @@ _JOB_KEY_RE: Pattern[str] = re.compile(
 _RESERVED_NON_JOB_SEGMENTS = {
     "application", "task", "coordinator", "client", "history", "tpu", "portal",
     "keep-failed-task-dirs", "internal", "fault", "rpc", "trace", "metrics",
-    "diagnosis", "pool", "elastic",
+    "diagnosis", "pool", "elastic", "profile",
 }
 
 

@@ -170,6 +170,19 @@ PROFILE_DIR = "TONY_PROFILE_DIR"
 # remote store is configured — the chief's host can't write the
 # coordinator's job dir directly; the coordinator pulls them back at stop).
 PROFILE_UPLOAD = "TONY_PROFILE_UPLOAD"
+# On-demand device profiling (tony-tpu profile <app>): path of the JSON
+# request file the executor writes when a PROFILE directive rides the
+# heartbeat response; the user process's telemetry reporter polls it and
+# arms jax.profiler at the next step boundary (tony_tpu/telemetry.py).
+PROFILE_REQUEST_ENV = "TONY_PROFILE_REQUEST_FILE"
+# Basename of that request file in the task working dir (atomic replace;
+# the reader tolerates a torn/absent file by ignoring it).
+PROFILE_REQUEST_FILE = "profile-request.json"
+# Step-time attribution report the coordinator writes into the job dir at
+# finish (tony_tpu/profiling/verdict.py): per-phase seconds/fractions and
+# the bottleneck verdict. Atomically replaced; torn/absent reads degrade
+# to "no perf advisory".
+PERF_FILE = "perf.json"
 
 # ---------------------------------------------------------------------------
 # Fault-injection test hooks, honoured by production code exactly like the

@@ -124,6 +124,15 @@ class _RpcService:
         """Live per-task utilization snapshot (the `tony-tpu top` feed)."""
         return self._c.metrics_live()
 
+    def profile__start(self, steps: int = 0, task: str = "") -> dict:
+        """On-demand device capture (`tony-tpu profile <app>`): arm
+        jax.profiler on a RUNNING task at its next step boundary."""
+        return self._c.profile_start(int(steps or 0), str(task or ""))
+
+    def profile__status(self) -> dict:
+        """Poll surface for the profile CLI: every request + its state."""
+        return self._c.profile_status()
+
     def trace__push(self, records) -> int:
         """Executor/client span intake: remote spans land in the job's
         span log, stitching the cross-process trace tree."""
@@ -224,6 +233,18 @@ class Coordinator:
         faults.install_from_conf(conf)
         self._last_hb: Dict[str, float] = {}
         self._hb_lock = threading.Lock()
+        # Step-time attribution (tony_tpu/profiling/): the latest phase
+        # beacon per task — cumulative per-phase seconds + attributed
+        # wall. Values are replaced whole (never mutated), so readers
+        # (metrics_live, the perf.json writer) take a dict() snapshot.
+        self._phase_latest: Dict[str, dict] = {}
+        # On-demand device profiling: task_id → request dict. Directives
+        # ride heartbeat responses until the task's beacon reports a
+        # terminal status (the PR 3 dump / PR 8 RESIZE pattern, deduped
+        # executor-side by the monotonic request id).
+        self._profile_reqs: Dict[str, dict] = {}
+        self._profile_seq = 0
+        self._profile_lock = threading.Lock()
         # Progress-based liveness on top of the heartbeat monitor
         # (coordinator/liveness.py): executors piggyback step-counter
         # beacons on heartbeats; this tracker turns frozen counters into
@@ -419,6 +440,23 @@ class Coordinator:
                             float(m[src]))
                     except (TypeError, ValueError):
                         continue
+        ph = progress.get("phases")
+        if isinstance(ph, dict) and isinstance(ph.get("cum"), dict):
+            for name, secs in ph["cum"].items():
+                try:
+                    self.metrics.gauge(
+                        "tony_step_phase_seconds",
+                        {**labels, "phase": str(name)},
+                        help="Cumulative seconds of step wall time "
+                             "attributed to each phase "
+                             "(telemetry.phase; 'other' = unattributed)."
+                    ).set(float(secs))
+                except (TypeError, ValueError):
+                    continue
+            self._phase_latest[task_id] = dict(ph)
+        prof = progress.get("profile")
+        if isinstance(prof, dict):
+            self._observe_profile_beacon(task_id, prof)
         rpc = progress.get("rpc")
         if isinstance(rpc, dict):
             self.metrics.set_histogram_snapshot(
@@ -504,6 +542,22 @@ class Coordinator:
                 "tony_task_steps_per_sec", labels)
             if history_v:
                 row["steps_per_sec_history"] = history_v[-32:]
+            ph = self._phase_latest.get(t.task_id)
+            if ph:
+                # Recent-window attribution preferred (the live view
+                # should show what the step is doing NOW, not the job
+                # average); falls back to cumulative.
+                from tony_tpu.profiling import phase_fractions
+
+                recent = ph.get("recent")
+                if isinstance(recent, dict) and ph.get("recent_wall_s"):
+                    fr = phase_fractions(recent, ph["recent_wall_s"])
+                else:
+                    fr = phase_fractions(ph.get("cum") or {},
+                                         ph.get("wall_s", 0.0))
+                if fr:
+                    row["phases"] = {k: round(v, 4)
+                                     for k, v in fr.items()}
             last = hb.get(t.task_id)
             if last is not None:
                 row["heartbeat_age_s"] = round(now - last, 3)
@@ -514,9 +568,153 @@ class Coordinator:
                 "gang_size": {name: job.instances
                               for name, job in self.session.jobs.items()},
                 "tasks": tasks}
+        phase_snapshot = dict(self._phase_latest)
+        if phase_snapshot:
+            # Live bottleneck verdict over the wall-weighted aggregate —
+            # the `top` header line every item-4 perf PR is aimed by.
+            from tony_tpu import profiling
+
+            doc = profiling.build_perf_report(self.app_id, phase_snapshot)
+            if doc.get("verdict"):
+                snap["perf"] = {"verdict": doc["verdict"]["category"],
+                                "summary": doc["verdict"]["summary"],
+                                "fractions": doc["fractions"]}
         if self.elastic is not None:
             snap["elastic"] = self.elastic.snapshot()
         return snap
+
+    # ------------------------------------------------------------------
+    # On-demand device profiling (tony-tpu profile <app>)
+    # ------------------------------------------------------------------
+    def profile_start(self, steps: int = 0, task: str = "") -> dict:
+        """Arm an on-demand capture: pick the target task (explicit, or
+        the chief), allocate a monotonic request id, and let the PROFILE
+        directive ride the target's heartbeat responses until its beacon
+        reports the result. Refused when disabled, when the task is not
+        running, or at the artifact ceiling — never fails the job."""
+        if not self.conf.get_bool(K.PROFILE_ENABLED, True):
+            return {"ok": False,
+                    "message": "on-demand profiling is disabled "
+                               "(tony.profile.enabled=false)"}
+        steps = steps or self.conf.get_int(K.PROFILE_DEFAULT_STEPS, 5)
+        target = None
+        if task:
+            t = self.session.get_task(task)
+            if t is None or t.status.terminal:
+                return {"ok": False,
+                        "message": f"task {task!r} is not running"}
+            target = t
+        else:
+            live = [t for t in self.session.all_tasks()
+                    if not t.status.terminal]
+            for t in live:
+                if self.session.is_chief(t.job_name, t.index):
+                    target = t
+                    break
+            target = target or (live[0] if live else None)
+        if target is None:
+            return {"ok": False, "message": "no running task to profile"}
+        profile_root = os.path.join(self.job_dir, "profile")
+        try:
+            existing = sum(1 for d in os.listdir(profile_root)
+                           if d.startswith("ondemand-"))
+        except OSError:
+            existing = 0
+        max_artifacts = self.conf.get_int(K.PROFILE_MAX_ARTIFACTS, 8)
+        if existing >= max_artifacts:
+            return {"ok": False,
+                    "message": f"{existing} on-demand artifact(s) "
+                               f"already under {profile_root} (ceiling "
+                               f"tony.profile.max-artifacts="
+                               f"{max_artifacts}); delete old captures"}
+        with self._profile_lock:
+            self._profile_seq += 1
+            req_id = self._profile_seq
+            req = {"id": req_id, "task": target.task_id,
+                   "steps": int(steps),
+                   "dir": os.path.join(
+                       profile_root,
+                       f"ondemand-{req_id:03d}-"
+                       f"{target.task_id.replace(':', '-')}"),
+                   "status": "requested"}
+            self._profile_reqs[target.task_id] = req
+            out = dict(req)
+        log.warning("profile: capture of %d step(s) requested on %s "
+                    "(request %d) — arming at its next step boundary",
+                    steps, target.task_id, req_id)
+        return {"ok": True, **out}
+
+    def profile_status(self) -> dict:
+        with self._profile_lock:
+            return {"requests": [dict(r)
+                                 for r in self._profile_reqs.values()]}
+
+    def _profile_directive(self, task_id: str) -> Optional[dict]:
+        """The heartbeat-response payload for a pending capture (re-sent
+        every beat — the executor dedups by id); None once terminal."""
+        with self._profile_lock:
+            req = self._profile_reqs.get(task_id)
+            if req is None or req["status"] in ("captured", "failed"):
+                return None
+            return {"id": req["id"], "steps": req["steps"],
+                    "dir": req["dir"]}
+
+    def _observe_profile_beacon(self, task_id: str, prof: dict) -> None:
+        """Match a beacon's capture status to our request; emit
+        TASK_PROFILED exactly once on the terminal transition."""
+        try:
+            beacon_id = int(prof.get("id", 0))
+        except (TypeError, ValueError):
+            return
+        status = str(prof.get("status", "") or "")
+        emit_payload = None
+        with self._profile_lock:
+            req = self._profile_reqs.get(task_id)
+            if req is None or beacon_id != req["id"]:
+                return
+            if status == "active" and req["status"] == "requested":
+                req["status"] = "active"
+            elif status in ("captured", "failed") \
+                    and req["status"] not in ("captured", "failed"):
+                req["status"] = status
+                if prof.get("dir"):
+                    req["dir"] = str(prof["dir"])
+                if prof.get("error"):
+                    req["error"] = str(prof["error"])[:300]
+                emit_payload = dict(req)
+        if emit_payload is not None:
+            emit_payload["session_id"] = self.session.session_id
+            self.events.emit(Event(EventType.TASK_PROFILED, emit_payload))
+            if emit_payload["status"] == "captured":
+                log.warning("profile: request %d captured %s step(s) on "
+                            "%s — artifact at %s", emit_payload["id"],
+                            emit_payload["steps"], task_id,
+                            emit_payload["dir"])
+            else:
+                log.warning("profile: request %d FAILED on %s: %s "
+                            "(training continues)", emit_payload["id"],
+                            task_id, emit_payload.get("error", "?"))
+
+    def _write_perf_report(self) -> None:
+        """<job_dir>/perf.json at finish: phase totals + the bottleneck
+        verdict over the job's steady-state step-time attribution. Only
+        written when at least one task beaconed phases (a non-telemetry
+        job has nothing to attribute). Best-effort by contract."""
+        snapshot = dict(self._phase_latest)
+        if not snapshot:
+            return
+        try:
+            from tony_tpu import profiling
+
+            doc = profiling.build_perf_report(
+                self.app_id, snapshot, status=self.final_status.value)
+            profiling.save_perf(
+                os.path.join(self.job_dir, constants.PERF_FILE), doc)
+            v = doc.get("verdict") or {}
+            log.warning("perf: %s — %s (perf.json written)",
+                        v.get("category", "?"), v.get("summary", ""))
+        except Exception:  # noqa: BLE001 — reporting must never fail a job
+            log.exception("perf.json write failed")
 
     def ingest_trace_records(self, records) -> int:
         return self.tracer.write_records(records)
@@ -835,6 +1033,9 @@ class Coordinator:
             directive = self.elastic.directive_for(task_id)
             if directive is not None:
                 resp["resize"] = directive
+        profile = self._profile_directive(task_id)
+        if profile is not None:
+            resp["profile"] = profile
         if resp:
             return {"ok": True, **resp}
         return True
@@ -1332,13 +1533,24 @@ class Coordinator:
                     action.info.get("window_s"))
                 self.events.emit(Event(EventType.TASK_STRAGGLER, payload))
             elif action.kind == liveness.HANG_KILL:
+                reason = (f"task {action.task_id} hung: heartbeats alive "
+                          f"but no step progress for "
+                          f"{action.info.get('stalled_s', 0.0):.0f}s "
+                          f"(progress deadline "
+                          f"{action.info.get('timeout_s')}s)")
+                # Elastic hang absorption (PR 8 carry-over): a hung
+                # elastic member is drained out via resize like a host
+                # loss — same epoch, no INFRA_TRANSIENT retry burned —
+                # instead of failing the epoch. The absorb policy itself
+                # (chief, min-tasks, elasticity off) decides; refusals
+                # fall through to the ordinary hang-kill path.
+                if self._absorb_task_loss(
+                        t, constants.EXIT_KILLED,
+                        FailureDomain.INFRA_TRANSIENT.value,
+                        reason=reason, kill=True):
+                    continue
                 self._kill_unhealthy_task(
-                    t, f"task {action.task_id} hung: heartbeats alive "
-                       f"but no step progress for "
-                       f"{action.info.get('stalled_s', 0.0):.0f}s "
-                       f"(progress deadline "
-                       f"{action.info.get('timeout_s')}s)",
-                    action.info, capture_dump=True)
+                    t, reason, action.info, capture_dump=True)
             elif action.kind == liveness.STRAGGLER_KILL:
                 self._kill_unhealthy_task(
                     t, f"task {action.task_id} proactively restarted as "
@@ -1611,6 +1823,9 @@ class Coordinator:
             # Postmortem extracts belong to the old epoch's processes —
             # a stale traceback must not attach to the new gang's exits.
             self._task_diag.clear()
+            # Phase attribution belongs to the old gang's user processes
+            # (fresh processes restart their telemetry counters at 0).
+            self._phase_latest.clear()
             self._worker_termination_done = False
             if self.elastic is not None:
                 # The retry epoch relaunches at the CONFIGURED size; the
@@ -1920,6 +2135,9 @@ class Coordinator:
                                                      "profile"))
             except Exception as e:  # noqa: BLE001 — teardown best-effort
                 log.warning("profile trace localization failed: %s", e)
+        # Step-time attribution report BEFORE diagnosis: the incident
+        # bundle attaches perf.json as its perf advisory section.
+        self._write_perf_report()
         self.events.emit(Event(EventType.APPLICATION_FINISHED, {
             "app_id": self.app_id, "status": self.final_status.value,
             "failure_reason": self.session.failure_reason or "",

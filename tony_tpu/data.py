@@ -32,6 +32,7 @@ import jax
 import numpy as np
 from jax.sharding import Mesh, NamedSharding
 
+from tony_tpu import telemetry
 from tony_tpu.parallel.mesh import batch_sharding as global_batch_sharding
 
 
@@ -143,8 +144,13 @@ class ShardedBatchIterator:
                 return                  # consumer re-raises; don't spin
 
     def __next__(self) -> Dict[str, Any]:
+        # Step-time attribution rides for free: the consumer-side wait —
+        # the whole assemble when synchronous, the queue wait when the
+        # prefetch worker is behind, ~0 when it is ahead — IS the
+        # training loop's input stall, telemetry's data_wait phase.
         if self.prefetch <= 0:
-            batch = self._assemble(self._consumed)
+            with telemetry.phase("data_wait"):
+                batch = self._assemble(self._consumed)
             self._consumed += 1
             return batch
         if self._worker is None:
@@ -160,7 +166,8 @@ class ShardedBatchIterator:
                 args=(self._stop_evt, self._q, self._step),
                 daemon=True)
             self._worker.start()
-        item = self._q.get()
+        with telemetry.phase("data_wait"):
+            item = self._q.get()
         if isinstance(item, _PrefetchError):
             self.close()
             raise item.exc

@@ -99,10 +99,11 @@ _ARTIFACTS = (
     "ready.json", "lease.json", "adopted.json", "pool-exit.json",
     "pool.addr", "tony-final.json", "session.journal", "incident.json",
     "metrics.counters", "tony-manifest", ".tony-localized",
+    "perf.json", "profile-request.json",
     "READY_FILE", "LEASE_FILE", "ADOPTED_FILE", "POOL_EXIT_FILE",
     "POOL_ADDR_FILE", "FINAL_CONFIG_FILE", "JOURNAL_FILE",
     "INCIDENT_FILE", "METRICS_COUNTERS_FILE", "MANIFEST_NAME",
-    "MANIFEST_FILE", "addr_file",
+    "MANIFEST_FILE", "addr_file", "PERF_FILE", "PROFILE_REQUEST_FILE",
 )
 
 #: attribute names whose call blocks (or can block) the calling thread —

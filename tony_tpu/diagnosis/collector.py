@@ -92,6 +92,9 @@ class IncidentBundle:
     spans: List[dict] = dataclasses.field(default_factory=list)
     metrics_prom: str = ""
     config: Dict[str, Any] = dataclasses.field(default_factory=dict)
+    #: step-time attribution report (<job_dir>/perf.json, written by the
+    #: coordinator at finish) — the diagnose perf advisory source.
+    perf: Dict[str, Any] = dataclasses.field(default_factory=dict)
     tasks: Dict[str, TaskIncident] = dataclasses.field(default_factory=dict)
     log_tails: Dict[str, str] = dataclasses.field(default_factory=dict)
     generations: List[int] = dataclasses.field(default_factory=list)
@@ -211,6 +214,13 @@ def collect(job_dir: str, app_id: str = "",
             bundle.config = _scrub_config(json.load(f))
     except (OSError, ValueError):
         bundle.config = {}
+    try:
+        with open(os.path.join(job_dir, constants.PERF_FILE),
+                  encoding="utf-8") as f:
+            perf = json.load(f)
+        bundle.perf = perf if isinstance(perf, dict) else {}
+    except (OSError, ValueError):
+        bundle.perf = {}
 
     for rec in bundle.journal:
         t = rec.get("t")

@@ -91,6 +91,26 @@ def build_timeline(bundle: IncidentBundle) -> List[Dict[str, Any]]:
     return out
 
 
+def _perf_advisory(perf: Dict[str, Any]) -> Optional[Dict[str, Any]]:
+    """Condense <job_dir>/perf.json into the incident's perf advisory:
+    the bottleneck verdict + phase fractions. Orthogonal to the failure
+    verdict by design — 'the job died of X, and while it ran it was
+    INPUT_BOUND' are two different answers an operator wants together.
+    None when the job recorded no step-time attribution."""
+    if not perf or not isinstance(perf.get("verdict"), dict):
+        return None
+    v = perf["verdict"]
+    return {
+        "verdict": v.get("category", ""),
+        "summary": v.get("summary", ""),
+        "confidence": v.get("confidence", 0.0),
+        "evidence": list(v.get("evidence") or []),
+        "fractions": dict(perf.get("fractions") or {}),
+        "wall_s": perf.get("wall_s"),
+        "steps": perf.get("steps"),
+    }
+
+
 def build_incident(bundle: IncidentBundle, findings: List[Finding],
                    provisional: bool = False) -> Dict[str, Any]:
     verdict = findings[0] if findings else None
@@ -117,6 +137,7 @@ def build_incident(bundle: IncidentBundle, findings: List[Finding],
                   "has_traceback": bool(t.traceback),
                   "has_stack_dump": bool(t.stack_dump)}
             for tid, t in sorted(bundle.tasks.items())},
+        "perf": _perf_advisory(bundle.perf),
         "bundle": {"events": len(bundle.events),
                    "journal_records": len(bundle.journal),
                    "spans": len(bundle.spans),
@@ -201,6 +222,17 @@ def render_text(incident: Dict[str, Any]) -> str:
         lines.append("other findings:")
         lines += [f"  - [{f.get('category')}] {f.get('summary', '')}"
                   for f in others]
+    perf = incident.get("perf") or {}
+    if perf.get("verdict"):
+        fr = perf.get("fractions") or {}
+        frac_line = "  ".join(
+            f"{k}={v:.0%}" for k, v in sorted(fr.items(), key=lambda kv:
+                                              -kv[1]))
+        lines += ["",
+                  f"perf advisory: {perf['verdict']} — "
+                  f"{perf.get('summary', '')}",
+                  f"  step-time attribution: {frac_line}"]
+        lines += [f"  - {e}" for e in perf.get("evidence", [])]
     blamed = incident.get("blamed_task") or {}
     if blamed.get("traceback"):
         lines += ["", f"--- user traceback ({blamed.get('task')}) ---",
@@ -246,6 +278,16 @@ def render_html(incident: Dict[str, Any]) -> str:
         items = "".join(f"<li><code>{esc(str(e))}</code></li>"
                         for e in v["evidence"])
         parts.append(f"<h2>evidence</h2><ul>{items}</ul>")
+    perf = incident.get("perf") or {}
+    if perf.get("verdict"):
+        fr = perf.get("fractions") or {}
+        frac = "  ".join(f"{esc(str(k))}={float(v):.0%}"
+                         for k, v in sorted(fr.items(),
+                                            key=lambda kv: -kv[1]))
+        parts.append(
+            f"<h2>perf advisory</h2><p><b>{esc(str(perf['verdict']))}"
+            f"</b> — {esc(str(perf.get('summary', '')))}<br>"
+            f"<code>{frac}</code></p>")
     blamed = incident.get("blamed_task") or {}
     if blamed.get("traceback"):
         parts.append(f"<h2>user traceback — "

@@ -63,6 +63,11 @@ class EventType(str, enum.Enum):
     # the diagnosis engine must not read its absorbed task exits as the
     # job's failure.
     GANG_RESIZED = "GANG_RESIZED"
+    # On-demand device profiling (tony-tpu profile <app>): a task's
+    # capture reached a terminal state. Payload: task, request id, steps,
+    # status ("captured" with the artifact dir, or "failed" with the
+    # error — a failed capture never kills or stalls training).
+    TASK_PROFILED = "TASK_PROFILED"
 
 
 @dataclasses.dataclass

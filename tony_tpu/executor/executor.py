@@ -92,7 +92,8 @@ class Heartbeater(threading.Thread):
                  progress_fn: Optional[Callable[[], Optional[dict]]] = None,
                  on_dump: Optional[Callable[[], None]] = None,
                  mgen_fn: Optional[Callable[[], int]] = None,
-                 on_resize: Optional[Callable[[dict], None]] = None):
+                 on_resize: Optional[Callable[[dict], None]] = None,
+                 on_profile: Optional[Callable[[dict], None]] = None):
         super().__init__(name="tony-heartbeater", daemon=True)
         self._client = client
         self._task_id = task_id
@@ -113,6 +114,10 @@ class Heartbeater(threading.Thread):
         # (checkpoint-and-park) or release.
         self._mgen_fn = mgen_fn
         self._on_resize = on_resize
+        # On-demand profiling (tony-tpu profile): the response may carry
+        # a PROFILE directive — re-sent every beat until the capture
+        # result rides a beacon back; the executor dedups by request id.
+        self._on_profile = on_profile
         self._misses = 0
         # _stop_evt, not _stop: threading.Thread has a private _stop()
         # method; shadowing it with an Event breaks Thread.join().
@@ -177,6 +182,13 @@ class Heartbeater(threading.Thread):
                         self._on_resize(res["resize"])
                     except Exception:  # noqa: BLE001 — keep beating
                         log.exception("resize directive handling failed")
+                if isinstance(res, dict) \
+                        and isinstance(res.get("profile"), dict) \
+                        and self._on_profile is not None:
+                    try:
+                        self._on_profile(res["profile"])
+                    except Exception:  # noqa: BLE001 — keep beating
+                        log.exception("profile directive handling failed")
             except FencedError as e:
                 self._orphan(f"fenced by a live coordinator: {e}")
                 return
@@ -278,6 +290,10 @@ class TaskExecutor:
         self._resize_lock = threading.Lock()
         self._resize_directive: Optional[dict] = None
         self._released = False
+        # On-demand profiling: request ids already written to the user
+        # process's request file (the directive re-rides every beat until
+        # the result lands — write each request exactly once).
+        self._profile_ids: set = set()
         self._rpc_max_retries = self.conf.get_int(K.RPC_MAX_RETRIES, 10)
         self._rpc_retry_sleep_s = float(
             self.conf.get(K.RPC_RETRY_SLEEP_S, 2.0) or 2.0)
@@ -461,6 +477,17 @@ class TaskExecutor:
             m["rss_bytes"] = self._monitor.last_rss
         if m:
             beacon["metrics"] = m
+        ph = stats.get("step_phases")
+        if isinstance(ph, dict) and ph:
+            # Step-time attribution: cumulative per-phase seconds + the
+            # recent ring means → tony_step_phase_seconds gauges and the
+            # `top` phase bar (tony_tpu/profiling/).
+            beacon["phases"] = ph
+        prof = stats.get("profile")
+        if isinstance(prof, dict) and prof:
+            # On-demand capture status/result — the coordinator matches
+            # it to its request by id and emits TASK_PROFILED.
+            beacon["profile"] = prof
         if self._rpc_hist.count:
             beacon["rpc"] = self._rpc_hist.snapshot()
         return beacon or None
@@ -523,6 +550,37 @@ class TaskExecutor:
             os.kill(pid, self._dump_signal)
         except (ProcessLookupError, PermissionError) as e:
             log.warning("stack-dump signal failed: %s", e)
+
+    # -- on-demand profiling (tony-tpu profile) --------------------------
+    def _profile_request_path(self) -> str:
+        return os.path.join(os.getcwd(), constants.PROFILE_REQUEST_FILE)
+
+    def _on_profile_directive(self, directive: dict) -> None:
+        """PROFILE directive off the heartbeat response (the dump/RESIZE
+        pattern): hand the request to the user process by writing the
+        request file its telemetry reporter polls
+        (TONY_PROFILE_REQUEST_FILE). Deduped by request id — the
+        coordinator re-sends the directive every beat until the capture
+        result rides a beacon back; the file is written exactly once per
+        request. Atomic replace: the reporter must never adopt a torn
+        request (it would dedup a garbage id)."""
+        try:
+            req_id = int(directive.get("id", 0))
+        except (TypeError, ValueError):
+            return
+        if req_id <= 0 or req_id in self._profile_ids:
+            return
+        self._profile_ids.add(req_id)
+        from tony_tpu.utils.durable import atomic_write
+
+        try:
+            atomic_write(self._profile_request_path(),
+                         json.dumps(directive).encode("utf-8"))
+            log.info("profile request %d (steps=%s) written for the "
+                     "user process", req_id, directive.get("steps"))
+        except OSError as e:
+            log.warning("could not write profile request %d: %s",
+                        req_id, e)
 
     # -- elastic resize (coordinator/elastic.py) -------------------------
     def _on_resize(self, directive: dict) -> None:
@@ -796,7 +854,8 @@ class TaskExecutor:
             progress_fn=self._progress_beacon,
             on_dump=self._dump_user_stacks,
             mgen_fn=lambda: self.mgen,
-            on_resize=self._on_resize)
+            on_resize=self._on_resize,
+            on_profile=self._on_profile_directive)
         hb.start()
         monitor = TaskMonitor(
             self.task_id,
@@ -910,6 +969,11 @@ class TaskExecutor:
                 # owns the chips; see tony_tpu/telemetry.py) and the
                 # monitor tails the file.
                 env[constants.METRICS_FILE] = metrics_file
+                # On-demand profiling request channel: the telemetry
+                # reporter polls this file for PROFILE directives the
+                # executor writes off the heartbeat response.
+                env[constants.PROFILE_REQUEST_ENV] = \
+                    self._profile_request_path()
                 # Hung-task diagnostics contract: `import tony_tpu` in
                 # the user process pre-registers a faulthandler
                 # all-thread stack dump on this signal; _dump_user_stacks

@@ -55,6 +55,12 @@ site                      where it fires
 ``resize.remesh``         coordinator elastic re-mesh, once per resize
                           before the member set is rebuilt — a failed
                           topology application; same abort path
+``profile.capture``       telemetry on-demand device capture, at the step
+                          boundary that would arm jax.profiler — the
+                          unsupported/failed-capture shape; the task
+                          reports PROFILE_FAILED on the next beat and
+                          training continues (capture must never kill or
+                          stall the job)
 ========================  =====================================================
 
 Spec grammar (the value of ``tony.fault.<site>`` conf keys, or one
@@ -110,7 +116,8 @@ SITES = ("rpc.connect", "rpc.send", "rpc.slow", "heartbeat",
          "coordinator.crash", "executor.reregister",
          "user.hang", "user.slow_step",
          "pool.lease", "pool.stale", "pool.adopt",
-         "host.loss", "resize.barrier", "resize.remesh")
+         "host.loss", "resize.barrier", "resize.remesh",
+         "profile.capture")
 
 
 class InjectedFault(ConnectionError):

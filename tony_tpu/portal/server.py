@@ -170,8 +170,8 @@ class PortalServer:
                 return self._prom_view(req)
             view, *rest = parts
             if view in ("config", "jobs", "logs", "logfile",
-                        "profiles", "metrics", "trace", "diagnose") \
-                    and rest:
+                        "profiles", "profile", "metrics", "trace",
+                        "diagnose") and rest:
                 job_id = rest[0]
                 if view == "config":
                     return self._config_view(req, job_id, as_json)
@@ -182,7 +182,9 @@ class PortalServer:
                 if view == "logfile" and len(rest) >= 2:
                     return self._logfile_view(req, job_id, int(rest[1]),
                                               query)
-                if view == "profiles":
+                if view in ("profiles", "profile"):
+                    # /profile/<app> (singular) is the documented spelling
+                    # for on-demand captures; both list the same dir.
                     return self._profiles_view(req, job_id, as_json)
                 if view == "metrics":
                     return self._metrics_view(req, job_id, as_json)

@@ -1,0 +1,221 @@
+"""Bottleneck verdicts over the step-phase ring: where do the lost MFU go.
+
+The classifier consumes per-task phase FRACTIONS (seconds attributed to
+each phase divided by the attributed wall — telemetry.phase_stats()
+shipped on the heartbeat beacon) and returns one of five evidence-backed
+verdicts, in the PR 5 rule-engine style: every verdict names the numbers
+that fired it, because an operator must be able to check the
+classifier's work before spending a week on async checkpointing.
+
+Thresholds (module constants, tunable in one place):
+
+- INPUT_BOUND: ``data_wait + h2d`` ≥ 15% of step wall — the input
+  pipeline (host read, H2D transfer) stalls the device; overlap/prefetch
+  is the fix, not a faster kernel.
+- CKPT_BOUND: ``ckpt_stall`` ≥ 10% — synchronous checkpoint saves stall
+  steps; async/overlapped checkpointing (ROADMAP item 4a) is the fix.
+- COMMS_BOUND: ``comms`` ≥ 15% — collective waits (instrument DCN
+  all-reduce with ``telemetry.phase("comms")``) dominate; overlap the
+  gradient all-reduce.
+- COMPUTE_BOUND: ``step_compute`` ≥ 70% and no waste class fired — the
+  healthy verdict: the chip is the limit, go after kernels/precision.
+- UNDERUTILIZED: unattributed ``other`` ≥ 30%, or nothing else fired —
+  wall time is leaking into host-side gaps (python overhead, logging,
+  un-instrumented eval); profile the host, not the device.
+
+Waste classes outrank COMPUTE_BOUND; among fired waste classes the
+largest fraction wins (the biggest recoverable slice is where to aim).
+"""
+
+from __future__ import annotations
+
+import json
+import time
+from typing import Any, Dict, List, Optional
+
+INPUT_BOUND = "INPUT_BOUND"
+CKPT_BOUND = "CKPT_BOUND"
+COMMS_BOUND = "COMMS_BOUND"
+COMPUTE_BOUND = "COMPUTE_BOUND"
+UNDERUTILIZED = "UNDERUTILIZED"
+
+#: every category the classifier can return (golden-matrix test anchor).
+VERDICTS = (INPUT_BOUND, CKPT_BOUND, COMMS_BOUND, COMPUTE_BOUND,
+            UNDERUTILIZED)
+
+#: schema version stamped into perf.json — bump on breaking changes.
+PERF_SCHEMA = 1
+
+INPUT_THRESHOLD = 0.15
+CKPT_THRESHOLD = 0.10
+COMMS_THRESHOLD = 0.15
+COMPUTE_THRESHOLD = 0.70
+OTHER_THRESHOLD = 0.30
+
+#: verdict → one-line operator guidance (rendered by top/diagnose).
+_ADVICE = {
+    INPUT_BOUND: "the input pipeline stalls the device — raise prefetch "
+                 "depth / overlap H2D, not the kernels",
+    CKPT_BOUND: "checkpoint saves stall steps — move to async/overlapped "
+                "checkpointing or widen the save interval",
+    COMMS_BOUND: "collective waits dominate — overlap the gradient "
+                 "all-reduce with compute (dcn_dp axis first)",
+    COMPUTE_BOUND: "the chip is the limit — kernel fusions, precision "
+                   "(int8/fp8), and geometry are the remaining levers",
+    UNDERUTILIZED: "step wall leaks into unattributed host time — "
+                   "instrument eval/logging phases or profile the host",
+}
+
+
+def phase_fractions(cum: Dict[str, float],
+                    wall_s: float) -> Dict[str, float]:
+    """Fraction of the attributed wall per phase (``other`` included when
+    present in ``cum``; zero wall → {})."""
+    try:
+        wall = float(wall_s)
+    except (TypeError, ValueError):
+        return {}
+    if wall <= 0:
+        return {}
+    out: Dict[str, float] = {}
+    for name, secs in (cum or {}).items():
+        try:
+            out[str(name)] = max(0.0, float(secs)) / wall
+        except (TypeError, ValueError):
+            continue
+    return out
+
+
+def classify(fractions: Dict[str, float]) -> Dict[str, Any]:
+    """One verdict over a fraction map. Returns ``{category, summary,
+    advice, confidence, evidence: [..], fractions}`` — evidence lines
+    carry the exact numbers and thresholds that fired."""
+    f = {k: float(v) for k, v in (fractions or {}).items()}
+    data = f.get("data_wait", 0.0) + f.get("h2d", 0.0)
+    ckpt = f.get("ckpt_stall", 0.0)
+    comms = f.get("comms", 0.0)
+    compute = f.get("step_compute", 0.0)
+    other = f.get("other", 0.0)
+    evidence: List[str] = []
+    waste = []
+    if data >= INPUT_THRESHOLD:
+        waste.append((data, INPUT_BOUND,
+                      f"data_wait+h2d = {data:.1%} of step wall "
+                      f"(threshold {INPUT_THRESHOLD:.0%})"))
+    if ckpt >= CKPT_THRESHOLD:
+        waste.append((ckpt, CKPT_BOUND,
+                      f"ckpt_stall = {ckpt:.1%} of step wall "
+                      f"(threshold {CKPT_THRESHOLD:.0%})"))
+    if comms >= COMMS_THRESHOLD:
+        waste.append((comms, COMMS_BOUND,
+                      f"comms = {comms:.1%} of step wall "
+                      f"(threshold {COMMS_THRESHOLD:.0%})"))
+    if waste:
+        waste.sort(reverse=True)
+        frac, category, line = waste[0]
+        evidence.append(line)
+        for _, other_cat, other_line in waste[1:]:
+            evidence.append(f"also fired: {other_cat} ({other_line})")
+        evidence.append(f"step_compute = {compute:.1%}")
+        confidence = min(0.95, 0.5 + frac)
+    elif other >= OTHER_THRESHOLD:
+        category = UNDERUTILIZED
+        evidence.append(f"unattributed (other) = {other:.1%} of step "
+                        f"wall (threshold {OTHER_THRESHOLD:.0%})")
+        evidence.append(f"step_compute = {compute:.1%}")
+        confidence = min(0.9, 0.4 + other)
+    elif compute >= COMPUTE_THRESHOLD:
+        category = COMPUTE_BOUND
+        evidence.append(f"step_compute = {compute:.1%} of step wall "
+                        f"(threshold {COMPUTE_THRESHOLD:.0%}); no waste "
+                        f"class above threshold")
+        confidence = min(0.9, compute)
+    else:
+        category = UNDERUTILIZED
+        evidence.append(
+            f"no phase dominates: step_compute = {compute:.1%}, "
+            f"data_wait+h2d = {data:.1%}, ckpt_stall = {ckpt:.1%}, "
+            f"comms = {comms:.1%}, other = {other:.1%} — attribution is "
+            f"spread thin (instrument the missing phases)")
+        confidence = 0.4
+    return {
+        "category": category,
+        "summary": _ADVICE[category],
+        "advice": _ADVICE[category],
+        "confidence": round(confidence, 3),
+        "evidence": evidence,
+        "fractions": {k: round(v, 4) for k, v in f.items()},
+    }
+
+
+def build_perf_report(app_id: str,
+                      per_task: Dict[str, Dict[str, Any]],
+                      status: str = "") -> Dict[str, Any]:
+    """The ``perf.json`` document: job-level phase totals (seconds, sum
+    EXACTLY equals ``wall_s`` — the acceptance invariant), the job
+    verdict over wall-weighted aggregate fractions, and per-task
+    fractions + verdicts. ``per_task`` maps task_id → the beacon's
+    ``step_phases`` payload ({"cum": {phase: s}, "wall_s": s,
+    "steps": n, ...})."""
+    agg: Dict[str, float] = {}
+    wall_total = 0.0
+    steps_total = 0.0
+    tasks: Dict[str, Any] = {}
+    for task_id, ph in sorted((per_task or {}).items()):
+        if not isinstance(ph, dict):
+            continue
+        cum = ph.get("cum") or {}
+        try:
+            wall = float(ph.get("wall_s", 0.0) or 0.0)
+        except (TypeError, ValueError):
+            wall = 0.0
+        fr = phase_fractions(cum, wall)
+        row: Dict[str, Any] = {"wall_s": round(wall, 4),
+                               "steps": ph.get("steps"),
+                               "fractions": {k: round(v, 4)
+                                             for k, v in fr.items()}}
+        if fr:
+            row["verdict"] = classify(fr)["category"]
+        tasks[task_id] = row
+        wall_total += wall
+        try:
+            steps_total += float(ph.get("steps", 0.0) or 0.0)
+        except (TypeError, ValueError):
+            pass
+        for name, secs in cum.items():
+            try:
+                agg[str(name)] = agg.get(str(name), 0.0) + float(secs)
+            except (TypeError, ValueError):
+                continue
+    fractions = phase_fractions(agg, wall_total)
+    doc: Dict[str, Any] = {
+        "schema": PERF_SCHEMA,
+        "app_id": app_id,
+        "status": status,
+        "generated_ms": int(time.time() * 1000),
+        "steps": steps_total,
+        "wall_s": round(wall_total, 4),
+        "phases_s": {k: round(v, 4) for k, v in sorted(agg.items())},
+        "fractions": {k: round(v, 4) for k, v in sorted(fractions.items())},
+        "verdict": classify(fractions) if fractions else None,
+        "tasks": tasks,
+    }
+    return doc
+
+
+def save_perf(path: str, doc: Dict[str, Any]) -> None:
+    """Atomic replace — readers see the whole report or the previous one."""
+    from tony_tpu.utils.durable import atomic_write
+
+    atomic_write(path, json.dumps(doc, indent=1,
+                                  sort_keys=True).encode("utf-8"))
+
+
+def load_perf(path: str) -> Optional[Dict[str, Any]]:
+    """Decoded perf.json, or None when absent/torn/not-an-object."""
+    try:
+        with open(path, encoding="utf-8") as f:
+            doc = json.load(f)
+    except (OSError, ValueError):
+        return None
+    return doc if isinstance(doc, dict) else None

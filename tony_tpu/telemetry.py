@@ -21,13 +21,14 @@ non-JAX task doesn't get a TPU runtime forced into it.
 
 from __future__ import annotations
 
+import collections
 import contextlib
 import json
 import os
 import sys
 import threading
 import time
-from typing import Dict, Optional
+from typing import Deque, Dict, Optional
 
 from tony_tpu import constants
 
@@ -59,6 +60,139 @@ PEAK_BF16_FLOPS = {
 }
 
 
+# ---------------------------------------------------------------------------
+# Per-step PHASE accounting (steady-state step-time attribution).
+#
+# ``step()``/``step_stats()`` answer "how fast"; nothing answered "where
+# does the step go". The Gemma-on-TPU comparison (PAPERS.md) is built on
+# exactly this decomposition — input wait vs device compute vs collective
+# vs checkpoint stall — so the ``phase(name)`` context manager times any
+# slice of the training loop, and ``step_done`` folds the accumulated
+# phase seconds into a ring of per-step records whose attribution
+# interval runs from the PREVIOUS step's end to this step's end (so
+# between-step work — the prefetch queue wait, a checkpoint save — is
+# attributed to the step that paid for it).
+#
+# Three of the five canonical phases come free:
+# - ``data_wait``: ShardedBatchIterator.__next__ (tony_tpu/data.py)
+# - ``ckpt_stall``: CheckpointManager.save/wait (checkpoint/manager.py)
+# - ``step_compute``: defaults to the step() busy time when no explicit
+#   step_compute phase was recorded (``block_until_ready``-anchor it
+#   yourself via ``with telemetry.phase("step_compute") as p: ...;
+#   p.block_until_ready(loss)`` for dispatch-gap-free numbers).
+# ``h2d``, ``comms`` and ``eval`` are one `with` statement each.
+# Everything unattributed lands in the synthetic ``other`` bucket, so the
+# per-step phases ALWAYS sum to the wall interval.
+# ---------------------------------------------------------------------------
+#: canonical phase names (free-form names are accepted; these are the
+#: ones the bottleneck classifier (tony_tpu/profiling/verdict.py) reads).
+PHASES = ("data_wait", "h2d", "step_compute", "comms", "ckpt_stall",
+          "eval")
+#: synthetic bucket: wall time no phase claimed (host-side gaps).
+OTHER_PHASE = "other"
+
+_phase_lock = threading.Lock()
+_phase_acc: Dict[str, float] = {}   # seconds since the last step boundary
+_phase_cum: Dict[str, float] = {}   # job-cumulative, folded per step
+_phase_wall_cum = 0.0               # cumulative attribution wall
+_phase_steps = 0
+_phase_ring: Deque[dict] = collections.deque(
+    maxlen=max(8, int(os.environ.get("TONY_PHASE_RING_STEPS", "") or 256)))
+
+
+class _PhaseSpan:
+    """Handle yielded by ``phase()``: ``block_until_ready(x)`` anchors the
+    phase end on device completion (a dispatch-async step would otherwise
+    time only the enqueue). No-op passthrough without a live jax."""
+
+    @staticmethod
+    def block_until_ready(x):
+        jax = sys.modules.get("jax")
+        if jax is not None:
+            try:
+                return jax.block_until_ready(x)
+            except Exception:  # noqa: BLE001 — timing aid, never fatal
+                return x
+        return x
+
+
+@contextlib.contextmanager
+def phase(name: str):
+    """Attribute the enclosed wall time to step-phase ``name``:
+    ``with telemetry.phase("data_wait"): batch = next(it)``. Folded into
+    the per-step ring at the next ``step_done`` and shipped on the
+    heartbeat metrics beacon as ``tony_step_phase_seconds``."""
+    t0 = time.monotonic()
+    try:
+        yield _PhaseSpan()
+    finally:
+        dt = time.monotonic() - t0
+        with _phase_lock:
+            _phase_acc[name] = _phase_acc.get(name, 0.0) + dt
+
+
+def _fold_phases(interval_s: float, busy_s: float) -> None:
+    """Close one attribution interval (step_done): drain the accumulator
+    into the ring + cumulative totals, defaulting step_compute to the
+    step's busy time and booking the unattributed remainder as other."""
+    global _phase_wall_cum, _phase_steps
+    with _phase_lock:
+        acc = dict(_phase_acc)
+        _phase_acc.clear()
+        if "step_compute" not in acc:
+            acc["step_compute"] = busy_s
+        wall = max(interval_s, 0.0)
+        attributed = sum(acc.values())
+        if attributed > wall:
+            # Overlapped phases (an async save timed across several
+            # steps) can over-attribute; widen the wall rather than
+            # invent a negative other bucket.
+            wall = attributed
+        acc[OTHER_PHASE] = wall - attributed
+        for k, v in acc.items():
+            _phase_cum[k] = _phase_cum.get(k, 0.0) + v
+        _phase_wall_cum += wall
+        _phase_steps += 1
+        _phase_ring.append({"wall_s": wall, "phases": acc})
+
+
+def phase_stats() -> Dict[str, object]:
+    """Step-time attribution snapshot: cumulative seconds per phase (sum
+    EXACTLY equals ``wall_s`` — ``other`` holds the unattributed rest)
+    plus recent per-step means over the ring. {} before the first step."""
+    with _phase_lock:
+        if not _phase_steps:
+            return {}
+        out: Dict[str, object] = {
+            "steps": float(_phase_steps),
+            "wall_s": _phase_wall_cum,
+            "cum": dict(_phase_cum),
+        }
+        n = len(_phase_ring)
+        if n:
+            recent: Dict[str, float] = {}
+            rwall = 0.0
+            for rec in _phase_ring:
+                rwall += rec["wall_s"]
+                for k, v in rec["phases"].items():
+                    recent[k] = recent.get(k, 0.0) + v
+            out["recent"] = {k: v / n for k, v in recent.items()}
+            out["recent_wall_s"] = rwall / n
+            out["recent_steps"] = float(n)
+    return out
+
+
+def _reset_phase_state() -> None:
+    """Tests/bench probes: start attribution from a clean slate."""
+    global _phase_wall_cum, _phase_steps
+    with _phase_lock:
+        _phase_acc.clear()
+        _phase_cum.clear()
+        _phase_wall_cum = 0.0
+        _phase_steps = 0
+        _phase_ring.clear()
+
+
 def step_done(started_at: float, flops: float = 0.0,
               tokens: float = 0.0) -> None:
     """Record one completed training step that began at ``started_at``
@@ -85,11 +219,19 @@ def step_done(started_at: float, flops: float = 0.0,
             # timestamp the executor's first-step trace span (and the
             # bench's submit→first-step metric) anchors on.
             _steps["first_end_wall"] = time.time()
+        prev_end = _steps["last_end"]
+        busy = max(0.0, now - started_at)
         _steps["count"] += 1
-        _steps["busy_s"] += max(0.0, now - started_at)
+        _steps["busy_s"] += busy
         _steps["flops"] += flops
         _steps["tokens"] += tokens
         _steps["last_end"] = now
+    # Attribution interval: previous step end → this step end, so the
+    # data wait / checkpoint stall BETWEEN steps lands on the step that
+    # paid for it; the first step's interval is its own busy time
+    # (compile/restore before it was never on the clock).
+    _fold_phases(now - prev_end if prev_end else busy, busy)
+    _profile_on_step_boundary()
 
 
 @contextlib.contextmanager
@@ -127,6 +269,131 @@ def step_stats() -> Dict[str, float]:
     if s["first_end_wall"]:
         out["first_step_done_ts"] = s["first_end_wall"]
     return out
+
+
+# ---------------------------------------------------------------------------
+# On-demand device profiling (live, any task, mid-run).
+#
+# `tony-tpu profile <app>` turns the static chief-only trace_window()
+# contract (tony_tpu/profiler.py: edit user code, decide before launch)
+# into a live directive: the coordinator rides a PROFILE request on the
+# heartbeat response, the executor writes it to the request file this
+# module polls (TONY_PROFILE_REQUEST_FILE, reporter-loop cadence), and
+# the NEXT step boundary arms ``jax.profiler`` for N steps — the capture
+# brackets whole steps, never a half-dispatched one. The result (or the
+# failure: fault site ``profile.capture``) rides the metrics file back
+# onto the next beat. Capture must never kill or stall training: every
+# failure shape degrades to a reported PROFILE_FAILED.
+# ---------------------------------------------------------------------------
+_profile_lock = threading.Lock()
+_profile: Dict[str, object] = {
+    "last_id": 0,        # highest request id ever seen (the dedup fence)
+    "pending": None,     # request waiting for the next step boundary
+    "active": None,      # {"req":..., "remaining": n} while tracing
+    "result": None,      # last terminal {"id","status","dir"|"error",...}
+}
+
+
+def _poll_profile_request(path: str = "") -> None:
+    """Reporter-loop tick: adopt a new profile request from the request
+    file (executor-written, atomic replace). Dedup on the request id —
+    the directive is re-sent every beat until the result lands."""
+    path = path or os.environ.get(constants.PROFILE_REQUEST_ENV, "")
+    if not path:
+        return
+    try:
+        with open(path, encoding="utf-8") as f:
+            req = json.load(f)
+        req_id = int(req.get("id", 0))
+    except (OSError, ValueError, TypeError):
+        return
+    if req_id <= 0:
+        return
+    with _profile_lock:
+        if req_id <= int(_profile["last_id"]):  # type: ignore[arg-type]
+            return
+        _profile["last_id"] = req_id
+        _profile["pending"] = {
+            "id": req_id,
+            "steps": max(1, int(req.get("steps", 1) or 1)),
+            "dir": str(req.get("dir", "") or ""),
+        }
+
+
+def _profile_on_step_boundary() -> None:
+    """step_done hook: start a pending capture at this step boundary, or
+    advance/stop an active one. Never raises — a failed capture becomes a
+    PROFILE_FAILED result on the beacon and the loop keeps training."""
+    with _profile_lock:
+        pending = _profile["pending"]
+        active = _profile["active"]
+    if active is not None:
+        active["remaining"] -= 1
+        if active["remaining"] > 0:
+            return
+        req = active["req"]
+        result = {"id": req["id"], "steps": req["steps"]}
+        try:
+            sys.modules["jax"].profiler.stop_trace()
+            result.update(status="captured", dir=req["dir"])
+        except Exception as e:  # noqa: BLE001 — capture is best-effort
+            result.update(status="failed", error=f"stop_trace: {e}"[:300])
+        with _profile_lock:
+            _profile["active"] = None
+            _profile["result"] = result
+        return
+    if pending is None:
+        return
+    result = {"id": pending["id"], "steps": pending["steps"]}
+    try:
+        from tony_tpu import faults
+
+        faults.check("profile.capture")
+        jax = sys.modules.get("jax")
+        if jax is None:
+            raise RuntimeError("jax is not initialized in this process")
+        dest = pending["dir"] or os.path.join(
+            os.getcwd(), "profile", f"ondemand-{pending['id']}")
+        try:
+            os.makedirs(dest, exist_ok=True)
+        except OSError:
+            # Directive named a dir this host can't write (remote-host
+            # task vs. coordinator job dir): capture locally and report
+            # where the artifact actually is.
+            dest = os.path.join(os.getcwd(), "profile",
+                                f"ondemand-{pending['id']}")
+            os.makedirs(dest, exist_ok=True)
+        pending["dir"] = dest
+        jax.profiler.start_trace(dest)
+    except Exception as e:  # noqa: BLE001 — never stall training
+        with _profile_lock:
+            _profile["pending"] = None
+            _profile["result"] = {**result, "status": "failed",
+                                  "error": str(e)[:300]}
+        return
+    with _profile_lock:
+        _profile["pending"] = None
+        _profile["active"] = {"req": pending,
+                              "remaining": pending["steps"]}
+
+
+def profile_state() -> Optional[Dict[str, object]]:
+    """Beacon payload: the capture in flight or the last terminal result
+    (kept until a newer request supersedes it); None = nothing to say."""
+    with _profile_lock:
+        if _profile["active"] is not None:
+            req = _profile["active"]["req"]  # type: ignore[index]
+            return {"id": req["id"], "status": "active",
+                    "dir": req["dir"], "steps": req["steps"]}
+        if _profile["result"] is not None:
+            return dict(_profile["result"])  # type: ignore[arg-type]
+    return None
+
+
+def _reset_profile_state() -> None:
+    """Tests: forget every request/capture/result."""
+    with _profile_lock:
+        _profile.update(last_id=0, pending=None, active=None, result=None)
 
 
 def collect_device_stats() -> Dict[str, float]:
@@ -181,6 +448,16 @@ def collect_device_stats() -> Dict[str, float]:
                 n_global = len(per_device) or 1
             out["mfu_vs_peak_bf16"] = (util["model_flops_per_sec"]
                                        / (peak_fl * n_global))
+    phases = phase_stats()
+    if phases:
+        # Step-time attribution: rides the metrics file → heartbeat
+        # beacon → tony_step_phase_seconds gauges + the `top` phase bar.
+        out["step_phases"] = phases  # type: ignore[assignment]
+    prof = profile_state()
+    if prof is not None:
+        # On-demand device capture status/result (the coordinator emits
+        # TASK_PROFILED and the CLI polls it off profile.status).
+        out["profile"] = prof  # type: ignore[assignment]
     return out
 
 
@@ -201,6 +478,12 @@ def write_stats_once(path: str) -> bool:
 
 def _loop(path: str, interval_s: float) -> None:
     while True:
+        # On-demand profiling directive intake first, so a request
+        # written just before this tick arms at the very next boundary.
+        try:
+            _poll_profile_request()
+        except Exception:  # noqa: BLE001 — telemetry must never die
+            pass
         write_stats_once(path)
         time.sleep(interval_s)
 
