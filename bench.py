@@ -187,7 +187,7 @@ def _time_scan(run_steps, state, inputs_for_rep, reps,
     return dt, final_loss, state
 
 
-def build_flagship_config(seq):
+def build_flagship_config(seq, matmul_dtype=None):
     """The ~300M-param flagship: bf16 activations + lm_head, flash blocks
     from the v5e sweeps (see ops/attention.py).
 
@@ -195,7 +195,11 @@ def build_flagship_config(seq):
     width): the MXU contracts 128 lanes per pass, so d=64 half-fills both
     flash contractions (q·kᵀ over d, p·v producing d) and caps the
     attention kernels at ~50% matmul rate. Measured on v5e at identical
-    params/FLOPs-per-token: 51.4k tok/s (d=64) → 64.8k (d=128)."""
+    params/FLOPs-per-token: 51.4k tok/s (d=64) → 64.8k (d=128).
+
+    ``matmul_dtype`` opts the attention/MLP projections into the
+    quantized path (tony.train.matmul-dtype; v5e runs int8 at 2x the
+    bf16 MXU rate) — None keeps the bitwise bf16 path."""
     from tony_tpu.models import TransformerConfig
 
     bq = int(os.environ.get("TONY_BENCH_BLOCK_Q", "1024"))
@@ -204,7 +208,8 @@ def build_flagship_config(seq):
         vocab_size=32000, dim=1024, n_layers=16, n_heads=8,
         n_kv_heads=4, mlp_dim=4096, max_seq_len=seq, remat=False,
         attn_block_q=min(bq, seq),
-        attn_block_k=min(bk, seq))
+        attn_block_k=min(bk, seq),
+        matmul_dtype=matmul_dtype or None)
 
 
 def measure_point(cfg, batch, seq, steps, chunked=False, loss_chunk=2048,
@@ -510,6 +515,11 @@ def measure_phase_point(steps=16, batch=64):
     return {"step_phases_s": per_step,
             "seconds_per_step": round(
                 float(stats.get("wall_s", 0.0)) / n, 6),
+            # Comms share of the step wall (grad_sync's bucketed sync
+            # books here on multislice meshes; ~0 on one chip). Recorded
+            # per bench point so `tony-tpu bench diff` gates comms
+            # regressions — direction: lower-better (benchdiff._LOWER).
+            "comms_fraction": round(fr.get("comms", 0.0), 4),
             "verdict": classify(fr)["category"] if fr else None,
             "steps": int(n), "batch": batch}
 
@@ -546,8 +556,24 @@ def main(argv=None):
     on_tpu = jax.default_backend() == "tpu"
 
     if on_tpu:
-        headline = measure_point(build_flagship_config(2048), batch=4,
+        # Headline runs the int8 projection path by default (ROADMAP 4a:
+        # the low-precision lever left on the table through r05); set
+        # TONY_BENCH_MATMUL_DTYPE="" to bench pure bf16 as the headline.
+        # The bf16 twin below stays in the json so the unquantized path
+        # is gated for noise-floor regressions alongside it.
+        md = os.environ.get("TONY_BENCH_MATMUL_DTYPE", "int8")
+        headline = measure_point(build_flagship_config(2048, md), batch=4,
                                  seq=2048, steps=50)
+        detail["matmul_dtype_note"] = (
+            f"headline matmul-dtype={md or 'bf16'}; flagship_bf16 is the "
+            f"unquantized twin (same geometry)")
+        try:
+            detail["flagship_bf16"] = measure_point(
+                build_flagship_config(2048), batch=4, seq=2048, steps=50,
+                reps=2)
+        except Exception as e:  # noqa: BLE001 — never kill the headline
+            print(f"# flagship_bf16 point failed: {e}", file=sys.stderr)
+            detail["flagship_bf16"] = {"error": str(e)[:300]}
     else:
         from tony_tpu.models import TransformerConfig
         headline = measure_point(TransformerConfig.tiny(), batch=4, seq=64,

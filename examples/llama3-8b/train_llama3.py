@@ -35,20 +35,29 @@ BATCH = int(os.environ.get("LLAMA_BATCH", "8"))
 SEQ = int(os.environ.get("LLAMA_SEQ", "8192"))
 STEPS = int(os.environ.get("LLAMA_STEPS", "100"))
 TP = int(os.environ.get("LLAMA_TP", "4"))
+# The tony.train.* hot-loop knobs, env-shaped for this script:
+# accumulation + bucketed DCN grad sync (parallel/grad_sync.py) and the
+# quantized projection path (ops/quant.py). Defaults = monolithic step,
+# bf16 — the pre-grad-sync behaviour, bitwise.
+ACCUM = int(os.environ.get("LLAMA_ACCUM_STEPS", "1"))
+BUCKET_MB = int(os.environ.get("LLAMA_BUCKET_MB", "32"))
+MATMUL_DTYPE = os.environ.get("LLAMA_MATMUL_DTYPE", "")
 
 if os.environ.get("LLAMA_TINY"):
     # CI shape: same code path (mesh, remat policy, checkpointing), toy
     # geometry — lets the flagship script run on the virtual CPU mesh.
     cfg = TransformerConfig.tiny(
         n_layers=2, remat=True,
-        remat_policy="dots_with_no_batch_dims_saveable")
+        remat_policy="dots_with_no_batch_dims_saveable",
+        matmul_dtype=MATMUL_DTYPE or None)
 else:
     cfg = TransformerConfig.llama3_8b(
         remat=True, remat_policy="dots_with_no_batch_dims_saveable",
         # RoPE guard bound: follow the requested context (llama3's native
         # window is 8192; longer runs are context extension on synthetic
         # data here).
-        max_seq_len=max(SEQ, 8192))
+        max_seq_len=max(SEQ, 8192),
+        matmul_dtype=MATMUL_DTYPE or None)
 mesh = build_mesh(MeshSpec(dp=1, fsdp=-1, tp=TP))
 model = Transformer(cfg)
 tokens = jax.random.randint(jax.random.key(0), (BATCH, SEQ), 0,
@@ -66,21 +75,42 @@ LOSS_CHUNK = int(os.environ.get("LLAMA_LOSS_CHUNK", "2048"))
 CHUNKED = SEQ >= 8192 or os.environ.get("LLAMA_CHUNKED_LOSS") == "1"
 
 
-def loss(params):
+def _loss_on(params, toks):
     with nn.logical_axis_rules(list(DEFAULT_RULES)):
         if CHUNKED:
-            h = model.apply({"params": params}, tokens, return_hidden=True)
+            h = model.apply({"params": params}, toks, return_hidden=True)
             return chunked_causal_lm_loss(
-                h, params["lm_head"]["kernel"], tokens,
+                h, params["lm_head"]["kernel"], toks,
                 chunk_size=LOSS_CHUNK, head_dtype=cfg.lm_head_dtype)
-        return causal_lm_loss(model.apply({"params": params}, tokens),
-                              tokens)
+        return causal_lm_loss(model.apply({"params": params}, toks), toks)
 
 
-@functools.partial(jax.jit, donate_argnums=0)
-def step(state):
-    l, grads = jax.value_and_grad(loss)(state.params)
-    return state.apply_gradients(grads), l
+def loss(params):
+    return _loss_on(params, tokens)
+
+
+if ACCUM > 1:
+    # Grad-sync path: ACCUM microbatches per optimizer step, bucketed
+    # cross-slice all-reduce as its own telemetry-phased dispatch — the
+    # step `top`/perf.json can attribute a comms fraction to.
+    from tony_tpu.parallel import jit_train_step_accum
+
+    def _loss_fn(params, b, rng):
+        return _loss_on(params, b["tokens"]), {}
+
+    _gstep = jit_train_step_accum(
+        _loss_fn, mesh, state_sh, {"tokens": tokens},
+        accum_steps=ACCUM, bucket_mb=BUCKET_MB, donate=False)
+
+    def step(state):
+        state, metrics = _gstep(state, {"tokens": tokens},
+                                jax.random.key(0))
+        return state, metrics["loss"]
+else:
+    @functools.partial(jax.jit, donate_argnums=0)
+    def step(state):
+        l, grads = jax.value_and_grad(loss)(state.params)
+        return state.apply_gradients(grads), l
 
 
 ckpt_dir = os.environ.get("TONY_CHECKPOINT_DIR", "")

@@ -376,12 +376,32 @@ def test_bench_diff_fixture_pass_and_regression():
     assert "detail.orchestration.submit_to_first_step_s" in flagged
     assert "detail.phase_probe.step_phases_s.data_wait" in flagged
     assert "detail.tokenfile_train.tokens_per_sec" in flagged
+    # The grad-sync comms gate: the regressed fixture's comms_fraction
+    # jump (0.03 -> 0.19) is flagged lower-is-better.
+    assert "detail.phase_probe.comms_fraction" in flagged
     # the CLI entry exits 0 / 1 accordingly
     assert benchdiff.main([os.path.join(FIXTURES, "bench_base.json"),
                            os.path.join(FIXTURES, "bench_ok.json")]) == 0
     assert benchdiff.main([os.path.join(FIXTURES, "bench_base.json"),
                            os.path.join(FIXTURES,
                                         "bench_regressed.json")]) == 1
+
+
+def test_bench_diff_comms_fraction_direction():
+    """comms_fraction is lower-better: a drop is an improvement, a jump
+    past tolerance is a regression — never the other way round."""
+    base = {"value": 1.0, "detail": {"phase_probe":
+                                     {"comms_fraction": 0.10}}}
+    worse = {"value": 1.0, "detail": {"phase_probe":
+                                      {"comms_fraction": 0.30}}}
+    better = {"value": 1.0, "detail": {"phase_probe":
+                                       {"comms_fraction": 0.02}}}
+    assert [r["metric"] for r in diff_bench(base, worse)["regressions"]] \
+        == ["detail.phase_probe.comms_fraction"]
+    res = diff_bench(base, better)
+    assert res["regressions"] == []
+    assert [r["metric"] for r in res["improvements"]] \
+        == ["detail.phase_probe.comms_fraction"]
 
 
 def test_bench_diff_never_compares_config_echoes():

@@ -547,6 +547,33 @@ TPU_MESH_SHAPE = _key(
     "'fsdp=4,tp=2'. One size may be -1 (inferred). Empty = pure-dp mesh "
     "over all devices.")
 
+# --- training hot loop (parallel/grad_sync.py, ops/quant.py) --------------
+TRAIN_ACCUM_STEPS = _key(
+    "tony.train.accum-steps", 1, int,
+    "Microbatched gradient accumulation: the global batch is split into "
+    "this many microbatches per optimizer step (parallel/grad_sync.py "
+    "jit_train_step_accum). Raises the compute:sync ratio — the first "
+    "knob a COMMS_BOUND verdict prescribes. 1 = no accumulation.")
+TRAIN_BUCKET_MB = _key(
+    "tony.train.bucket-mb", 32, int,
+    "Gradient-sync bucket size in MiB: accumulated grads are cross-slice "
+    "all-reduced bucket-by-bucket in tree-flatten order (order-stable, "
+    "so results match the monolithic psum), letting XLA overlap "
+    "independent bucket collectives instead of serializing one monolith "
+    "behind backward. A param larger than the bucket gets its own "
+    "bucket. Smaller buckets = more overlap, more collective launches.")
+TRAIN_MATMUL_DTYPE = _key(
+    "tony.train.matmul-dtype", "", str,
+    "Opt-in low-precision matmul path for the flagship transformer's "
+    "attention/MLP projections (ops/quant.py): 'int8' (symmetric "
+    "per-channel, 2x MXU rate on v5e) | 'fp8_e4m3'. Forward-only: "
+    "backward stays in the activation dtype (straight-through), the "
+    "embedding/LM head are never quantized, and an unsupported backend "
+    "degrades to bf16 with a one-time warning on the metrics beacon. "
+    "Empty = bitwise-identical bf16/f32 behaviour (the knob off IS the "
+    "old code path). Unsafe for loss-scale-sensitive runs — see "
+    "docs/operations.md 'Spending the verdict'.")
+
 # --- fault injection (tony_tpu/faults.py) ---------------------------------
 FAULT_SEED = _key(
     "tony.fault.seed", 0, int,
@@ -653,6 +680,13 @@ FAULT_RESIZE_REMESH = _key(
     "Fail the application of an elastic resize's new topology (checked "
     "once per resize, before the member set is rebuilt): the resize "
     "aborts into an INFRA_TRANSIENT epoch failure.")
+FAULT_QUANT_PROBE = _key(
+    "tony.fault.quant-probe", "", str,
+    "Fail the quantized-matmul backend support probe (ops/quant.py): a "
+    "firing makes resolve_mode treat the requested int8/fp8 path as "
+    "unsupported on this backend — the model must degrade to the bf16 "
+    "path with a one-time warning riding the metrics beacon, never fail "
+    "the job.")
 FAULT_PROFILE_CAPTURE = _key(
     "tony.fault.profile-capture", "", str,
     "Fail an on-demand device capture at the step boundary that would "
@@ -779,7 +813,7 @@ _JOB_KEY_RE: Pattern[str] = re.compile(
 _RESERVED_NON_JOB_SEGMENTS = {
     "application", "task", "coordinator", "client", "history", "tpu", "portal",
     "keep-failed-task-dirs", "internal", "fault", "rpc", "trace", "metrics",
-    "diagnosis", "pool", "elastic", "profile",
+    "diagnosis", "pool", "elastic", "profile", "train",
 }
 
 

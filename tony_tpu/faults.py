@@ -61,6 +61,11 @@ site                      where it fires
                           reports PROFILE_FAILED on the next beat and
                           training continues (capture must never kill or
                           stall the job)
+``quant.probe``           ops/quant.py backend support probe for the
+                          int8/fp8 matmul path — a firing simulates an
+                          unsupported backend; the model must degrade to
+                          bf16 with a one-time beacon warning, never
+                          fail the job
 ========================  =====================================================
 
 Spec grammar (the value of ``tony.fault.<site>`` conf keys, or one
@@ -117,7 +122,7 @@ SITES = ("rpc.connect", "rpc.send", "rpc.slow", "heartbeat",
          "user.hang", "user.slow_step",
          "pool.lease", "pool.stale", "pool.adopt",
          "host.loss", "resize.barrier", "resize.remesh",
-         "profile.capture")
+         "profile.capture", "quant.probe")
 
 
 class InjectedFault(ConnectionError):

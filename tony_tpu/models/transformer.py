@@ -23,6 +23,7 @@ import jax
 import jax.numpy as jnp
 
 from tony_tpu.ops.attention import flash_attention, reference_attention
+from tony_tpu.ops.quant import QDense
 from tony_tpu.ops.ring import ring_attention
 from tony_tpu.ops.ulysses import ulysses_attention
 
@@ -64,6 +65,14 @@ class TransformerConfig:
     # [dim, vocab] projection is ~20% of model FLOPs and f32 runs at half
     # the MXU rate — loss softmax stays f32 downstream either way).
     lm_head_dtype: Optional[jnp.dtype] = None
+    # Opt-in quantized matmul path for the attention/MLP projections
+    # (tony.train.matmul-dtype): "int8" | "fp8_e4m3" | None. Forward-only
+    # symmetric per-channel quantization (ops/quant.py) on wq/wk/wv/wo and
+    # gate/up/down; the embedding and LM head stay in bf16/f32 (they set
+    # the loss scale). None keeps the exact nn.Dense path — bitwise
+    # identical to the pre-quantization model. An unsupported backend
+    # degrades to bf16 with a one-time beacon warning.
+    matmul_dtype: Optional[str] = None
 
     @classmethod
     def llama3_8b(cls, **kw) -> "TransformerConfig":
@@ -82,12 +91,17 @@ class TransformerConfig:
         return cls(**defaults)
 
 
-def _dense(cfg: TransformerConfig, feats: int, axes, name: str) -> nn.Dense:
+def _dense(cfg: TransformerConfig, feats: int, axes, name: str) -> nn.Module:
+    init = nn.with_logical_partitioning(nn.initializers.lecun_normal(), axes)
+    if cfg.matmul_dtype:
+        # Same param name ("kernel"), path and init as nn.Dense, so the
+        # knob flips freely across checkpoints of the same model.
+        return QDense(features=feats, dtype=cfg.dtype,
+                      param_dtype=cfg.param_dtype, name=name,
+                      kernel_init=init, matmul_dtype=cfg.matmul_dtype)
     return nn.Dense(
         feats, use_bias=False, dtype=cfg.dtype, param_dtype=cfg.param_dtype,
-        name=name,
-        kernel_init=nn.with_logical_partitioning(
-            nn.initializers.lecun_normal(), axes))
+        name=name, kernel_init=init)
 
 
 def _sp_offset() -> jax.Array:

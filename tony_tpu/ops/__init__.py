@@ -15,3 +15,7 @@ from tony_tpu.ops.ring import (  # noqa: F401
 from tony_tpu.ops.ulysses import (  # noqa: F401
     ulysses_attention, ulysses_attention_sharded,
 )
+from tony_tpu.ops.quant import (  # noqa: F401
+    QDense, quantized_matmul, quantize_symmetric, resolve_mode,
+)
+from tony_tpu.ops.convfuse import fused_groupnorm_relu  # noqa: F401

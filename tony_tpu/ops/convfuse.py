@@ -1,0 +1,177 @@
+"""HBM-aware fused GroupNorm→ReLU for the resnet conv trunk.
+
+BENCH_r05 pins the resnet workload at 0.13 MFU with every conv fusion
+HBM-bound (~700 GiB/s measured, xprof r5): the chip's 240 FLOPs/byte
+ratio, not the MXU, is the ceiling, so the lever is *fewer HBM passes
+per conv→norm→relu chain*, not faster matmuls. ``nn.GroupNorm`` + a
+separate ``nn.relu`` walks the [B, H, W, C] activation several times
+(stats, normalize, affine, relu) and saves the normalized tensor for
+backward. This module collapses the chain:
+
+- **One-pass stats.** mean and E[x²] per (batch, group) come from a
+  single fused reduction sweep (XLA fuses the two reductions over the
+  same operand into one pass).
+- **Folded affine.** scale/rsqrt/mean/bias collapse into per-(B, C)
+  ``a``/``b`` vectors, so normalize+affine+relu is ONE fused
+  multiply-add-max over the activation — a Pallas kernel on TPU (one
+  HBM read + one write, ``pallas_guide.md``), a single fused ``lax``
+  expression everywhere else (the portable path tier-1 CPU runs).
+- **Remat'd epilogue.** The fused apply sits under ``jax.checkpoint``
+  (on by default): backward recomputes the cheap normalize instead of
+  keeping the [B, H, W, C] normalized tensor resident — HBM footprint
+  and write traffic both drop.
+
+Degrade discipline matches ops/quant.py: the Pallas path is probed once
+per backend with a tiny eager call; any refusal falls back to the lax
+composition with a one-time warning — the fused trunk may lose its
+kernel, never the job. models/resnet.py threads this through every
+bottleneck via ``ResNetConfig.fused`` (on by default; the unfused
+GroupNorm path stays as the parity twin).
+"""
+
+from __future__ import annotations
+
+import functools
+import logging
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+
+log = logging.getLogger(__name__)
+
+#: row-block for the Pallas apply kernel ([rows, C] tiles of the
+#: flattened [B, H·W, C] view).
+APPLY_BLOCK_ROWS = 256
+
+_pallas_fallback_reason: Optional[str] = None
+
+
+def group_stats(x: jax.Array, groups: int):
+    """(mean, var) per (batch, group) over spatial dims and the group's
+    channels, f32, one fused sweep (E[x²] − E[x]² with a non-negative
+    clamp)."""
+    b, c = x.shape[0], x.shape[-1]
+    xg = x.reshape(b, -1, groups, c // groups).astype(jnp.float32)
+    mean = jnp.mean(xg, axis=(1, 3))
+    ex2 = jnp.mean(jnp.square(xg), axis=(1, 3))
+    var = jnp.maximum(ex2 - jnp.square(mean), 0.0)
+    return mean, var
+
+
+def folded_affine(mean: jax.Array, var: jax.Array, scale: jax.Array,
+                  bias: jax.Array, channels: int, eps: float):
+    """Fold (mean, var, scale, bias) into per-(B, C) ``a``/``b`` so the
+    whole normalize+affine is ``x * a + b`` — one fused elementwise pass
+    instead of GroupNorm's subtract/rsqrt/mul/mul/add chain."""
+    groups = mean.shape[-1]
+    inv = lax.rsqrt(var + eps)                          # [B, G]
+    cg = channels // groups
+    inv_c = jnp.repeat(inv, cg, axis=1)                 # [B, C]
+    mean_c = jnp.repeat(mean, cg, axis=1)
+    a = inv_c * scale.astype(jnp.float32)[None, :]
+    b = bias.astype(jnp.float32)[None, :] - mean_c * a
+    return a, b
+
+
+def _apply_lax(x: jax.Array, a: jax.Array, b: jax.Array,
+               relu: bool) -> jax.Array:
+    """Portable fused apply: one multiply-add(-max) expression XLA fuses
+    into a single pass (and into the neighbouring conv where it can)."""
+    shape = (x.shape[0],) + (1,) * (x.ndim - 2) + (x.shape[-1],)
+    y = x.astype(jnp.float32) * a.reshape(shape) + b.reshape(shape)
+    if relu:
+        y = jnp.maximum(y, 0.0)
+    return y.astype(x.dtype)
+
+
+def _apply_kernel(x_ref, a_ref, b_ref, o_ref, *, relu):
+    y = x_ref[0].astype(jnp.float32) * a_ref[0] + b_ref[0]
+    if relu:
+        y = jnp.maximum(y, 0.0)
+    o_ref[0] = y.astype(o_ref.dtype)
+
+
+def _apply_pallas(x: jax.Array, a: jax.Array, b: jax.Array, relu: bool,
+                  interpret: bool) -> jax.Array:
+    """One-HBM-pass apply: grid over (batch, row blocks) of the
+    flattened [B, H·W, C] view; a/b ride along as [1, C] blocks."""
+    batch, c = x.shape[0], x.shape[-1]
+    x2 = x.reshape(batch, -1, c)
+    rows = x2.shape[1]
+    block = min(APPLY_BLOCK_ROWS, rows)
+    grid = (batch, pl.cdiv(rows, block))
+    out = pl.pallas_call(
+        functools.partial(_apply_kernel, relu=relu),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, block, c), lambda i, j: (i, j, 0)),
+            pl.BlockSpec((1, c), lambda i, j: (i, 0)),
+            pl.BlockSpec((1, c), lambda i, j: (i, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, block, c), lambda i, j: (i, j, 0)),
+        out_shape=jax.ShapeDtypeStruct(x2.shape, x.dtype),
+        interpret=interpret,
+    )(x2, a, b)
+    return out.reshape(x.shape)
+
+
+@functools.lru_cache(maxsize=None)
+def _pallas_ok(backend: str) -> bool:
+    """Probe the Pallas apply once per backend (tiny eager call, CPU
+    interpret mode included); any refusal degrades to the lax path with
+    a one-time warning."""
+    global _pallas_fallback_reason
+    try:
+        x = jnp.ones((1, 8, 8), jnp.float32)
+        ab = jnp.ones((1, 8), jnp.float32)
+        out = _apply_pallas(x, ab, ab, True, backend != "tpu")
+        jax.block_until_ready(out)
+    except Exception as e:  # noqa: BLE001 — any refusal shape degrades
+        _pallas_fallback_reason = f"{type(e).__name__}: {e}"[:200]
+        log.warning(
+            "fused groupnorm Pallas apply unavailable on backend %r "
+            "(%s); DEGRADING to the fused lax composition (one-time "
+            "warning)", backend, _pallas_fallback_reason)
+        return False
+    return True
+
+
+def fused_groupnorm_relu(x: jax.Array, scale: jax.Array, bias: jax.Array,
+                         *, groups: int, eps: float = 1e-6,
+                         relu: bool = True,
+                         use_pallas: Optional[bool] = None,
+                         remat: bool = True) -> jax.Array:
+    """GroupNorm (+ optional ReLU) in two HBM passes: one fused stats
+    sweep, one fused folded-affine apply. Numerically matches
+    ``nn.relu(nn.GroupNorm(num_groups=groups)(x))`` to f32 tolerance.
+
+    ``use_pallas=None`` auto-selects: the Pallas kernel on TPU (probed
+    once, degrades to lax), interpret-mode Pallas only when forced
+    (unit tests), the lax composition otherwise. ``remat=True`` wraps
+    the apply in ``jax.checkpoint`` so backward recomputes it instead of
+    keeping the normalized activation resident."""
+    c = x.shape[-1]
+    if c % groups:
+        raise ValueError(f"channels {c} not divisible by groups {groups}")
+    mean, var = group_stats(x, groups)
+    a, b = folded_affine(mean, var, scale, bias, c, eps)
+
+    backend = jax.default_backend()
+    if use_pallas is None:
+        use_pallas = backend == "tpu" and _pallas_ok(backend)
+    elif use_pallas:
+        use_pallas = _pallas_ok(backend)
+
+    if use_pallas:
+        def apply(x, a, b):
+            return _apply_pallas(x, a, b, relu, backend != "tpu")
+    else:
+        def apply(x, a, b):
+            return _apply_lax(x, a, b, relu)
+
+    if remat:
+        apply = jax.checkpoint(apply)
+    return apply(x, a, b)

@@ -18,3 +18,7 @@ from tony_tpu.parallel.sharding import (  # noqa: F401
 from tony_tpu.parallel.train import (  # noqa: F401
     TrainState, init_sharded_state, jit_train_step,
 )
+from tony_tpu.parallel.grad_sync import (  # noqa: F401
+    GradSyncSpec, bucketed_sync, jit_train_step_accum, monolithic_grads,
+    plan_buckets,
+)

@@ -24,8 +24,9 @@ Axes:
 from __future__ import annotations
 
 import dataclasses
+import functools
 import math
-from typing import Optional, Sequence
+from typing import Any, Optional, Sequence
 
 import jax
 import numpy as np
@@ -139,11 +140,35 @@ def build_mesh(spec: Optional[MeshSpec] = None,
     return Mesh(dev_array, MESH_AXES)
 
 
-def batch_sharding(mesh: Mesh, extra_dims: int = 1) -> NamedSharding:
-    """Sharding for a [batch, ...] input: batch split over every
-    data-parallel-ish axis (dcn_dp, dp and fsdp all consume batch)."""
+@functools.lru_cache(maxsize=256)
+def _cached_batch_sharding(mesh: Mesh, extra_dims: int) -> NamedSharding:
     return NamedSharding(mesh, P(BATCH_AXES, *([None] * extra_dims)))
 
 
+def batch_sharding(mesh: Mesh, extra_dims: int = 1) -> NamedSharding:
+    """Sharding for a [batch, ...] input: batch split over every
+    data-parallel-ish axis (dcn_dp, dp and fsdp all consume batch).
+    Memoized per (mesh, extra_dims): large batch pytrees map every leaf
+    through here on the submit path, and NamedSharding construction is
+    not free — identical requests return the same object."""
+    return _cached_batch_sharding(mesh, extra_dims)
+
+
+def tree_batch_shardings(mesh: Mesh, sample_batch: Any) -> Any:
+    """Per-leaf batch shardings for a whole batch pytree: [batch, ...]
+    leaves split over the batch axes, scalar (0-d) leaves replicated —
+    the one shared recipe for ``jit_train_step`` and the grad-sync accum
+    step. Shardings are memoized per (mesh, ndim), so a batch tree with
+    thousands of leaves pays for at most a handful of constructions."""
+    import jax.numpy as jnp
+
+    replicated = replicated_sharding(mesh)
+    return jax.tree.map(
+        lambda leaf: (batch_sharding(mesh, extra_dims=jnp.ndim(leaf) - 1)
+                      if jnp.ndim(leaf) > 0 else replicated),
+        sample_batch)
+
+
+@functools.lru_cache(maxsize=256)
 def replicated_sharding(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, P())

@@ -19,7 +19,7 @@ from flax import struct
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from tony_tpu import compat
-from tony_tpu.parallel.mesh import batch_sharding
+from tony_tpu.parallel.mesh import tree_batch_shardings
 from tony_tpu.parallel.sharding import DEFAULT_RULES, param_shardings
 
 
@@ -101,11 +101,10 @@ def jit_train_step(
         return new_state, metrics
 
     # Scalar (0-d) leaves can't carry a batch dim — replicate those.
-    batch_sh = jax.tree.map(
-        lambda leaf: (batch_sharding(mesh, extra_dims=jnp.ndim(leaf) - 1)
-                      if jnp.ndim(leaf) > 0
-                      else NamedSharding(mesh, P())),
-        sample_batch)
+    # Shardings are memoized per (mesh, ndim) in mesh.py, so a large
+    # batch pytree no longer pays one NamedSharding construction per
+    # leaf per builder call on the submit path.
+    batch_sh = tree_batch_shardings(mesh, sample_batch)
     jitted = jax.jit(
         step,
         in_shardings=(state_shardings, batch_sh, NamedSharding(mesh, P())),

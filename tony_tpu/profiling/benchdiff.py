@@ -36,7 +36,8 @@ _HIGHER = ("tokens_per_sec", "samples_per_sec", "mfu_vs_peak_bf16",
            "pct_of_synthetic", "steps_per_sec", "value")
 #: metric-name suffixes where smaller is better
 _LOWER = ("submit_to_first_step_s", "probe_self_reported_s",
-          "phase_total_s", "seconds_per_step", "mean_step_s")
+          "phase_total_s", "seconds_per_step", "mean_step_s",
+          "comms_fraction")
 #: path components under which every plain numeric leaf is seconds of a
 #: phase breakdown → lower is better
 _LOWER_CONTAINERS = ("phases", "step_phases_s", "phase_span_durations")

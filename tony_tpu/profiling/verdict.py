@@ -58,10 +58,13 @@ _ADVICE = {
                  "depth / overlap H2D, not the kernels",
     CKPT_BOUND: "checkpoint saves stall steps — move to async/overlapped "
                 "checkpointing or widen the save interval",
-    COMMS_BOUND: "collective waits dominate — overlap the gradient "
-                 "all-reduce with compute (dcn_dp axis first)",
-    COMPUTE_BOUND: "the chip is the limit — kernel fusions, precision "
-                   "(int8/fp8), and geometry are the remaining levers",
+    COMMS_BOUND: "collective waits dominate — bucket/overlap the DCN "
+                 "all-reduce: set tony.train.accum-steps > 1 and tune "
+                 "tony.train.bucket-mb (parallel/grad_sync.py)",
+    COMPUTE_BOUND: "the chip is the limit — opt into low-precision "
+                   "matmuls (tony.train.matmul-dtype=int8 | fp8_e4m3) "
+                   "and the fused conv trunk; geometry is the remaining "
+                   "lever",
     UNDERUTILIZED: "step wall leaks into unattributed host time — "
                    "instrument eval/logging phases or profile the host",
 }
@@ -117,6 +120,14 @@ def classify(fractions: Dict[str, float]) -> Dict[str, Any]:
         for _, other_cat, other_line in waste[1:]:
             evidence.append(f"also fired: {other_cat} ({other_line})")
         evidence.append(f"step_compute = {compute:.1%}")
+        if category == COMMS_BOUND:
+            # Prescribe the fix this repo ships, not generic advice: the
+            # comms phase is recorded by grad_sync's bucketed sync, and
+            # these are its knobs.
+            evidence.append(
+                "knobs: tony.train.accum-steps (raise the compute:sync "
+                "ratio), tony.train.bucket-mb (bucket/overlap the "
+                "all-reduce)")
         confidence = min(0.95, 0.5 + frac)
     elif other >= OTHER_THRESHOLD:
         category = UNDERUTILIZED
@@ -129,6 +140,10 @@ def classify(fractions: Dict[str, float]) -> Dict[str, Any]:
         evidence.append(f"step_compute = {compute:.1%} of step wall "
                         f"(threshold {COMPUTE_THRESHOLD:.0%}); no waste "
                         f"class above threshold")
+        evidence.append(
+            "knobs: tony.train.matmul-dtype=int8|fp8_e4m3 (quantized "
+            "projections, loss-parity-gated) — see docs/operations.md "
+            "'Spending the verdict'")
         confidence = min(0.9, compute)
     else:
         category = UNDERUTILIZED

@@ -458,6 +458,16 @@ def collect_device_stats() -> Dict[str, float]:
         # On-demand device capture status/result (the coordinator emits
         # TASK_PROFILED and the CLI polls it off profile.status).
         out["profile"] = prof  # type: ignore[assignment]
+    quant = sys.modules.get("tony_tpu.ops.quant")
+    if quant is not None:
+        # One-time quantization-fallback event (tony.train.matmul-dtype
+        # refused on this backend → degraded to bf16): surfaced on the
+        # beacon so the degrade is visible in metrics/top, not only in a
+        # log line. Checked via sys.modules so a job that never touched
+        # the quant path never imports it (or jax) from here.
+        fb = quant.fallback_events()
+        if fb:
+            out["quant_fallback"] = fb  # type: ignore[assignment]
     return out
 
 
