@@ -524,6 +524,145 @@ def measure_phase_point(steps=16, batch=64):
             "steps": int(n), "batch": batch}
 
 
+def measure_scale_point(width, hb_interval_ms=500, sustain_s=6.0,
+                        monitor_interval_ms=100, pump_threads=16):
+    """One BENCH_SCALE width point: a gang of ``width`` beat-only
+    virtual executors (tony.scale.virtual-executors — real RPC frames,
+    real journal records, no user processes) against ONE coordinator,
+    measuring the control plane itself: rendezvous time, beats/s
+    sustained, active tick duration, journal records/s + fsync stall
+    fraction, and resize latency at width. Runs entirely on CPU — no
+    jax, CI-sized time — because the thing under test is the
+    coordinator's O(n) loops, not the device."""
+    import shutil
+    import tempfile
+    import threading
+
+    from tony_tpu.cluster.local import VirtualExecutorBackend
+    from tony_tpu.conf import keys as K
+    from tony_tpu.conf.config import TonyTpuConfig
+    from tony_tpu.coordinator.coordinator import Coordinator
+    from tony_tpu.profiling import classify_coord
+
+    tmp = tempfile.mkdtemp(prefix=f"tony-bench-scale-{width}-")
+    conf = TonyTpuConfig()
+    conf.set("tony.worker.instances", width)
+    conf.set("tony.worker.command", "virtual")
+    conf.set(K.SCALE_VIRTUAL_EXECUTORS, True)
+    conf.set(K.SCALE_VIRTUAL_PUMP_THREADS, pump_threads)
+    conf.set(K.TASK_HEARTBEAT_INTERVAL_MS, hb_interval_ms)
+    conf.set(K.COORDINATOR_MONITOR_INTERVAL_MS, monitor_interval_ms)
+    conf.set(K.ELASTIC_ENABLED, True)
+    conf.set(K.ELASTIC_BARRIER_TIMEOUT_S, 60)
+    # Bench hygiene: no client to signal finish, and the teardown must
+    # not spend seconds diagnosing the deliberate stop.
+    conf.set(K.APPLICATION_NUM_CLIENTS_TO_WAIT, False)
+    conf.set(K.DIAGNOSIS_ENABLED, False)
+    backend = VirtualExecutorBackend.from_conf(
+        conf, os.path.join(tmp, "work"))
+    coord = Coordinator(conf, f"bench_scale_{width}", backend,
+                        os.path.join(tmp, "history"), user="bench")
+    runner = threading.Thread(target=coord.run, daemon=True,
+                              name=f"scale-coord-{width}")
+    point = {"tasks": width,
+             "hb_interval_ms": hb_interval_ms}
+    try:
+        t0 = time.monotonic()
+        runner.start()
+        deadline = t0 + 120
+        while not coord.session.all_registered() \
+                and time.monotonic() < deadline:
+            time.sleep(0.02)
+        if not coord.session.all_registered():
+            raise RuntimeError(
+                f"rendezvous of {width} virtual tasks did not complete "
+                f"within 120s ({coord.session.num_registered} "
+                f"registered)")
+        point["rendezvous_s"] = round(time.monotonic() - t0, 3)
+        # Steady state: let the beats/journal/tick machinery run, then
+        # read the coordinator's own phase accounting.
+        time.sleep(sustain_s)
+        snap = coord.coordphases.snapshot()
+        fractions = coord.coordphases.fractions()
+        cum = snap.get("cum") or {}
+        wall = float(snap.get("wall_s", 0.0) or 0.0)
+        point.update({
+            "beats_per_sec": round(
+                float(snap.get("beats_per_sec", 0.0)), 2),
+            "tick_duration_s": round(
+                float(snap.get("tick_active_s", 0.0)), 6),
+            "journal_records_per_sec": round(
+                float(snap.get("journal_records_per_sec", 0.0)), 2),
+            "journal_fsync_p99_s": round(
+                float(snap.get("journal_fsync_p99_s", 0.0)), 6),
+            # Fraction of the coordinator's wall spent inside fsync'd
+            # journal appends — the group-commit target number.
+            "fsync_stall_fraction": round(
+                fractions.get("journal_fsync", 0.0), 4),
+            # Acceptance invariant: per-tick phases sum to the tick
+            # wall; the cumulative ratio must be ~1.0.
+            "phase_sum_ratio": round(
+                sum(cum.values()) / wall, 4) if wall > 0 else None,
+            "coord_phases": {k: round(v, 4)
+                             for k, v in sorted(fractions.items())},
+        })
+        if fractions:
+            point["verdict"] = classify_coord(fractions)["category"]
+        # Resize at width: shrink by one through the real
+        # drain→remesh→barrier path; latency = request → op complete.
+        t1 = time.monotonic()
+        res = coord.resize_application(width - 1)
+        if res.get("ok"):
+            while coord.elastic is not None and coord.elastic.resizing \
+                    and time.monotonic() - t1 < 90:
+                time.sleep(0.02)
+            if coord.elastic is not None and not coord.elastic.resizing:
+                point["resize_latency_s"] = round(
+                    time.monotonic() - t1, 3)
+            else:
+                point["resize_error"] = "resize did not complete in 90s"
+        else:
+            point["resize_error"] = str(res.get("message", "refused"))
+    finally:
+        coord.request_stop("scale bench point complete")
+        runner.join(timeout=60)
+        shutil.rmtree(tmp, ignore_errors=True)
+    return point
+
+
+def run_scale_suite(widths=None, sustain_s=6.0):
+    """The BENCH_SCALE family (persisted as BENCH_SCALE_r*.json, gated
+    by `tony-tpu bench diff` like every other family): control-plane
+    capacity vs gang width. Headline = beats/s sustained at the widest
+    point (the number 'a thousand tasks on one control plane' hangs
+    off)."""
+    if widths is None:
+        widths = [int(w) for w in os.environ.get(
+            "TONY_BENCH_SCALE_WIDTHS", "128,256,512").split(",")
+            if w.strip()]
+    detail = {"suite": "scale"}
+    headline = None
+    for width in widths:
+        label = f"w{width}"
+        try:
+            point = _retry(f"scale-{width}",
+                           lambda w=width: measure_scale_point(
+                               w, sustain_s=sustain_s),
+                           attempts=2, backoff_s=2.0)
+            detail[label] = point
+            headline = point
+        except Exception as e:  # noqa: BLE001 — keep the other widths
+            print(f"# scale point {label} failed: {e}", file=sys.stderr)
+            detail[label] = {"error": str(e)[:300]}
+    return {
+        "metric": "coord_beats_per_sec_at_max_width",
+        "value": headline.get("beats_per_sec") if headline else None,
+        "unit": "beats/s",
+        "vs_baseline": None,
+        "detail": detail,
+    }
+
+
 def main(argv=None):
     import argparse
 
@@ -535,7 +674,37 @@ def main(argv=None):
                          "regression")
     ap.add_argument("--tolerance", type=float, default=0.10,
                     help="relative regression tolerance for --against")
+    ap.add_argument("--suite", choices=("default", "scale"),
+                    default="default",
+                    help="'scale' runs the control-plane width family "
+                         "(BENCH_SCALE: rendezvous/beats/tick/journal/"
+                         "resize vs gang size on virtual executors — "
+                         "CPU-only, no jax) instead of the training "
+                         "bench")
+    ap.add_argument("--out", default="",
+                    help="also write the bench json to this path")
     args = ap.parse_args(argv)
+
+    if args.suite == "scale":
+        doc = run_scale_suite()
+        print(json.dumps(doc))
+        if args.out:
+            with open(args.out, "w", encoding="utf-8") as f:
+                json.dump(doc, f, indent=1, sort_keys=True)
+                f.write("\n")
+        if args.against:
+            from tony_tpu.profiling import benchdiff
+
+            with open(args.against) as f:
+                base = json.load(f)
+            result = benchdiff.diff_bench(base, doc,
+                                          tolerance=args.tolerance)
+            print(benchdiff.format_report(result, args.against,
+                                          "(this run)"),
+                  file=sys.stderr)
+            if result["regressions"]:
+                sys.exit(1)
+        return
 
     detail = {}
 
@@ -726,6 +895,10 @@ def main(argv=None):
         "detail": detail,
     }
     print(json.dumps(doc))
+    if args.out:
+        with open(args.out, "w", encoding="utf-8") as f:
+            json.dump(doc, f, indent=1, sort_keys=True)
+            f.write("\n")
 
     if args.against:
         # Regression gate (tony_tpu/profiling/benchdiff.py): compare

@@ -23,8 +23,12 @@ from tony_tpu.conf import keys as K
 from tony_tpu.conf.config import TonyTpuConfig
 from tony_tpu.events.events import EventType
 from tony_tpu.profiling import (CKPT_BOUND, COMMS_BOUND, COMPUTE_BOUND,
-                                INPUT_BOUND, UNDERUTILIZED, build_perf_report,
-                                classify, diff_bench, phase_fractions)
+                                COORD_HEALTHY, HEARTBEAT_BOUND,
+                                INPUT_BOUND, JOURNAL_BOUND,
+                                RENDEZVOUS_BOUND, RPC_BOUND,
+                                UNDERUTILIZED, build_perf_report,
+                                classify, classify_coord, diff_bench,
+                                phase_fractions)
 from tony_tpu.profiling import benchdiff
 
 pytestmark = pytest.mark.faults
@@ -130,6 +134,54 @@ def test_classifier_golden_matrix(fractions, expected):
     assert v["category"] == expected
     assert v["evidence"], "every verdict must be evidence-backed"
     assert 0 < v["confidence"] <= 1
+
+
+# ---------------------------------------------------------------------------
+# Control-plane classifier: golden matrix for the four coordinator
+# verdicts + the healthy case (coordinator/coordphases.py fractions)
+# ---------------------------------------------------------------------------
+COORD_GOLDEN = [
+    ({"journal_fsync": 0.25, "rpc_serve": 0.10, "hb_scan": 0.02,
+      "beacon_fold": 0.03, "idle": 0.55, "other": 0.05},
+     JOURNAL_BOUND),
+    ({"hb_scan": 0.12, "beacon_fold": 0.10, "journal_fsync": 0.05,
+      "rpc_serve": 0.08, "idle": 0.60, "other": 0.05},
+     HEARTBEAT_BOUND),
+    ({"rendezvous_barrier": 0.30, "journal_fsync": 0.05,
+      "rpc_serve": 0.10, "idle": 0.50, "other": 0.05},
+     RENDEZVOUS_BOUND),
+    ({"rpc_serve": 0.40, "journal_fsync": 0.08, "hb_scan": 0.02,
+      "idle": 0.45, "other": 0.05}, RPC_BOUND),
+    ({"journal_fsync": 0.02, "rpc_serve": 0.03, "hb_scan": 0.01,
+      "beacon_fold": 0.01, "idle": 0.90, "other": 0.03},
+     COORD_HEALTHY),
+]
+
+
+@pytest.mark.parametrize("fractions,expected", COORD_GOLDEN)
+def test_coord_classifier_golden_matrix(fractions, expected):
+    v = classify_coord(fractions)
+    assert v["category"] == expected
+    assert v["evidence"], "every coord verdict must be evidence-backed"
+    assert 0 < v["confidence"] <= 1
+    # the advice names a restructure/knob, never an empty shrug
+    assert v["advice"]
+
+
+def test_coord_classifier_largest_fired_wins_and_names_the_others():
+    v = classify_coord({"journal_fsync": 0.20, "rpc_serve": 0.35,
+                        "idle": 0.40, "other": 0.05})
+    assert v["category"] == RPC_BOUND
+    assert any("JOURNAL_BOUND" in e for e in v["evidence"])
+
+
+def test_coord_classifier_advice_names_the_future_knobs():
+    assert "group-commit" in classify_coord(
+        {"journal_fsync": 0.3})["advice"]
+    assert "batch/coalesce" in classify_coord(
+        {"hb_scan": 0.1, "beacon_fold": 0.1})["advice"]
+    assert "incremental cluster-spec" in classify_coord(
+        {"rendezvous_barrier": 0.3})["advice"]
 
 
 def test_classifier_largest_waste_class_wins_and_names_the_others():

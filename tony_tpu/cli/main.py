@@ -427,6 +427,30 @@ def _phase_bar(fractions: dict, width: int = 12) -> str:
     return "".join(out)[:width + 2]
 
 
+#: coordinator phase → bar glyph (coordinator/coordphases.py order):
+#: J=journal_fsync b=beacon_fold h=hb_scan r=rpc_serve z=rendezvous
+#: p=prom_export ·=idle/other
+_COORD_PHASE_GLYPHS = (("journal_fsync", "J"), ("beacon_fold", "b"),
+                       ("hb_scan", "h"), ("rpc_serve", "r"),
+                       ("rendezvous_barrier", "z"), ("prom_export", "p"),
+                       ("idle", "·"), ("other", "·"))
+
+
+def _coord_phase_bar(fractions: dict, width: int = 16) -> str:
+    """Proportional control-plane phase bar for the top coord row:
+    'JJJr············' means ~19% journal fsync, ~6% rpc, rest idle."""
+    if not fractions:
+        return ""
+    out = []
+    for name, glyph in _COORD_PHASE_GLYPHS:
+        try:
+            n = int(round(float(fractions.get(name, 0.0)) * width))
+        except (TypeError, ValueError):
+            n = 0
+        out.append(glyph * n)
+    return "".join(out)[:width + 2]
+
+
 def _render_top(snap: dict) -> str:
     """One frame of the `tony-tpu top` live view from a metrics.live
     snapshot: per-task utilization + heartbeat age + a steps/s sparkline
@@ -446,6 +470,27 @@ def _render_top(snap: dict) -> str:
     perf = snap.get("perf") or {}
     if perf.get("verdict"):
         lines.append(f"perf: {perf['verdict']} — {perf.get('summary', '')}")
+    coord = snap.get("coord") or {}
+    if coord:
+        # Control-plane self row: is the COORDINATOR keeping up — tick
+        # duration, beat/journal throughput, fsync p99 — visible during
+        # an incident, not just in post-hoc metrics.
+        tick = coord.get("tick_s")
+        p99 = coord.get("journal_fsync_p99_s")
+        line = (f"coord: tick="
+                f"{(f'{tick * 1e3:.1f}ms' if tick is not None else '-')}"
+                f"  beats/s={coord.get('beats_per_s', '-')}"
+                f"  journal/s={coord.get('journal_records_per_s', '-')}"
+                f"  fsync p99="
+                f"{(f'{p99 * 1e3:.1f}ms' if p99 is not None else '-')}"
+                f"  reg={coord.get('registered_tasks', '-')}")
+        bar = _coord_phase_bar(coord.get("phases") or {})
+        if bar:
+            line += f"  [{bar}]"
+        lines.append(line)
+        if coord.get("verdict") and coord["verdict"] != "COORD_HEALTHY":
+            lines.append(f"coord verdict: {coord['verdict']} — "
+                         f"{coord.get('summary', '')}")
     lines.append(
         f"{'TASK':<14}{'STATUS':<11}{'STEPS':>8}{'STEPS/S':>9}"
         f"{'MFU':>7}{'HBM':>10}{'RSS':>10}{'HB AGE':>8}  "

@@ -266,3 +266,95 @@ class LocalProcessBackend(Backend):
             procs = list(self._procs.values())
         for proc in procs:
             self.kill_task(proc, grace_s=0.5)
+
+
+class VirtualExecutorBackend(Backend):
+    """Width-harness twin of :class:`LocalProcessBackend`
+    (``tony.scale.virtual-executors``): every launched task becomes a
+    beat-only in-process virtual executor (executor/virtual.py) — real
+    registration/heartbeat/result RPC traffic against the coordinator,
+    no subprocess, no user command — so the control plane is exercised
+    at 128–1024 tasks per box (``bench.py --suite scale``,
+    tests/test_scale.py). One shared :class:`VirtualGang` pump serves
+    every task; its coordinates come from the first launch spec's env
+    (the same identity contract a real executor reads)."""
+
+    def __init__(self, workdir: str, hb_interval_s: float = 1.0,
+                 steps_per_s: float = 5.0, run_s: float = 0.0,
+                 pump_threads: int = 8):
+        self.workdir = workdir
+        self.hb_interval_s = hb_interval_s
+        self.steps_per_s = steps_per_s
+        self.run_s = run_s
+        self.pump_threads = pump_threads
+        self._gang = None
+        self._handles: Dict[str, object] = {}
+        self._reported: set = set()
+        self._lock = threading.Lock()
+
+    @classmethod
+    def from_conf(cls, conf, workdir: str) -> "VirtualExecutorBackend":
+        from tony_tpu.conf import keys as K
+
+        return cls(
+            workdir,
+            hb_interval_s=conf.get_int(K.TASK_HEARTBEAT_INTERVAL_MS,
+                                       1000) / 1000.0,
+            steps_per_s=float(
+                conf.get(K.SCALE_VIRTUAL_STEPS_PER_S, 5.0) or 5.0),
+            run_s=float(conf.get(K.SCALE_VIRTUAL_RUN_S, 0.0) or 0.0),
+            pump_threads=conf.get_int(K.SCALE_VIRTUAL_PUMP_THREADS, 8))
+
+    def launch_task(self, spec: TaskLaunchSpec) -> object:
+        from tony_tpu.executor.virtual import VirtualGang
+
+        # Same launch-path fault seam every real backend passes through
+        # (``executor.spawn``) — argv itself is discarded.
+        build_executor_argv(sys.executable, spec, self.workdir)
+        env = spec.env
+        with self._lock:
+            if self._gang is None:
+                self._gang = VirtualGang(
+                    env.get(constants.COORDINATOR_HOST, "127.0.0.1"),
+                    int(env.get(constants.COORDINATOR_PORT, "0") or 0),
+                    token=env.get("TONY_RPC_TOKEN") or None,
+                    generation=int(
+                        env.get(constants.COORDINATOR_GENERATION, "0")
+                        or 0),
+                    hb_interval_s=self.hb_interval_s,
+                    steps_per_s=self.steps_per_s, run_s=self.run_s,
+                    pump_threads=self.pump_threads)
+            gang = self._gang
+        handle = gang.launch(
+            spec.task_id,
+            session_id=int(env.get(constants.SESSION_ID, "0") or 0),
+            mgen=int(env.get(constants.MEMBERSHIP_GEN, "-1") or -1))
+        with self._lock:
+            self._handles[spec.task_id] = handle
+            self._reported.discard(spec.task_id)
+        return handle
+
+    def kill_task(self, handle: object, grace_s: float = 0.0) -> None:
+        task_id = getattr(handle, "task_id", None)
+        if task_id is not None and self._gang is not None:
+            self._gang.kill(task_id)
+
+    def poll_completions(self) -> List[Tuple[str, int]]:
+        done: List[Tuple[str, int]] = []
+        with self._lock:
+            for task_id, handle in self._handles.items():
+                if task_id in self._reported:
+                    continue
+                rc = handle.poll()
+                if rc is not None:
+                    self._reported.add(task_id)
+                    done.append((task_id, int(rc)))
+        return done
+
+    def gang_active(self) -> bool:
+        with self._lock:
+            return any(h.poll() is None for h in self._handles.values())
+
+    def stop(self) -> None:
+        if self._gang is not None:
+            self._gang.stop()

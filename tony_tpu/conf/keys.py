@@ -394,6 +394,41 @@ METRICS_EXPORT_INTERVAL_S = _key(
     "into <job_dir>/metrics.prom (the portal /metrics scrape source) and "
     "snapshots counters for recovery. Control-plane-rate, not per-step.")
 
+# --- control-plane self-observation (coordinator/coordphases.py) ----------
+COORD_PHASE_RING_TICKS = _key(
+    "tony.coord.phase-ring-ticks", 256, int,
+    "Ring depth of the coordinator's own per-tick phase attribution "
+    "(hb_scan / journal_fsync / beacon_fold / prom_export / rpc_serve / "
+    "rendezvous_barrier — coordinator/coordphases.py): recent-window "
+    "tick duration and phase fractions are computed over this many "
+    "monitor ticks. Bounded by design, like the step-phase ring.")
+
+# --- width harness (cluster/local.py virtual mode, bench --suite scale) ---
+SCALE_VIRTUAL_EXECUTORS = _key(
+    "tony.scale.virtual-executors", False, bool,
+    "LocalSim width harness: the local backend launches each task as an "
+    "in-process beat-only virtual executor (executor/virtual.py) instead "
+    "of a subprocess — real RPC frames, real journal records, real "
+    "heartbeat/beacon traffic, NO user process — so rendezvous, "
+    "heartbeat and resize paths are exercised at 128–1024 tasks per box "
+    "in CI-sized time (bench.py --suite scale). Never for real "
+    "training: the tasks only pretend to step.")
+SCALE_VIRTUAL_STEPS_PER_S = _key(
+    "tony.scale.virtual-steps-per-s", 5.0, float,
+    "Synthetic step rate a virtual executor's progress beacon reports "
+    "(keeps progress-liveness and the metrics fold exercised at width).")
+SCALE_VIRTUAL_RUN_S = _key(
+    "tony.scale.virtual-run-s", 0.0, float,
+    "How long a virtual executor beats before reporting exit 0 over the "
+    "real register_execution_result path; 0 = beat until killed (the "
+    "bench's sustain window stops the job explicitly).")
+SCALE_VIRTUAL_PUMP_THREADS = _key(
+    "tony.scale.virtual-pump-threads", 8, int,
+    "Worker threads of the shared virtual-executor beat pump: hundreds "
+    "of virtual tasks multiplex their register/heartbeat/result calls "
+    "over this many threads (and RPC connections) — a thread per "
+    "virtual task would not reach 1024 tasks per box.")
+
 # --- on-demand device profiling (tony_tpu/telemetry.py capture agent) -----
 PROFILE_ENABLED = _key(
     "tony.profile.enabled", True, bool,
@@ -687,6 +722,14 @@ FAULT_QUANT_PROBE = _key(
     "unsupported on this backend — the model must degrade to the bf16 "
     "path with a one-time warning riding the metrics beacon, never fail "
     "the job.")
+FAULT_COORD_SLOW_TICK = _key(
+    "tony.fault.coord-slow-tick", "", str,
+    "Inject latency into the coordinator's monitor tick: firings stall "
+    "the tick by 'amt:X' seconds before any per-tick work runs — the "
+    "overloaded-control-plane shape the coordinator's own phase "
+    "accounting (tony_coord_phase_seconds, tick duration in `top`) must "
+    "surface. The call counter is monitor iterations, like "
+    "coordinator.crash.")
 FAULT_PROFILE_CAPTURE = _key(
     "tony.fault.profile-capture", "", str,
     "Fail an on-demand device capture at the step boundary that would "
@@ -813,7 +856,7 @@ _JOB_KEY_RE: Pattern[str] = re.compile(
 _RESERVED_NON_JOB_SEGMENTS = {
     "application", "task", "coordinator", "client", "history", "tpu", "portal",
     "keep-failed-task-dirs", "internal", "fault", "rpc", "trace", "metrics",
-    "diagnosis", "pool", "elastic", "profile", "train",
+    "diagnosis", "pool", "elastic", "profile", "train", "coord", "scale",
 }
 
 

@@ -30,6 +30,13 @@ def _make_backend(conf, workdir):
 
     kind = str(conf.get(K.APPLICATION_BACKEND, "local"))
     if kind == "local":
+        if conf.get_bool(K.SCALE_VIRTUAL_EXECUTORS):
+            # Width harness (bench --suite scale / tests/test_scale.py):
+            # beat-only in-process virtual executors instead of real
+            # subprocesses — control-plane traffic at 128–1024 tasks.
+            from tony_tpu.cluster.local import VirtualExecutorBackend
+
+            return VirtualExecutorBackend.from_conf(conf, workdir)
         # Warm-executor-pool seam (tony_tpu/pool.py): with tony.pool.dir
         # set, launches try a pool.lease before cold-spawning.
         pool_dir = os.path.expanduser(

@@ -35,6 +35,7 @@ from tony_tpu.metrics import MetricsRegistry
 from tony_tpu.conf.config import TonyTpuConfig
 from tony_tpu.conf import keys as K
 from tony_tpu.coordinator import journal, liveness
+from tony_tpu.coordinator.coordphases import CoordPhases
 from tony_tpu.coordinator.elastic import (BARRIER, DRAIN, ElasticManager,
                                           ResizeRefused)
 from tony_tpu.coordinator.journal import SessionJournal
@@ -300,6 +301,23 @@ class Coordinator:
         self._prom_interval_s = float(
             conf.get(K.METRICS_EXPORT_INTERVAL_S, 2.0) or 2.0)
         self._prom_last_write = 0.0
+        # Prometheus rendering walks every series — milliseconds at
+        # thousand-task width (measured by the prom_export phase below)
+        # — so the render+write runs on a single-flight worker, never
+        # on the monitor tick or a beat.
+        self._prom_thread: Optional[threading.Thread] = None
+
+        # --- control-plane self-observation (coordinator/coordphases.py):
+        # the coordinator's OWN per-tick phase ring — hb_scan /
+        # journal_fsync / beacon_fold / prom_export / rpc_serve /
+        # rendezvous_barrier, sum-to-wall like step phases — exported as
+        # tony_coord_* families and classified by the control-plane
+        # verdicts (profiling/verdict.py classify_coord). This is the
+        # measurement layer the width restructuring (ROADMAP item 5)
+        # is aimed by.
+        self.coordphases = CoordPhases(
+            conf.get_int(K.COORD_PHASE_RING_TICKS, 256))
+        self._coord_counter_prev: Dict[str, float] = {}
 
         if rpc_token is None and conf.get_bool(K.APPLICATION_SECURITY_ENABLED):
             import secrets
@@ -329,7 +347,8 @@ class Coordinator:
         # even an immediately-recrashed coordinator leaves a fence trail.
         self.journal = SessionJournal(
             self.journal_path,
-            enabled=conf.get_bool(K.COORDINATOR_JOURNAL_ENABLED, True))
+            enabled=conf.get_bool(K.COORDINATOR_JOURNAL_ENABLED, True),
+            observer=self.coordphases.note_journal_append)
         self.journal.generation(self.generation)
         if st is None:
             self.journal.app(app_id, self._started_ms, self.user)
@@ -380,6 +399,11 @@ class Coordinator:
         """RpcServer hook: every dispatched request lands in the server
         latency histogram + request counter, and significant ones get a
         span parented under the caller's trace context."""
+        # Control-plane self-observation: the dispatch's wall (minus
+        # whatever its handler already booked to a named phase — journal
+        # appends, the beacon fold) lands in the rpc_serve tick phase,
+        # and heartbeats feed the beats/s rate.
+        self.coordphases.note_dispatch(method, seconds)
         app = {"app": self.app_id}
         self.metrics.histogram(
             "tony_rpc_server_seconds", {**app, "method": method},
@@ -467,11 +491,32 @@ class Coordinator:
     def _maybe_write_prom(self, force: bool = False) -> None:
         """Refresh <job_dir>/metrics.prom (atomic replace) + the counter
         snapshot, throttled to the export cadence — the file the portal
-        serves live at /metrics."""
+        serves live at /metrics. The gauge refresh (O(tasks)) stays on
+        the caller; the RENDER (O(all series) — the measured bulk at
+        width) runs on a single-flight worker thread so neither a beat
+        nor a monitor tick pays it. ``force`` (teardown) renders
+        synchronously: the final exposition must be on disk before the
+        coordinator exits."""
         now = time.monotonic()
         if not force and now - self._prom_last_write < self._prom_interval_s:
             return
         self._prom_last_write = now
+        with self.coordphases.phase("prom_export"):
+            self._update_prom_gauges()
+        if force:
+            self._render_prom()
+            return
+        t = self._prom_thread
+        if t is None or not t.is_alive():
+            self._prom_thread = threading.Thread(
+                target=self._render_prom, name="prom-export", daemon=True)
+            self._prom_thread.start()
+
+    def _update_prom_gauges(self) -> None:
+        """The cheap half of an export: refresh the coordinator-owned
+        gauges (per-task liveness, gang sizes, and the control-plane
+        self-observation families) in the registry."""
+        now = time.monotonic()
         app = {"app": self.app_id}
         self.metrics.gauge(
             "tony_coordinator_generation", app,
@@ -507,12 +552,66 @@ class Coordinator:
                 help="Elastic membership generation (bumps on every "
                      "resize; the topology fence).").set(
                 self.elastic.mgen)
-        text = self.metrics.render()
-        try:
-            durable.atomic_write(self._prom_path, text.encode("utf-8"))
-        except OSError as e:
-            log.debug("metrics.prom write failed: %s", e)
-        self.metrics.save_counters(self._counters_path)
+        self._update_coord_metrics(app)
+
+    def _update_coord_metrics(self, app: Dict[str, str]) -> None:
+        """Control-plane self-observation families: the coordinator's own
+        phase seconds, tick duration, journal throughput + fsync
+        histogram, beats received, registered-task count."""
+        snap = self.coordphases.snapshot()
+        if not snap:
+            return
+        for name, secs in sorted((snap.get("cum") or {}).items()):
+            self.metrics.gauge(
+                "tony_coord_phase_seconds", {**app, "phase": str(name)},
+                help="Cumulative seconds of the coordinator's own tick "
+                     "wall attributed to each control-plane phase "
+                     "(coordinator/coordphases.py; 'other' = "
+                     "unattributed, 'idle' = the monitor sleep)."
+            ).set(float(secs))
+        self.metrics.gauge(
+            "tony_coord_tick_seconds", app,
+            help="Recent mean ACTIVE coordinator tick duration "
+                 "(attributed non-idle work per monitor tick — the "
+                 "number that grows with gang width).").set(
+            float(snap.get("tick_active_s", 0.0)))
+        self.metrics.gauge(
+            "tony_coord_registered_tasks", app,
+            help="Tasks currently registered with the coordinator."
+        ).set(self.session.num_registered)
+        for metric, key_, help_ in (
+                ("tony_coord_beats_total", "beats_total",
+                 "Heartbeats received by the coordinator."),
+                ("tony_journal_records_total", "journal_records_total",
+                 "Write-ahead journal records appended (each one "
+                 "fsync'd)."),
+                ("tony_journal_bytes_total", "journal_bytes_total",
+                 "Write-ahead journal bytes appended.")):
+            cur = float(snap.get(key_, 0) or 0)
+            prev = self._coord_counter_prev.get(metric, 0.0)
+            self.metrics.counter(metric, app, help=help_).inc(
+                max(0.0, cur - prev))
+            self._coord_counter_prev[metric] = cur
+        fsync = snap.get("fsync")
+        if isinstance(fsync, dict):
+            self.metrics.set_histogram_snapshot(
+                "tony_journal_fsync_seconds", app, fsync,
+                help="Write-ahead journal append latency (fsync "
+                     "included) — the histogram behind JOURNAL_BOUND "
+                     "evidence.")
+
+    def _render_prom(self) -> None:
+        """The expensive half of an export: render the whole exposition
+        and write it (atomic replace) + snapshot counters for recovery.
+        Runs on the export worker (or synchronously at teardown)."""
+        with self.coordphases.phase("prom_export"):
+            text = self.metrics.render()
+            try:
+                durable.atomic_write(self._prom_path,
+                                     text.encode("utf-8"))
+            except OSError as e:
+                log.debug("metrics.prom write failed: %s", e)
+            self.metrics.save_counters(self._counters_path)
 
     def metrics_live(self) -> dict:
         """The `tony-tpu top` feed: current utilization + liveness per
@@ -581,7 +680,42 @@ class Coordinator:
                                 "fractions": doc["fractions"]}
         if self.elastic is not None:
             snap["elastic"] = self.elastic.snapshot()
+        coord = self._coord_live_row()
+        if coord is not None:
+            # Coordinator self row (`tony-tpu top` control-plane
+            # section): control-plane health must be visible DURING an
+            # incident, not only in post-hoc metrics.
+            snap["coord"] = coord
         return snap
+
+    def _coord_live_row(self) -> Optional[dict]:
+        """The control-plane self row for metrics.live/top: tick
+        duration, beats/s, journal fsync p99 + records/s, registered
+        tasks, recent phase fractions, and the control-plane verdict."""
+        snap = self.coordphases.snapshot()
+        if not snap:
+            return None
+        fr = self.coordphases.fractions()
+        row: Dict[str, object] = {
+            "tick_s": round(float(snap.get("tick_active_s", 0.0)), 6),
+            "tick_wall_s": round(float(snap.get("recent_wall_s", 0.0)),
+                                 6),
+            "beats_per_s": round(float(snap.get("beats_per_sec", 0.0)),
+                                 2),
+            "journal_records_per_s": round(
+                float(snap.get("journal_records_per_sec", 0.0)), 2),
+            "journal_fsync_p99_s": round(
+                float(snap.get("journal_fsync_p99_s", 0.0)), 6),
+            "registered_tasks": self.session.num_registered,
+        }
+        if fr:
+            row["phases"] = {k: round(v, 4) for k, v in fr.items()}
+            from tony_tpu import profiling
+
+            v = profiling.classify_coord(fr)
+            row["verdict"] = v["category"]
+            row["summary"] = v["summary"]
+        return row
 
     # ------------------------------------------------------------------
     # On-demand device profiling (tony-tpu profile <app>)
@@ -1022,8 +1156,12 @@ class Coordinator:
                 self._last_hb[task_id] = time.monotonic()
         # The beacon doubles as the live-metrics feed: utilization gauges
         # and the executor's client-latency histogram ride the same dict
-        # the liveness tracker reads steps from.
-        self._observe_beacon(task_id, progress)
+        # the liveness tracker reads steps from. The fold runs inline on
+        # the beat path — its cost is booked to the beacon_fold tick
+        # phase (and subtracted from rpc_serve), so a width problem here
+        # indicts as HEARTBEAT_BOUND instead of hiding.
+        with self.coordphases.phase("beacon_fold"):
+            self._observe_beacon(task_id, progress)
         if self.progress.observe(task_id, progress):
             self._maybe_journal_progress(task_id)
         resp: Dict[str, object] = {}
@@ -1934,6 +2072,9 @@ class Coordinator:
         reg_timeout_s = self.conf.get_int(K.TASK_REGISTRATION_TIMEOUT_S, 900)
         regrace_s = self.conf.get_int(K.COORDINATOR_REREGISTRATION_GRACE_S,
                                       60)
+        # Anchor the self-observation clock: the first tick_done only
+        # records "now" so the first folded interval is a real tick.
+        self.coordphases.tick_done()
         while True:
             if faults.fire("coordinator.crash"):
                 # The SIGKILL shape: no teardown, no history finalize, no
@@ -1943,22 +2084,31 @@ class Coordinator:
                 log.critical("FAULT coordinator.crash: hard-exiting with "
                              "no teardown (os._exit)")
                 os._exit(137)
+            slow_tick = faults.fire_amount("coord.slow-tick")
+            if slow_tick:
+                # Injected control-plane stall: the tick stretches by the
+                # configured amount BEFORE any per-tick work, so the
+                # slowdown lands in the tick-duration accounting the
+                # self-observation surfaces must show.
+                time.sleep(slow_tick)
             if self._reregistration_grace and self.session.all_registered():
                 log.info("recovery: all surviving tasks re-registered; "
                          "resuming normal monitoring")
                 self._reregistration_grace = False
-            if self._rendezvous_span is not None \
-                    and self.session.all_registered():
-                # The gang barrier opened: every later step (first step,
-                # epochs) hangs off a closed rendezvous on the timeline.
-                self._rendezvous_span.end(
-                    registered=self.session.num_registered)
-                self._rendezvous_span = None
-                if self.elastic is not None:
-                    # Resizes only make sense against an established
-                    # gang; losses before this point are rendezvous
-                    # failures, not absorbable churn.
-                    self.elastic.established = True
+            with self.coordphases.phase("rendezvous_barrier"):
+                if self._rendezvous_span is not None \
+                        and self.session.all_registered():
+                    # The gang barrier opened: every later step (first
+                    # step, epochs) hangs off a closed rendezvous on the
+                    # timeline.
+                    self._rendezvous_span.end(
+                        registered=self.session.num_registered)
+                    self._rendezvous_span = None
+                    if self.elastic is not None:
+                        # Resizes only make sense against an established
+                        # gang; losses before this point are rendezvous
+                        # failures, not absorbable churn.
+                        self.elastic.established = True
             # Live-metrics export (throttled internally): keeps the
             # portal's /metrics exposition fresh while the job runs.
             self._maybe_write_prom()
@@ -2000,14 +2150,19 @@ class Coordinator:
                 return self.session.status
             for task_id, exit_code in self.backend.poll_completions():
                 self._process_completion(task_id, exit_code)
-            self._check_heartbeats()
+            with self.coordphases.phase("hb_scan"):
+                self._check_heartbeats()
             self._check_progress()
             self._elastic_tick()
             if self.session.status != SessionStatus.RUNNING:
                 return self.session.status
             if self.session.training_finished():
                 return self.session.update_status()
-            time.sleep(interval)
+            with self.coordphases.phase("idle"):
+                time.sleep(interval)
+            # Close this tick's attribution interval (sum-to-wall fold,
+            # like step_done for the data plane).
+            self.coordphases.tick_done()
 
     def _kill_all_tasks(self, grace_s: float,
                         mark: str = "killed") -> None:

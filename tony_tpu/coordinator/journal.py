@@ -105,19 +105,33 @@ class ReplayState:
 
 class SessionJournal:
     """Append side. ``enabled=False`` turns every append into a no-op so
-    the journal can be conf-gated without littering call sites."""
+    the journal can be conf-gated without littering call sites.
 
-    def __init__(self, path: str, enabled: bool = True):
+    ``observer`` is the control-plane self-observation seam
+    (coordinator/coordphases.py): called ``(n_bytes, seconds)`` after
+    every fsync'd append, it feeds the ``journal_fsync`` tick phase, the
+    fsync-latency histogram, and the records/bytes rate counters — the
+    numbers behind the JOURNAL_BOUND verdict. Best-effort by contract:
+    an observer failure must never fail a write-ahead append."""
+
+    def __init__(self, path: str, enabled: bool = True, observer=None):
         self.path = path
         self.enabled = enabled
+        self.observer = observer
         self._log: Optional[AppendLog] = AppendLog(path) if enabled else None
 
     def append(self, record: Dict) -> None:
         if self._log is None:
             return
         record.setdefault("ts", int(time.time() * 1000))
-        self._log.append(
-            (json.dumps(record, sort_keys=True) + "\n").encode("utf-8"))
+        data = (json.dumps(record, sort_keys=True) + "\n").encode("utf-8")
+        t0 = time.monotonic()
+        self._log.append(data)
+        if self.observer is not None:
+            try:
+                self.observer(len(data), time.monotonic() - t0)
+            except Exception:  # noqa: BLE001 — observation is best-effort
+                log.exception("journal observer failed")
 
     # -- typed convenience appenders (one per record shape) ---------------
     def generation(self, generation: int) -> None:

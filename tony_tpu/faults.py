@@ -66,6 +66,13 @@ site                      where it fires
                           unsupported backend; the model must degrade to
                           bf16 with a one-time beacon warning, never
                           fail the job
+``coord.slow-tick``       Coordinator._monitor loop: a firing stalls the
+                          tick by ``amt:`` seconds before any per-tick
+                          work — the overloaded-control-plane shape the
+                          coordinator's own phase accounting must
+                          surface (tick duration in ``top``, the
+                          control-plane verdicts); the call counter is
+                          monitor iterations
 ========================  =====================================================
 
 Spec grammar (the value of ``tony.fault.<site>`` conf keys, or one
@@ -122,7 +129,7 @@ SITES = ("rpc.connect", "rpc.send", "rpc.slow", "heartbeat",
          "user.hang", "user.slow_step",
          "pool.lease", "pool.stale", "pool.adopt",
          "host.loss", "resize.barrier", "resize.remesh",
-         "profile.capture", "quant.probe")
+         "profile.capture", "quant.probe", "coord.slow-tick")
 
 
 class InjectedFault(ConnectionError):

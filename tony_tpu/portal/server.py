@@ -313,7 +313,37 @@ class PortalServer:
         self._send_html(
             req, f"<h1>metrics — {html.escape(job_id)}</h1>"
                  f"<table border=1 cellpadding=4><tr><th>task</th>{head}"
-                 f"</tr>{rows}</table>" + self._liveness_incidents(evs))
+                 f"</tr>{rows}</table>" + self._coord_section(job_id)
+                 + self._liveness_incidents(evs))
+
+    def _coord_section(self, job_id: str) -> str:
+        """Control-plane self-observation table for the metrics view:
+        the coordinator's own tony_coord_*/tony_journal_* families out
+        of the job's live exposition (coordinator/coordphases.py) — is
+        the CONTROL PLANE keeping up, next to whether the tasks are."""
+        job_dir = self._job_dir(job_id)
+        if job_dir is None:
+            return ""
+        path = os.path.join(job_dir, constants.METRICS_PROM_FILE)
+        try:
+            with open(path, "r", encoding="utf-8") as f:
+                text = f.read()
+        except OSError:
+            return ""
+        rows = []
+        for line in text.splitlines():
+            if line.startswith("#"):
+                continue
+            if line.startswith(("tony_coord_", "tony_journal_records",
+                                "tony_journal_bytes")):
+                name, _, value = line.rpartition(" ")
+                rows.append(f"<tr><td><code>{html.escape(name)}</code>"
+                            f"</td><td>{html.escape(value)}</td></tr>")
+        if not rows:
+            return ""
+        return ("<h2>control plane (coordinator self-observation)</h2>"
+                "<table border=1 cellpadding=4><tr><th>series</th>"
+                "<th>value</th></tr>" + "".join(rows) + "</table>")
 
     #: progress-liveness event types surfaced as incidents on the metrics
     #: view (coordinator/liveness.py verdicts).

@@ -23,7 +23,11 @@ act on:
 from tony_tpu.profiling.benchdiff import diff_bench  # noqa: F401
 from tony_tpu.profiling.verdict import (COMPUTE_BOUND,  # noqa: F401
                                         CKPT_BOUND, COMMS_BOUND,
-                                        INPUT_BOUND, UNDERUTILIZED,
+                                        COORD_HEALTHY, COORD_VERDICTS,
+                                        HEARTBEAT_BOUND, INPUT_BOUND,
+                                        JOURNAL_BOUND, RENDEZVOUS_BOUND,
+                                        RPC_BOUND, UNDERUTILIZED,
                                         VERDICTS, build_perf_report,
-                                        classify, load_perf,
-                                        phase_fractions, save_perf)
+                                        classify, classify_coord,
+                                        load_perf, phase_fractions,
+                                        save_perf)

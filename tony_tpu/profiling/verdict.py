@@ -43,6 +43,22 @@ UNDERUTILIZED = "UNDERUTILIZED"
 VERDICTS = (INPUT_BOUND, CKPT_BOUND, COMMS_BOUND, COMPUTE_BOUND,
             UNDERUTILIZED)
 
+# --- control-plane verdicts (coordinator self-observation) -----------------
+# classify_coord consumes the COORDINATOR's own per-tick phase fractions
+# (coordinator/coordphases.py) and names which O(n) control-plane loop is
+# eating the tick — the numbers that aim the width restructuring
+# (ROADMAP item 5: batched heartbeats, group-commit journal, hierarchical
+# beacon fan-in, incremental cluster-spec deltas).
+JOURNAL_BOUND = "JOURNAL_BOUND"
+HEARTBEAT_BOUND = "HEARTBEAT_BOUND"
+RENDEZVOUS_BOUND = "RENDEZVOUS_BOUND"
+RPC_BOUND = "RPC_BOUND"
+COORD_HEALTHY = "COORD_HEALTHY"
+
+#: every category classify_coord can return (golden-matrix test anchor).
+COORD_VERDICTS = (JOURNAL_BOUND, HEARTBEAT_BOUND, RENDEZVOUS_BOUND,
+                  RPC_BOUND, COORD_HEALTHY)
+
 #: schema version stamped into perf.json — bump on breaking changes.
 PERF_SCHEMA = 1
 
@@ -157,6 +173,93 @@ def classify(fractions: Dict[str, float]) -> Dict[str, Any]:
         "category": category,
         "summary": _ADVICE[category],
         "advice": _ADVICE[category],
+        "confidence": round(confidence, 3),
+        "evidence": evidence,
+        "fractions": {k: round(v, 4) for k, v in f.items()},
+    }
+
+
+#: control-plane thresholds: fraction of the coordinator's tick wall a
+#: loop must eat before it is indicted (the tick wall includes the
+#: monitor sleep, so even 15% of wall means the loop dominates the
+#: coordinator's ACTIVE time many times over).
+COORD_JOURNAL_THRESHOLD = 0.15
+COORD_HEARTBEAT_THRESHOLD = 0.15
+COORD_RENDEZVOUS_THRESHOLD = 0.15
+COORD_RPC_THRESHOLD = 0.25
+
+#: control-plane verdict → the restructure it prescribes. These name the
+#: FUTURE knobs on purpose: the PR-12 width work (ROADMAP item 5) spends
+#: exactly these verdicts, the way PR 10 spent COMMS_BOUND.
+_COORD_ADVICE = {
+    JOURNAL_BOUND: "fsync-per-journal-record dominates the tick — "
+                   "group-commit the journal (batch appends per fsync) "
+                   "before growing the gang further",
+    HEARTBEAT_BOUND: "per-beat work (heartbeat scan + beacon fold) "
+                     "dominates — batch/coalesce heartbeats and move to "
+                     "hierarchical (per-jobtype sub-aggregator) beacon "
+                     "fan-in",
+    RENDEZVOUS_BOUND: "the global rendezvous barrier dominates — "
+                      "hierarchical registration and incremental "
+                      "cluster-spec deltas instead of full re-broadcast",
+    RPC_BOUND: "RPC dispatch itself dominates — batch the per-task "
+               "control RPCs (one frame per host, not per task) or "
+               "shard the serve plane",
+    COORD_HEALTHY: "the control plane keeps up at this width — no "
+                   "restructure indicated",
+}
+
+
+def classify_coord(fractions: Dict[str, float]) -> Dict[str, Any]:
+    """One control-plane verdict over a coordinator phase-fraction map
+    (coordphases.fractions()). Same contract as classify(): every
+    verdict is evidence-backed with the numbers and thresholds that
+    fired, and the advice names the knob to spend it on."""
+    f = {k: float(v) for k, v in (fractions or {}).items()}
+    journal = f.get("journal_fsync", 0.0)
+    beats = f.get("hb_scan", 0.0) + f.get("beacon_fold", 0.0)
+    rendezvous = f.get("rendezvous_barrier", 0.0)
+    rpc = f.get("rpc_serve", 0.0)
+    idle = f.get("idle", 0.0)
+    evidence: List[str] = []
+    fired = []
+    if journal >= COORD_JOURNAL_THRESHOLD:
+        fired.append((journal, JOURNAL_BOUND,
+                      f"journal_fsync = {journal:.1%} of tick wall "
+                      f"(threshold {COORD_JOURNAL_THRESHOLD:.0%})"))
+    if beats >= COORD_HEARTBEAT_THRESHOLD:
+        fired.append((beats, HEARTBEAT_BOUND,
+                      f"hb_scan+beacon_fold = {beats:.1%} of tick wall "
+                      f"(threshold {COORD_HEARTBEAT_THRESHOLD:.0%})"))
+    if rendezvous >= COORD_RENDEZVOUS_THRESHOLD:
+        fired.append((rendezvous, RENDEZVOUS_BOUND,
+                      f"rendezvous_barrier = {rendezvous:.1%} of tick "
+                      f"wall (threshold "
+                      f"{COORD_RENDEZVOUS_THRESHOLD:.0%})"))
+    if rpc >= COORD_RPC_THRESHOLD:
+        fired.append((rpc, RPC_BOUND,
+                      f"rpc_serve = {rpc:.1%} of tick wall (threshold "
+                      f"{COORD_RPC_THRESHOLD:.0%})"))
+    if fired:
+        fired.sort(reverse=True)
+        frac, category, line = fired[0]
+        evidence.append(line)
+        for _, other_cat, other_line in fired[1:]:
+            evidence.append(f"also fired: {other_cat} ({other_line})")
+        evidence.append(f"idle = {idle:.1%}")
+        confidence = min(0.95, 0.5 + frac)
+    else:
+        category = COORD_HEALTHY
+        evidence.append(
+            f"no control-plane loop above threshold: journal_fsync = "
+            f"{journal:.1%}, hb_scan+beacon_fold = {beats:.1%}, "
+            f"rendezvous_barrier = {rendezvous:.1%}, rpc_serve = "
+            f"{rpc:.1%}, idle = {idle:.1%}")
+        confidence = min(0.9, 0.4 + idle)
+    return {
+        "category": category,
+        "summary": _COORD_ADVICE[category],
+        "advice": _COORD_ADVICE[category],
         "confidence": round(confidence, 3),
         "evidence": evidence,
         "fractions": {k: round(v, 4) for k, v in f.items()},
