@@ -153,3 +153,50 @@ def pytest_runtest_teardown(item):
         yield
     finally:
         _disarm(old)
+
+
+# ---------------------------------------------------------------------------
+# Protocol invariant checking of drill artifacts (tonycheck: tony_tpu/
+# devtools/invariants.py). Every e2e and virtual-gang drill that ran a
+# real coordinator left a job dir (journal + span log + metrics) under
+# its tmp_path; verify the control-plane protocol held at teardown, so
+# every existing slow drill doubles as a protocol test. Opt out with
+# TONY_CHECK_ARTIFACTS=0.
+# ---------------------------------------------------------------------------
+@pytest.hookimpl(hookwrapper=True)
+def pytest_runtest_makereport(item, call):
+    outcome = yield
+    rep = outcome.get_result()
+    setattr(item, "_tony_rep_" + rep.when, rep)
+
+
+@pytest.fixture(autouse=True)
+def _verify_drill_artifacts(request):
+    """Autouse teardown gate: run `tony-tpu check` over every job dir
+    the test produced. Scoped to the e2e/scale drill modules, and only
+    when the test itself PASSED — a failing test's artifacts are
+    evidence, not a second failure."""
+    # Resolve tmp_path at SETUP (declaring the dependency orders this
+    # fixture's teardown before tmp_path's — at teardown time the value
+    # is no longer requestable).
+    tmp_path = None
+    mod = request.module.__name__.rpartition(".")[2]
+    if (os.environ.get("TONY_CHECK_ARTIFACTS", "") != "0"
+            and (mod.startswith("test_e2e") or mod == "test_scale")
+            and "tmp_path" in request.fixturenames):
+        tmp_path = request.getfixturevalue("tmp_path")
+    yield
+    if tmp_path is None:
+        return
+    rep_call = getattr(request.node, "_tony_rep_call", None)
+    if rep_call is None or not rep_call.passed:
+        return
+    from tony_tpu.devtools import invariants
+
+    reports = invariants.check_tree(str(tmp_path))
+    bad = [r for r in reports if not r.ok]
+    if bad:
+        pytest.fail(
+            "protocol invariant violation(s) in this drill's job "
+            "artifacts (tony-tpu check):\n"
+            + invariants.render_text(bad), pytrace=False)

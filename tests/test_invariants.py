@@ -1,0 +1,393 @@
+"""tony-tpu check — the cross-artifact trace invariant checker
+(tony_tpu/devtools/invariants.py).
+
+Constructed job dirs, one invariant violated per test, each asserting
+the exact violation rule + message shape (the ISSUE-12 fixture list:
+torn-tail journal, superseded resize, unclosed span, stale-gen beat),
+plus the clean golden dir, the CLI surface, and status-aware leniency
+(failure paths degrade end-state invariants to notes, never false
+violations).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+
+import pytest
+
+from tony_tpu import constants
+from tony_tpu.cli.main import main as cli_main
+from tony_tpu.devtools import invariants
+
+pytestmark = pytest.mark.faults
+
+
+def _write_journal(job_dir, records):
+    os.makedirs(job_dir, exist_ok=True)
+    path = os.path.join(job_dir, constants.JOURNAL_FILE)
+    with open(path, "w", encoding="utf-8") as f:
+        for rec in records:
+            f.write(json.dumps(rec, sort_keys=True) + "\n")
+    return path
+
+
+def _write_spans(job_dir, records):
+    path = os.path.join(job_dir, constants.TRACE_FILE)
+    with open(path, "w", encoding="utf-8") as f:
+        for rec in records:
+            f.write(json.dumps(rec, sort_keys=True) + "\n")
+    return path
+
+
+def _finalize(job_dir, status="SUCCEEDED"):
+    """Stamp a finalized jhist filename so the checker applies the
+    strict (SUCCEEDED) invariants."""
+    from tony_tpu.events import history
+
+    now = int(time.time() * 1000)
+    name = history.final_name("app_x", now - 1000, now, "tester", status)
+    open(os.path.join(job_dir, name), "w").close()
+
+
+def _base_journal(session=0):
+    return [
+        {"t": "gen", "generation": 1},
+        {"t": "app", "app_id": "app-x", "started_ms": 1, "user": "t"},
+        {"t": "epoch", "session": session, "infra_used": 0,
+         "preempt_used": 0},
+        {"t": "job_scheduled", "job": "worker", "session": session},
+        {"t": "task", "task": "worker:0", "status": "SCHEDULED",
+         "session": session},
+        {"t": "register", "task": "worker:0", "host": "h", "port": 1,
+         "session": session},
+    ]
+
+
+def _violations(job_dir, rule=None):
+    rep = invariants.check_job_dir(str(job_dir))
+    if rule is None:
+        return rep.violations
+    return [v for v in rep.violations if v.rule == rule]
+
+
+# ---------------------------------------------------------------------------
+# golden clean dir
+# ---------------------------------------------------------------------------
+def test_clean_job_dir_passes(tmp_path):
+    job = tmp_path / "job"
+    recs = _base_journal() + [
+        {"t": "progress", "task": "worker:0", "steps": 5.0, "session": 0},
+        {"t": "task", "task": "worker:0", "status": "SUCCEEDED",
+         "session": 0, "exit": 0},
+        {"t": "job_completed", "job": "worker", "session": 0},
+    ]
+    _write_journal(str(job), recs)
+    _write_spans(str(job), [
+        {"ev": "X", "trace": "t", "span": "c1", "parent": "",
+         "name": "client.submit", "svc": "client", "task": "",
+         "ts_us": 1, "dur_us": 10, "args": {}},
+        {"ev": "B", "trace": "t", "span": "s1", "parent": "c1",
+         "name": "coordinator.run", "svc": "coordinator", "task": "",
+         "ts_us": 2, "args": {}},
+        {"ev": "E", "span": "s1", "ts_us": 9, "args": {}},
+    ])
+    _finalize(str(job))
+    rep = invariants.check_job_dir(str(job))
+    assert rep.ok, invariants.render_text([rep])
+    assert rep.checked[constants.JOURNAL_FILE] == 9
+    assert rep.checked[constants.TRACE_FILE] == 3
+
+
+# ---------------------------------------------------------------------------
+# journal invariants
+# ---------------------------------------------------------------------------
+def test_torn_tail_journal_is_a_note_not_a_violation(tmp_path):
+    """The crash window: an unterminated/undecodable final line is the
+    documented torn-write shape — the prefix is checked, the tail is a
+    note (write-ahead discipline makes the prefix the truth)."""
+    job = tmp_path / "job"
+    path = _write_journal(str(job), _base_journal())
+    with open(path, "a", encoding="utf-8") as f:
+        f.write('{"t": "task", "task": "worker:0", "st')   # torn
+    rep = invariants.check_job_dir(str(job))
+    assert rep.ok, invariants.render_text([rep])
+    assert any("torn" in n for n in rep.notes)
+    assert rep.checked[constants.JOURNAL_FILE] == 6   # prefix only
+
+
+def test_generation_step_back_is_flagged(tmp_path):
+    job = tmp_path / "job"
+    _write_journal(str(job), [
+        {"t": "gen", "generation": 3},
+        {"t": "gen", "generation": 2},    # a zombie's bump landed late
+    ])
+    v = _violations(job, "journal-gen-monotonic")
+    assert len(v) == 1
+    assert "generation 2 does not supersede 3" in v[0].message
+    assert v[0].record == 2
+    assert '"generation": 2' in v[0].evidence
+
+
+def test_superseded_resize_is_clean_but_mgen_step_back_is_not(tmp_path):
+    """A start superseded by a newer start then applied is the
+    documented second-host-dies-during-drain shape — clean. A LOWER
+    mgen landing after it is a stale-topology record — flagged."""
+    job = tmp_path / "job"
+    base = _base_journal()
+    ok = base + [
+        {"t": "resize", "job": "worker", "mgen": 2, "members": [0, 1],
+         "phase": "start", "session": 0, "reason": "host loss"},
+        {"t": "resize", "job": "worker", "mgen": 3, "members": [0],
+         "phase": "start", "session": 0, "reason": "second host loss"},
+        {"t": "resize", "job": "worker", "mgen": 3, "members": [0],
+         "phase": "applied", "session": 0},
+    ]
+    _write_journal(str(job), ok)
+    assert _violations(job) == []
+
+    bad = ok + [
+        {"t": "resize", "job": "worker", "mgen": 2, "members": [0, 1],
+         "phase": "applied", "session": 0},   # stale mgen after fence
+    ]
+    _write_journal(str(job), bad)
+    v = _violations(job, "journal-mgen-monotonic")
+    assert len(v) == 1
+    assert "membership generation 2 steps back from 3" in v[0].message
+
+
+def test_dangling_resize_start_flagged_only_on_succeeded_jobs(tmp_path):
+    recs = _base_journal() + [
+        {"t": "resize", "job": "worker", "mgen": 2, "members": [0],
+         "phase": "start", "session": 0, "reason": "drain"},
+    ]
+    # Unfinished/failed job: the open start IS the --recover re-entry
+    # record — a note, not a violation.
+    job = tmp_path / "unfinished"
+    _write_journal(str(job), recs)
+    rep = invariants.check_job_dir(str(job))
+    assert rep.ok
+    assert any("never applied" in n for n in rep.notes)
+    # SUCCEEDED job: a resize left in flight is a protocol breach.
+    job2 = tmp_path / "finished"
+    _write_journal(str(job2), recs)
+    _finalize(str(job2))
+    v = _violations(job2, "journal-resize-dangling")
+    assert len(v) == 1
+    assert "mgen 2" in v[0].message and "never applied" in v[0].message
+
+
+def test_stale_epoch_record_after_fence_is_flagged(tmp_path):
+    """The stale-gen beat shape: a record carrying an old session id
+    appended after a newer epoch fence means a zombie frame was
+    accepted post-fence."""
+    job = tmp_path / "job"
+    _write_journal(str(job), _base_journal() + [
+        {"t": "epoch", "session": 1, "infra_used": 1, "preempt_used": 0},
+        {"t": "progress", "task": "worker:0", "steps": 9.0,
+         "session": 0},                      # epoch-0 beat after fence
+    ])
+    v = _violations(job, "journal-stale-epoch")
+    assert len(v) == 1
+    assert ("record for session 0 appended while the epoch fence is at "
+            "session 1") in v[0].message
+    assert v[0].record == 8
+
+
+def test_terminal_transition_and_post_terminal_register_flagged(tmp_path):
+    job = tmp_path / "job"
+    _write_journal(str(job), _base_journal() + [
+        {"t": "task", "task": "worker:0", "status": "SUCCEEDED",
+         "session": 0, "exit": 0},
+        {"t": "register", "task": "worker:0", "host": "h", "port": 2,
+         "session": 0},                      # register after finish
+        {"t": "task", "task": "worker:0", "status": "RUNNING",
+         "session": 0},                      # resurrection
+    ])
+    v = _violations(job, "journal-terminal")
+    assert len(v) == 2
+    assert "register record" in v[0].message
+    assert "transitions SUCCEEDED → RUNNING" in v[1].message
+
+
+def test_applied_resize_resets_the_terminal_fold(tmp_path):
+    """The journaled absorb path: a lost member goes FAILED, the applied
+    resize keeps its index (replacement relaunch), and the fresh
+    SCHEDULED record must NOT read as a terminal resurrection."""
+    job = tmp_path / "job"
+    _write_journal(str(job), _base_journal() + [
+        {"t": "task", "task": "worker:1", "status": "FAILED",
+         "session": 0, "exit": 137},
+        {"t": "resize", "job": "worker", "mgen": 2, "members": [0, 1],
+         "phase": "start", "session": 0, "reason": "replace lost host"},
+        {"t": "resize", "job": "worker", "mgen": 2, "members": [0, 1],
+         "phase": "applied", "session": 0},
+        {"t": "task", "task": "worker:1", "status": "SCHEDULED",
+         "session": 0},
+    ])
+    assert _violations(job) == []
+
+
+# ---------------------------------------------------------------------------
+# span-log invariants
+# ---------------------------------------------------------------------------
+def _spans_with_unclosed():
+    return [
+        {"ev": "B", "trace": "t", "span": "s1", "parent": "",
+         "name": "coordinator.run", "svc": "coord", "task": "",
+         "ts_us": 1, "args": {}},
+        {"ev": "B", "trace": "t", "span": "s2", "parent": "s1",
+         "name": "task.lifecycle", "svc": "coord", "task": "worker:0",
+         "ts_us": 2, "args": {}},
+        {"ev": "E", "span": "s1", "ts_us": 9, "args": {}},
+        # s2 never closes
+    ]
+
+
+def test_unclosed_span_flagged_on_clean_succeeded_run(tmp_path):
+    job = tmp_path / "job"
+    _write_journal(str(job), _base_journal() + [
+        {"t": "task", "task": "worker:0", "status": "SUCCEEDED",
+         "session": 0, "exit": 0}])
+    _write_spans(str(job), _spans_with_unclosed())
+    _finalize(str(job))
+    v = _violations(job, "trace-unclosed")
+    assert len(v) == 1
+    assert "1 span(s) opened but never closed" in v[0].message
+    assert "task.lifecycle" in v[0].message
+
+
+def test_unclosed_span_is_a_note_after_recovery(tmp_path):
+    """A SIGKILLed pre-recovery coordinator life leaves unclosed spans
+    by design: two REC_GENERATION records downgrade the finding."""
+    job = tmp_path / "job"
+    _write_journal(str(job), _base_journal() + [
+        {"t": "gen", "generation": 2},     # --recover happened
+        {"t": "task", "task": "worker:0", "status": "SUCCEEDED",
+         "session": 0, "exit": 0}])
+    _write_spans(str(job), _spans_with_unclosed())
+    _finalize(str(job))
+    rep = invariants.check_job_dir(str(job))
+    assert rep.ok, invariants.render_text([rep])
+    assert any("unclosed span(s)" in n for n in rep.notes)
+
+
+def test_orphan_close_and_unresolved_parent_flagged(tmp_path):
+    job = tmp_path / "job"
+    _write_journal(str(job), _base_journal() + [
+        {"t": "task", "task": "worker:0", "status": "SUCCEEDED",
+         "session": 0, "exit": 0}])
+    _write_spans(str(job), [
+        {"ev": "E", "span": "zz", "ts_us": 5, "args": {}},
+        {"ev": "X", "trace": "t", "span": "s3", "parent": "missing",
+         "name": "executor.register", "svc": "exec", "task": "worker:0",
+         "ts_us": 3, "dur_us": 1, "args": {}},
+    ])
+    _finalize(str(job))
+    rules = {v.rule for v in _violations(job)}
+    assert "trace-orphan-close" in rules
+    assert "trace-parent" in rules
+
+
+def test_unresolved_parent_is_a_note_on_disturbed_runs(tmp_path):
+    """A retry epoch (or any task death) legitimately strands buffered
+    executor spans' parents — note, never a violation."""
+    job = tmp_path / "job"
+    _write_journal(str(job), _base_journal() + [
+        {"t": "task", "task": "worker:0", "status": "FAILED",
+         "session": 0, "exit": 1},
+        {"t": "epoch", "session": 1, "infra_used": 1, "preempt_used": 0},
+        {"t": "task", "task": "worker:0", "status": "SUCCEEDED",
+         "session": 1, "exit": 0},
+    ])
+    _write_spans(str(job), [
+        {"ev": "X", "trace": "t", "span": "s3", "parent": "missing",
+         "name": "executor.register", "svc": "exec", "task": "worker:0",
+         "ts_us": 3, "dur_us": 1, "args": {}},
+    ])
+    _finalize(str(job))
+    rep = invariants.check_job_dir(str(job))
+    assert rep.ok, invariants.render_text([rep])
+    assert any("unresolved parent" in n for n in rep.notes)
+
+
+# ---------------------------------------------------------------------------
+# perf.json + metrics.prom
+# ---------------------------------------------------------------------------
+def test_phase_sum_mismatch_flagged(tmp_path):
+    job = tmp_path / "job"
+    _write_journal(str(job), _base_journal())
+    with open(job / constants.PERF_FILE, "w") as f:
+        json.dump({"wall_s": 10.0,
+                   "phases_s": {"compute": 4.0, "other": 1.0}}, f)
+    v = _violations(job, "phase-sum")
+    assert len(v) == 1
+    assert "sum to 5.0000 but the attributed wall is 10.0000" \
+        in v[0].message
+
+    with open(job / constants.PERF_FILE, "w") as f:
+        json.dump({"wall_s": 10.0,
+                   "phases_s": {"compute": 8.0, "other": 2.0}}, f)
+    assert _violations(job) == []
+
+
+def test_unregistered_prom_family_flagged(tmp_path):
+    job = tmp_path / "job"
+    _write_journal(str(job), _base_journal())
+    with open(job / constants.METRICS_PROM_FILE, "w") as f:
+        f.write("# HELP tony_tasks Tasks by status.\n"
+                "# TYPE tony_tasks gauge\n"
+                'tony_tasks{status="RUNNING"} 2\n'
+                "# TYPE tony_rogue_series gauge\n"
+                "tony_rogue_series 1\n")
+    v = _violations(job, "metrics-unregistered")
+    assert len(v) == 1
+    assert "tony_rogue_series" in v[0].message
+
+
+# ---------------------------------------------------------------------------
+# surfaces: module CLI + tony-tpu check + tree scan
+# ---------------------------------------------------------------------------
+def test_cli_check_job_dir_and_json(tmp_path, capsys):
+    job = tmp_path / "history" / "intermediate" / "app-x"
+    _write_journal(str(job), _base_journal() + [
+        {"t": "gen", "generation": 1},     # duplicate: violation
+    ])
+    rc = cli_main(["check", str(job), "--json"])
+    out = json.loads(capsys.readouterr().out)
+    assert rc == 1
+    assert out["ok"] is False
+    assert out["violations"][0]["rule"] == "journal-gen-monotonic"
+
+    rc = cli_main(["check", str(tmp_path / "nope" / "missing"),
+                   "--history-root", str(tmp_path / "history")])
+    assert rc == 2
+
+
+def test_cli_check_resolves_app_id(tmp_path, capsys):
+    from tony_tpu.events import history
+
+    hist = tmp_path / "history"
+    job = hist / "intermediate" / "app-ok"
+    _write_journal(str(job), _base_journal())
+    assert history.list_job_dirs(str(hist)).get("app-ok")
+    rc = cli_main(["check", "app-ok", "--history-root", str(hist)])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "OK" in out
+
+
+def test_module_cli_tree_scan(tmp_path, capsys):
+    """`python -m tony_tpu.devtools.invariants <tree>` — the no-deps CI
+    surface — scans every job dir under the tree."""
+    _write_journal(str(tmp_path / "a"), _base_journal())
+    _write_journal(str(tmp_path / "b"), [
+        {"t": "gen", "generation": 2},
+        {"t": "gen", "generation": 1},
+    ])
+    rc = invariants.main([str(tmp_path)])
+    out = capsys.readouterr().out
+    assert rc == 1
+    assert "OK" in out and "journal-gen-monotonic" in out
+    assert len(invariants.find_job_dirs(str(tmp_path))) == 2

@@ -314,6 +314,312 @@ def test_suppression_comment(tmp_path):
 
 
 # ---------------------------------------------------------------------------
+# v2 protocol rules (tony_tpu/devtools/protocol.py): multi-file golden
+# fixtures — each rule extracts BOTH halves of a protocol, so the
+# synthetic repo needs both files.
+# ---------------------------------------------------------------------------
+def _lint_files(tmp_path, files, rules):
+    """Drop ``{rel: code}`` into a synthetic repo, run ``rules``; returns
+    the linter."""
+    for rel, code in files.items():
+        path = tmp_path / rel
+        path.parent.mkdir(parents=True, exist_ok=True)
+        path.write_text(textwrap.dedent(code))
+    linter = Linter(str(tmp_path))
+    linter.run(rules=rules)
+    return linter
+
+
+_COORD_HEARTBEAT_OK = '''
+    def heartbeat(self, task_id):
+        resp = {}
+        resp["dump"] = True
+        resp["resize"] = {"mgen": 2}
+        return {"ok": True, **resp}
+'''
+
+_EXEC_HEARTBEAT_OK = '''
+    class H:
+        def run(self):
+            res = self._client.call("task_executor_heartbeat", task_id=1)
+            if res.get("dump"):
+                self._on_dump()
+            if isinstance(res.get("resize"), dict):
+                self._on_resize(res["resize"])
+
+    def _on_resize(self, directive):
+        mgen = int(directive.get("mgen", -1))
+        if mgen <= self.mgen:
+            return
+        self.mgen = mgen
+'''
+
+
+@pytest.mark.faults
+def test_directive_parity_bad_and_clean(tmp_path):
+    linter = _lint_files(tmp_path, {
+        "tony_tpu/coordinator/coordinator.py": '''
+            def heartbeat(self, task_id):
+                resp = {}
+                resp["dump"] = True
+                resp["vanish"] = True        # no executor branch
+                return {"ok": True, **resp}
+        ''',
+        "tony_tpu/executor/executor.py": '''
+            class H:
+                def run(self):
+                    res = self._client.call("task_executor_heartbeat")
+                    if res.get("dump"):
+                        pass
+                    if isinstance(res.get("ghost"), dict):  # no writer
+                        pass
+        ''',
+    }, ["directive-parity"])
+    msgs = [(f.rule, f.message) for f in linter.findings]
+    assert any("'vanish'" in m and "no executor heartbeat branch" in m
+               for _, m in msgs), msgs
+    assert any("'ghost'" in m and "no coordinator heartbeat path" in m
+               for _, m in msgs), msgs
+
+    clean = _lint_files(tmp_path / "clean", {
+        "tony_tpu/coordinator/coordinator.py": _COORD_HEARTBEAT_OK,
+        "tony_tpu/executor/executor.py": _EXEC_HEARTBEAT_OK,
+    }, ["directive-parity"])
+    assert clean.findings == []
+
+
+@pytest.mark.faults
+def test_directive_parity_missing_dedup_guard(tmp_path):
+    """A stateful (dict-payload) directive whose handler never compares
+    an mgen/id is flagged: the drain would re-fire every beat."""
+    linter = _lint_files(tmp_path, {
+        "tony_tpu/coordinator/coordinator.py": _COORD_HEARTBEAT_OK,
+        "tony_tpu/executor/executor.py": '''
+            class H:
+                def run(self):
+                    res = self._client.call("task_executor_heartbeat")
+                    if res.get("dump"):
+                        pass
+                    if isinstance(res.get("resize"), dict):
+                        self._on_resize(res["resize"])
+
+            def _on_resize(self, directive):
+                self.drain(directive)        # acts every time: no guard
+        ''',
+    }, ["directive-parity"])
+    assert any("no dedup/mgen guard" in f.message
+               for f in linter.findings), linter.findings
+
+
+@pytest.mark.faults
+def test_journal_parity_bad_and_clean(tmp_path):
+    linter = _lint_files(tmp_path, {
+        "tony_tpu/coordinator/journal.py": '''
+            REC_GOOD = "good"
+            REC_NOREPLAY = "noreplay"    # appended, no replay branch
+            REC_DEAD = "dead"            # declared, never appended
+
+            class J:
+                def good(self):
+                    self.append({"t": REC_GOOD})
+
+                def noreplay(self):
+                    self.append({"t": REC_NOREPLAY})
+
+                def literal(self):
+                    self.append({"t": "sneaky"})   # bypasses constants
+
+            def replay(path):
+                t = "x"
+                if t == REC_GOOD:
+                    pass
+        ''',
+    }, ["journal-parity"])
+    msgs = [f.message for f in linter.findings]
+    assert any("REC_NOREPLAY" in m and "no branch" in m for m in msgs), msgs
+    assert any("REC_DEAD" in m and "never appended" in m for m in msgs), msgs
+    assert any("'sneaky'" in m and "string literal" in m for m in msgs), msgs
+
+    clean = _lint_files(tmp_path / "clean", {
+        "tony_tpu/coordinator/journal.py": '''
+            REC_GOOD = "good"
+
+            class J:
+                def good(self):
+                    self.append({"t": REC_GOOD})
+
+            def replay(path):
+                t = "x"
+                if t == REC_GOOD:
+                    pass
+        ''',
+    }, ["journal-parity"])
+    assert clean.findings == []
+
+
+@pytest.mark.faults
+def test_fence_coverage_bad_and_clean(tmp_path):
+    bad, _ = _lint_snippet(tmp_path, '''
+        from tony_tpu.rpc.wire import RpcServer
+
+        class _Svc:
+            def mutate_unfenced(self, task_id):
+                t = self.session.get_task(task_id)
+                t.tb_url = "x"
+                return True
+
+        def go():
+            RpcServer(_Svc())
+    ''', ["fence-coverage"], rel="tony_tpu/coordinator/coordinator.py")
+    assert [(f.rule, f.line) for f in bad] == [("fence-coverage", 5)]
+    assert "mutate_unfenced" in bad[0].message
+
+    clean, _ = _lint_snippet(tmp_path / "clean", '''
+        from tony_tpu.rpc.wire import RpcServer
+
+        class _Svc:
+            def mutate_fenced(self, task_id, session_id=-1):
+                self._check_epoch(task_id, session_id)
+                t = self.session.get_task(task_id)
+                t.tb_url = "x"
+                return True
+
+            def _check_epoch(self, task_id, session_id):
+                pass
+
+            def operator_surface(self, size):
+                self.session.fail("operator kill")   # no task_id: exempt
+                return True
+
+        def go():
+            RpcServer(_Svc())
+    ''', ["fence-coverage"], rel="tony_tpu/coordinator/coordinator.py")
+    assert clean == []
+
+
+@pytest.mark.faults
+def test_fence_coverage_sees_through_delegation(tmp_path):
+    """The thin RPC-wrapper shape: the handler delegates to a same-named
+    coordinator method whose body does the unfenced mutation."""
+    bad, _ = _lint_snippet(tmp_path, '''
+        from tony_tpu.rpc.wire import RpcServer
+
+        class _Svc:
+            def register_thing(self, task_id):
+                return self._c.register_thing(task_id)
+
+        class Coordinator:
+            def register_thing(self, task_id):
+                self.session.mark_killed(task_id)
+                return True
+
+        def go():
+            RpcServer(_Svc())
+    ''', ["fence-coverage"], rel="tony_tpu/coordinator/coordinator.py")
+    assert [(f.rule, f.line) for f in bad] == [("fence-coverage", 5)]
+
+
+@pytest.mark.faults
+def test_beacon_parity_bad_and_clean(tmp_path):
+    linter = _lint_files(tmp_path, {
+        "tony_tpu/executor/executor.py": '''
+            def _progress_beacon(self):
+                beacon = {}
+                beacon["steps"] = 1.0
+                beacon["junk"] = "never read"
+                nested = {}
+                nested["sub"] = 1     # not the returned dict: ignored
+                return beacon or None
+        ''',
+        "tony_tpu/coordinator/coordinator.py": '''
+            def _observe_beacon(self, progress):
+                if "steps" in progress:
+                    return progress["steps"]
+                return progress.get("ghost")
+        ''',
+    }, ["beacon-parity"])
+    msgs = [f.message for f in linter.findings]
+    assert any("'junk'" in m and "no coordinator fold reads" in m
+               for m in msgs), msgs
+    assert any("'ghost'" in m and "no executor beacon writes"
+               in m for m in msgs), msgs
+    assert not any("'sub'" in m for m in msgs), msgs
+
+    clean = _lint_files(tmp_path / "clean", {
+        "tony_tpu/executor/executor.py": '''
+            def _progress_beacon(self):
+                beacon = {}
+                beacon["steps"] = 1.0
+                return beacon or None
+        ''',
+        "tony_tpu/coordinator/coordinator.py": '''
+            def _observe_beacon(self, progress):
+                return progress.get("steps")
+        ''',
+    }, ["beacon-parity"])
+    assert clean.findings == []
+
+
+@pytest.mark.faults
+def test_terminal_state_bad_and_clean(tmp_path):
+    bad, _ = _lint_snippet(tmp_path, '''
+        def promote(session, task_id):
+            t = session.get_task(task_id)
+            t.status = "RUNNING"
+    ''', ["terminal-state"], rel="tony_tpu/coordinator/session.py")
+    assert [(f.rule, f.line) for f in bad] == [("terminal-state", 4)]
+
+    clean, _ = _lint_snippet(tmp_path / "clean", '''
+        def promote(session, task_id):
+            t = session.get_task(task_id)
+            if t.status.terminal:
+                return
+            t.status = "RUNNING"
+
+        def absorb_loss(t):
+            t.status = "FAILED"       # the absorb path: exempt
+
+        def reduce(self):
+            self.status = "FAILED"    # session reduction, not a task
+    ''', ["terminal-state"], rel="tony_tpu/coordinator/session.py")
+    assert clean == []
+
+
+@pytest.mark.faults
+def test_metrics_registry_bad_and_clean(tmp_path):
+    bad, _ = _lint_snippet(tmp_path, '''
+        def export(metrics):
+            metrics.gauge("tony_bogus_series", {}).set(1)
+    ''', ["metrics-registry"])
+    assert [(f.rule, f.line) for f in bad] == [("metrics-registry", 3)]
+    assert "tony_bogus_series" in bad[0].message
+
+    clean, _ = _lint_snippet(tmp_path / "clean", '''
+        def export(metrics):
+            metrics.gauge("tony_tasks", {}).set(1)          # registered
+            prefix = "tony_coord_"                          # family match
+            path = "tony_tpu/metrics.py"                    # not a series
+    ''', ["metrics-registry"])
+    assert clean == []
+
+
+@pytest.mark.faults
+def test_metrics_registry_dead_entry_detected(tmp_path):
+    """The OTHER direction: every registered series must be referenced
+    somewhere — a synthetic repo referencing only one leaves the rest
+    flagged at the registry."""
+    from tony_tpu.metrics import SERIES
+
+    _, linter = _lint_snippet(tmp_path, '''
+        def export(metrics):
+            metrics.gauge("tony_tasks", {}).set(1)
+    ''', ["metrics-registry"])
+    dead = [f for f in linter.findings
+            if "dead registry entry" in f.message]
+    assert len(dead) == len(SERIES) - 1
+
+
+# ---------------------------------------------------------------------------
 # the repo gate
 # ---------------------------------------------------------------------------
 def test_repo_is_lint_clean():

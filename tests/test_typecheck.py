@@ -1,0 +1,32 @@
+"""Strict-core typecheck gate (ISSUE 12, third tonycheck layer).
+
+Runs ``mypy --strict`` over the strict-core module set declared in
+pyproject.toml ``[tool.mypy]`` — the RPC wire protocol, the write-ahead
+journal, elastic membership, faults, the conf-key registry, and the
+devtools themselves. Skips when mypy is not installed (the test image
+is deps-frozen); CI installs mypy in the dedicated ``typecheck`` job so
+the gate is always enforced on push.
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+pytest.importorskip("mypy", reason="mypy not installed; the CI "
+                                   "typecheck job enforces this gate")
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.mark.timeout_s(300)
+def test_strict_core_typechecks():
+    proc = subprocess.run(
+        [sys.executable, "-m", "mypy", "--strict"],
+        cwd=REPO_ROOT, capture_output=True, text=True, timeout=280)
+    assert proc.returncode == 0, (
+        "mypy --strict failed on the strict-core set "
+        "(pyproject.toml [tool.mypy]):\n" + proc.stdout + proc.stderr)

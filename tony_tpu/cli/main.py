@@ -887,6 +887,32 @@ def _cmd_lint(args: argparse.Namespace) -> int:
     return tonylint.main(argv)
 
 
+def _cmd_check(args: argparse.Namespace) -> int:
+    """`tony-tpu check` — the cross-artifact trace invariant checker
+    (tonycheck's runtime half; devtools/invariants.py)."""
+    import json as _json
+
+    from tony_tpu.devtools import invariants
+    from tony_tpu.events import history
+
+    target = args.target
+    if os.path.isdir(target):
+        job_dir = target
+    else:
+        root = _history_root(args)
+        job_dir = history.list_job_dirs(root).get(target)
+        if job_dir is None:
+            print(f"unknown application {target} under {root}",
+                  file=sys.stderr)
+            return 2
+    report = invariants.check_job_dir(job_dir)
+    if args.json:
+        print(_json.dumps(report.to_dict(), indent=1, sort_keys=True))
+    else:
+        print(invariants.render_text([report]))
+    return 0 if report.ok else 1
+
+
 def _cmd_pool(args: argparse.Namespace) -> int:
     """Warm-executor-pool operations (tony_tpu/pool.py): `start` spawns
     the daemon detached and waits for its endpoint; `status` prints the
@@ -1206,6 +1232,23 @@ def build_parser() -> argparse.ArgumentParser:
     ln.add_argument("--list", dest="list_rules", action="store_true",
                     help="list rule ids and exit")
     ln.set_defaults(fn=_cmd_lint)
+
+    ck = sub.add_parser(
+        "check",
+        help="verify a finished job's artifacts against the "
+             "control-plane protocol invariants: journal gen/mgen "
+             "monotonicity, resize pairing, epoch fences, terminal-"
+             "state discipline, span-tree closure, phase sums, and the "
+             "metrics registry (docs/development.md). Run it BEFORE "
+             "diagnose: a protocol violation means the artifacts "
+             "themselves may be lying. Exits nonzero on violations.")
+    ck.add_argument("target",
+                    help="an app id (resolved under the history root) "
+                         "or a job-dir path")
+    ck.add_argument("--history-root")
+    ck.add_argument("--json", action="store_true",
+                    help="emit the report as JSON")
+    ck.set_defaults(fn=_cmd_check)
     return p
 
 

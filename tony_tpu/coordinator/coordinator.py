@@ -81,8 +81,10 @@ class _RpcService:
                                             session_id=session_id,
                                             mgen=mgen)
 
-    def register_tensorboard_url(self, task_id: str, url: str) -> bool:
-        return self._c.register_tensorboard_url(task_id, url)
+    def register_tensorboard_url(self, task_id: str, url: str,
+                                 session_id: int = -1) -> bool:
+        return self._c.register_tensorboard_url(task_id, url,
+                                                session_id=session_id)
 
     def register_execution_result(self, task_id: str, exit_code: int,
                                   session_id: int = -1,
@@ -976,6 +978,14 @@ class Coordinator:
         """Launch ONE task (gang launch and elastic relaunch/grow share
         this path). Returns False when the backend spawn failed and the
         session was failed INFRA_TRANSIENT."""
+        if task.status.terminal:
+            # Terminal-state discipline (tonylint terminal-state):
+            # relaunching a finished Task object would resurrect a
+            # closed identity under its old exit verdict — resize and
+            # retry paths always hand this a FRESH Task.
+            log.error("refusing to launch terminal task %s (%s)",
+                      task.task_id, task.status.value)
+            return False
         job = self.session.jobs[task.job_name]
         # Write-ahead: journal the SCHEDULED transition before the
         # backend spawn. A crash in between recovers a task the
@@ -1112,7 +1122,13 @@ class Coordinator:
                 self._worker_termination_done = True
                 return
 
-    def register_tensorboard_url(self, task_id: str, url: str) -> bool:
+    def register_tensorboard_url(self, task_id: str, url: str,
+                                 session_id: int = -1) -> bool:
+        # Epoch fence (tonylint fence-coverage): a chief surviving from a
+        # pre-reset session must not overwrite the NEW epoch's TB URL
+        # with its dead server's address. session_id < 0 = pre-fence
+        # caller, compat-accepted like every other fenced surface.
+        self._check_epoch(task_id, session_id)
         t = self.session.get_task(task_id)
         if t is None:
             return False

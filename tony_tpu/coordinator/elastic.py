@@ -46,7 +46,12 @@ from __future__ import annotations
 
 import threading
 import time
-from typing import Dict, List, Optional, Set
+from typing import (TYPE_CHECKING, Any, Callable, Dict, Iterable, List,
+                    Optional, Set)
+
+if TYPE_CHECKING:
+    from tony_tpu.conf.config import TonyTpuConfig
+    from tony_tpu.coordinator.session import Session, Task
 
 from tony_tpu.conf import keys as K
 
@@ -63,7 +68,7 @@ class ResizeRefused(ValueError):
 
 class _Op:
     def __init__(self, mgen: int, job: str, members: List[int],
-                 reason: str, started: float):
+                 reason: str, started: float) -> None:
         self.mgen = mgen
         self.job = job
         self.members = sorted(members)
@@ -82,7 +87,8 @@ class _Op:
 class ElasticManager:
     """Membership policy + resize-op state for ONE elastic jobtype."""
 
-    def __init__(self, conf, now_fn=time.monotonic):
+    def __init__(self, conf: "TonyTpuConfig",
+                 now_fn: Callable[[], float] = time.monotonic) -> None:
         self._now = now_fn
         self.enabled = conf.get_bool(K.ELASTIC_ENABLED)
         self.job = str(conf.get(K.ELASTIC_JOBTYPE, "worker") or "worker")
@@ -121,7 +127,8 @@ class ElasticManager:
             return out
 
     # -- policy -----------------------------------------------------------
-    def may_absorb(self, task, domain_value: str, session) -> bool:
+    def may_absorb(self, task: "Task", domain_value: str,
+                   session: "Session") -> bool:
         """Would losing this task be absorbed as a shrink (or folded into
         the in-flight resize) instead of failing the epoch? Pure read —
         the coordinator acts via begin()/note_task_gone().
@@ -158,7 +165,7 @@ class ElasticManager:
                      and t.task_id != task.task_id]
         return len(survivors) >= self.min_tasks
 
-    def plan_explicit(self, size: int, session) -> List[int]:
+    def plan_explicit(self, size: int, session: "Session") -> List[int]:
         """Member list for an operator resize to ``size`` — shrink drops
         the HIGHEST indices (never the chief at index 0), grow re-adds
         the smallest free indices. Raises ResizeRefused with the reason
@@ -192,8 +199,8 @@ class ElasticManager:
         return sorted(members)
 
     # -- op lifecycle (driven by the coordinator) -------------------------
-    def begin(self, members: List[int], live_tasks, reason: str,
-              mgen: Optional[int] = None) -> _Op:
+    def begin(self, members: List[int], live_tasks: "Iterable[Task]",
+              reason: str, mgen: Optional[int] = None) -> _Op:
         """Start a resize (or supersede the in-flight one with a smaller
         membership — the second host dying during a drain). Bumps the
         membership generation unless ``mgen`` pins it (recovery re-entry
@@ -303,7 +310,8 @@ class ElasticManager:
             self.established = False
 
     # -- fencing ----------------------------------------------------------
-    def fences_frame(self, task_known: bool, mgen) -> Optional[str]:
+    def fences_frame(self, task_known: bool,
+                     mgen: Any) -> Optional[str]:
         """Should a register/heartbeat frame be rejected as stale
         topology? Returns the fence reason, or None to accept.
 

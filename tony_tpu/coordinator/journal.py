@@ -30,7 +30,7 @@ import json
 import logging
 import os
 import time
-from typing import Dict, Optional, Set
+from typing import Callable, Dict, Iterable, Iterator, Optional, Set, Tuple
 
 from tony_tpu.utils.durable import AppendLog
 
@@ -114,7 +114,9 @@ class SessionJournal:
     numbers behind the JOURNAL_BOUND verdict. Best-effort by contract:
     an observer failure must never fail a write-ahead append."""
 
-    def __init__(self, path: str, enabled: bool = True, observer=None):
+    def __init__(self, path: str, enabled: bool = True,
+                 observer: Optional[Callable[[int, float], None]]
+                 = None) -> None:
         self.path = path
         self.enabled = enabled
         self.observer = observer
@@ -179,7 +181,8 @@ class SessionJournal:
         self.append({"t": REC_PROGRESS, "task": task_id, "steps": steps,
                      "session": session_id})
 
-    def resize(self, job: str, mgen: int, members, phase: str,
+    def resize(self, job: str, mgen: int, members: Iterable[int],
+               phase: str,
                session_id: int, reason: str = "") -> None:
         """Elastic membership transition. Write-ahead discipline:
         ``phase="start"`` lands BEFORE any drain directive is issued and
@@ -197,7 +200,7 @@ class SessionJournal:
             self._log = None
 
 
-def _iter_complete_lines(path: str):
+def _iter_complete_lines(path: str) -> Tuple[Iterator[bytes], bool]:
     """Yield complete (newline-terminated) lines; a trailing unterminated
     line is the torn-write window and is dropped, flagged via the second
     yield element."""

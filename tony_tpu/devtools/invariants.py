@@ -1,0 +1,515 @@
+"""Cross-artifact trace invariant checker: the runtime half of tonycheck.
+
+tonylint's protocol rules (devtools/protocol.py) prove the CODE keeps
+both halves of each control-plane contract; this module proves a
+finished RUN did. It reads a job dir's artifacts — the write-ahead
+journal, the span log, perf.json, metrics.prom — and asserts the
+invariants the protocol promises at runtime:
+
+=======================  ==================================================
+journal-gen-monotonic    coordinator generations strictly increase
+journal-mgen-monotonic   membership generations never step backwards
+journal-resize-dangling  every REC_RESIZE ``start`` is closed by an
+                         ``applied`` (same-or-newer mgen), a superseding
+                         ``start``, or an epoch reset — never left open
+journal-stale-epoch      no sessioned record lands after a newer epoch
+                         fence (a stale frame was accepted post-fence)
+journal-terminal         no REC_TASK transition out of SUCCEEDED/FAILED/
+                         KILLED and no REC_REGISTER for a terminal task
+                         within an epoch (applied resizes reset their
+                         job's fold — the journaled absorb path)
+trace-unclosed           every opened span is closed (single-generation
+                         runs; pre-recovery lives may leave unclosed
+                         spans and are reported as a note instead)
+trace-orphan-close       no span close without a matching open
+trace-parent             every span's parent resolves inside the log
+phase-sum                perf.json per-phase seconds sum to the
+                         attributed wall within tolerance
+metrics-unregistered     every ``tony_*`` family in metrics.prom is in
+                         ``tony_tpu.metrics.SERIES``
+=======================  ==================================================
+
+Surfaces: ``tony-tpu check <app|job_dir>`` (and the no-deps module CLI
+``python -m tony_tpu.devtools.invariants <job_dir>``), plus the autouse
+pytest fixture in tests/conftest.py that verifies the artifact dir of
+every e2e and virtual-gang drill at teardown — every existing slow drill
+is a protocol test for free.
+
+Stdlib only (the journal/tracing readers it leans on are stdlib too), so
+CI runs it without installing anything. Torn tails are tolerated exactly
+as the readers tolerate them (write-ahead discipline makes the prefix
+the truth) and reported as notes, never violations.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import sys
+from typing import Any, Dict, List, Optional, Sequence, Set, Tuple
+
+from tony_tpu import constants
+from tony_tpu.coordinator import journal as journal_mod
+
+_TERMINAL = ("SUCCEEDED", "FAILED", "KILLED")
+
+#: perf.json sum-to-wall tolerance: the writer rounds each phase to 4
+#: decimals, so allow 1% relative plus a rounding epsilon.
+PHASE_SUM_REL_TOL = 0.01
+PHASE_SUM_ABS_TOL = 0.05
+
+
+@dataclasses.dataclass(frozen=True)
+class Violation:
+    """One invariant breach, in the diagnosis evidence style: what broke,
+    where (artifact + record/line number), and the record that proves
+    it."""
+
+    rule: str
+    artifact: str
+    record: int          # 1-based record/line index; 0 = file-level
+    message: str
+    evidence: str = ""
+
+    def to_dict(self) -> Dict[str, object]:
+        return dataclasses.asdict(self)
+
+    def __str__(self) -> str:
+        s = f"{self.artifact}:{self.record}: [{self.rule}] {self.message}"
+        if self.evidence:
+            s += f"\n    evidence: {self.evidence}"
+        return s
+
+
+@dataclasses.dataclass
+class Report:
+    job_dir: str
+    violations: List[Violation] = dataclasses.field(default_factory=list)
+    notes: List[str] = dataclasses.field(default_factory=list)
+    checked: Dict[str, int] = dataclasses.field(default_factory=dict)
+
+    @property
+    def ok(self) -> bool:
+        return not self.violations
+
+    def to_dict(self) -> Dict[str, object]:
+        return {
+            "job_dir": self.job_dir,
+            "ok": self.ok,
+            "violations": [v.to_dict() for v in self.violations],
+            "notes": list(self.notes),
+            "checked": dict(self.checked),
+        }
+
+
+def _iter_journal_records(
+        path: str) -> Tuple[List[Tuple[int, Dict[str, Any]]], bool]:
+    """(index, record) for every decodable complete record; mirrors
+    replay()'s torn-tail posture. Returns (records, torn)."""
+    lines, torn = journal_mod._iter_complete_lines(path)
+    out: List[Tuple[int, Dict[str, Any]]] = []
+    for i, raw in enumerate(lines, start=1):
+        raw = raw.strip()
+        if not raw:
+            continue
+        try:
+            rec = json.loads(raw.decode("utf-8"))
+            if not isinstance(rec, dict):
+                raise ValueError("not an object")
+        except (ValueError, UnicodeDecodeError):
+            torn = True
+            break
+        out.append((i, rec))
+    return out, torn
+
+
+# ---------------------------------------------------------------------------
+# journal invariants
+# ---------------------------------------------------------------------------
+def _check_journal(path: str, rel: str, rep: Report,
+                   strict: bool) -> Tuple[int, bool]:
+    """All journal invariants in one ordered fold. Returns
+    ``(generations, clean)`` — the recovery count and whether the run
+    was disturbance-free (one epoch, no failed/killed task): the facts
+    the span-tree check needs to know how much stitching to demand.
+    ``strict`` = the job finished SUCCEEDED: end-state invariants (no
+    dangling resize) are hard; on failure paths they degrade to notes."""
+    records, torn = _iter_journal_records(path)
+    rep.checked[rel] = len(records)
+    clean = True
+    if torn:
+        rep.notes.append(
+            f"{rel}: torn/undecodable tail after {len(records)} good "
+            f"record(s) — the crash window; prefix checked")
+    last_gen: Optional[int] = None
+    n_gens = 0
+    max_mgen: Optional[int] = None
+    session: Optional[int] = None
+    # job → (record_idx, mgen) of the open resize start
+    open_start: Dict[str, Tuple[int, int]] = {}
+    # task → folded status for the current epoch
+    tasks: Dict[str, str] = {}
+    for idx, rec in records:
+        t = rec.get("t")
+        ev = json.dumps(rec, sort_keys=True)
+        if t == journal_mod.REC_GENERATION:
+            n_gens += 1
+            gen = int(rec.get("generation", 0) or 0)
+            if last_gen is not None and gen <= last_gen:
+                rep.violations.append(Violation(
+                    "journal-gen-monotonic", rel, idx,
+                    f"coordinator generation {gen} does not supersede "
+                    f"{last_gen} — generations must strictly increase "
+                    f"(the split-brain fence)", ev))
+            last_gen = max(gen, last_gen or 0)
+        elif t == journal_mod.REC_EPOCH:
+            new_session = int(rec.get("session", 0) or 0)
+            if session is not None and new_session < session:
+                rep.violations.append(Violation(
+                    "journal-stale-epoch", rel, idx,
+                    f"epoch record steps back from session {session} to "
+                    f"{new_session}", ev))
+            if new_session > 0:
+                clean = False      # a retry epoch happened
+            session = new_session
+            tasks.clear()
+            open_start.clear()     # an epoch reset abandons the resize
+        elif t == journal_mod.REC_RESIZE:
+            if _stale_session(rec, session):
+                rep.violations.append(_stale_violation(rel, idx, rec,
+                                                       session, ev))
+                continue
+            job = str(rec.get("job", "") or "")
+            mgen = int(rec.get("mgen", 0) or 0)
+            if max_mgen is not None and mgen < max_mgen:
+                rep.violations.append(Violation(
+                    "journal-mgen-monotonic", rel, idx,
+                    f"membership generation {mgen} steps back from "
+                    f"{max_mgen} — a stale-topology record landed after "
+                    f"the fence", ev))
+            max_mgen = max(mgen, max_mgen if max_mgen is not None else 0)
+            if rec.get("phase") == "applied":
+                start = open_start.pop(job, None)
+                if start is not None and mgen < start[1]:
+                    rep.violations.append(Violation(
+                        "journal-resize-dangling", rel, idx,
+                        f"resize applied at mgen {mgen} but the open "
+                        f"start is newer (mgen {start[1]}) — the applied "
+                        f"topology is stale", ev))
+                # The applied topology supersedes the member tasks' fold:
+                # replaced indices relaunch fresh (the journaled absorb
+                # path) — mirror replay() and reset the job's fold.
+                for tid in [tid for tid in tasks
+                            if tid.partition(":")[0] == job]:
+                    del tasks[tid]
+            else:
+                open_start[job] = (idx, mgen)
+        elif t in (journal_mod.REC_REGISTER, journal_mod.REC_TASK,
+                   journal_mod.REC_PROGRESS, journal_mod.REC_VERDICT,
+                   journal_mod.REC_JOB_SCHEDULED,
+                   journal_mod.REC_JOB_COMPLETED):
+            if _stale_session(rec, session):
+                rep.violations.append(_stale_violation(rel, idx, rec,
+                                                       session, ev))
+                continue
+            tid = str(rec.get("task", "") or "")
+            if t == journal_mod.REC_TASK and tid:
+                status = str(rec.get("status", "") or "")
+                if status in ("FAILED", "KILLED"):
+                    clean = False  # a task died along the way
+                prev = tasks.get(tid)
+                if prev in _TERMINAL and status != prev:
+                    rep.violations.append(Violation(
+                        "journal-terminal", rel, idx,
+                        f"task {tid} transitions {prev} → {status} after "
+                        f"a terminal state — a closed task identity was "
+                        f"resurrected outside the journaled epoch-reset/"
+                        f"absorb paths", ev))
+                tasks[tid] = status
+            elif t == journal_mod.REC_REGISTER and tid:
+                if tasks.get(tid) in _TERMINAL:
+                    rep.violations.append(Violation(
+                        "journal-terminal", rel, idx,
+                        f"register record for task {tid} in terminal "
+                        f"state {tasks[tid]} — a registration frame was "
+                        f"accepted after the task finished", ev))
+    for job, (idx, mgen) in sorted(open_start.items()):
+        msg = (f"resize start for job {job!r} (mgen {mgen}) is never "
+               f"applied, superseded, or reset — the journal ends with "
+               f"the resize in flight (a --recover would re-enter the "
+               f"drain; a SUCCEEDED job must not end here)")
+        if strict:
+            rep.violations.append(Violation(
+                "journal-resize-dangling", rel, idx, msg))
+        else:
+            # A job that died/was killed mid-resize legitimately leaves
+            # the start open — that IS the recover re-entry record.
+            rep.notes.append(f"{rel}:{idx}: {msg}")
+    return n_gens, clean and n_gens <= 1
+
+
+def _stale_session(rec: Dict[str, Any], session: Optional[int]) -> bool:
+    if session is None or "session" not in rec:
+        return False
+    try:
+        return int(rec.get("session", 0) or 0) != session
+    except (TypeError, ValueError):
+        return True
+
+
+def _stale_violation(rel: str, idx: int, rec: Dict[str, Any],
+                     session: Optional[int], ev: str) -> Violation:
+    return Violation(
+        "journal-stale-epoch", rel, idx,
+        f"record for session {rec.get('session')} appended while the "
+        f"epoch fence is at session {session} — a stale-epoch frame was "
+        f"accepted after the fence", ev)
+
+
+# ---------------------------------------------------------------------------
+# span-log invariants
+# ---------------------------------------------------------------------------
+def _check_spans(path: str, rel: str, rep: Report,
+                 strict: bool) -> None:
+    """``strict`` = SUCCEEDED + single generation + no task deaths/retry
+    epochs: the only shape that owes a fully closed, fully stitched
+    span tree (buffered tracers ship spans complete-only, so any kill
+    along the way legitimately drops parents)."""
+    from tony_tpu import tracing
+
+    records = tracing.load_records(path)
+    rep.checked[rel] = len(records)
+    opens: Dict[str, Tuple[int, str]] = {}     # span id → (line, name)
+    known: Set[str] = set()
+    parents: List[Tuple[int, str, str]] = []   # (line, span name, parent)
+    for i, recd in enumerate(records, start=1):
+        ev = recd.get("ev")
+        span = str(recd.get("span", "") or "")
+        name = str(recd.get("name", "") or "")
+        if ev == "B":
+            opens[span] = (i, name)
+            known.add(span)
+        elif ev == "E":
+            if opens.pop(span, None) is None:
+                rep.violations.append(Violation(
+                    "trace-orphan-close", rel, i,
+                    f"span close for {span!r} has no matching open — "
+                    f"the span tree is inconsistent",
+                    json.dumps(recd, sort_keys=True)))
+        elif ev in ("X", "I"):
+            known.add(span)
+        if ev in ("B", "X", "I"):
+            parent = str(recd.get("parent", "") or "")
+            if parent:
+                parents.append((i, name, parent))
+    if opens:
+        names = ", ".join(
+            f"{name} (line {line})"
+            for line, name in sorted(opens.values())[:5])
+        if strict:
+            line = min(l for l, _ in opens.values())
+            rep.violations.append(Violation(
+                "trace-unclosed", rel, line,
+                f"{len(opens)} span(s) opened but never closed on a "
+                f"clean SUCCEEDED run: {names}"))
+        else:
+            # A SIGKILLed coordinator life (pre-recovery, or a crash
+            # drill that never recovered) leaves its open spans
+            # unclosed by design — evidence of what was in flight, not
+            # a protocol breach.
+            rep.notes.append(
+                f"{rel}: {len(opens)} unclosed span(s) from a killed/"
+                f"pre-recovery coordinator life: {names}")
+    unresolved = [(i, name, p) for i, name, p in parents if p not in known]
+    if not strict and unresolved:
+        # Executor/client spans ship over best-effort trace.push, and a
+        # buffered tracer only ships CLOSED spans: any task or
+        # coordinator killed mid-life strands its children's parent
+        # links. Only a clean single-epoch SUCCEEDED run owes a fully
+        # stitched tree.
+        rep.notes.append(
+            f"{rel}: {len(unresolved)} unresolved parent link(s) on a "
+            f"disturbed run (best-effort span push)")
+        return
+    for i, name, p in unresolved[:5]:
+        rep.violations.append(Violation(
+            "trace-parent", rel, i,
+            f"span {name!r} has parent {p!r} which resolves to no span "
+            f"in the log — the trace tree is broken at this edge"))
+    if len(unresolved) > 5:
+        rep.notes.append(f"{rel}: {len(unresolved) - 5} further "
+                         f"unresolved parent link(s) suppressed")
+
+
+# ---------------------------------------------------------------------------
+# perf.json + metrics.prom invariants
+# ---------------------------------------------------------------------------
+def _check_perf(path: str, rel: str, rep: Report) -> None:
+    try:
+        with open(path, encoding="utf-8") as f:
+            doc = json.load(f)
+    except (OSError, ValueError):
+        rep.notes.append(f"{rel}: absent or torn — skipped")
+        return
+    if not isinstance(doc, dict):
+        return
+    phases = doc.get("phases_s")
+    wall = doc.get("wall_s")
+    if not isinstance(phases, dict) or not isinstance(wall, (int, float)):
+        return
+    rep.checked[rel] = 1
+    total = 0.0
+    for v in phases.values():
+        try:
+            total += float(v)
+        except (TypeError, ValueError):
+            continue
+    tol = max(PHASE_SUM_ABS_TOL, PHASE_SUM_REL_TOL * float(wall))
+    if abs(total - float(wall)) > tol:
+        rep.violations.append(Violation(
+            "phase-sum", rel, 0,
+            f"per-phase seconds sum to {total:.4f} but the attributed "
+            f"wall is {wall:.4f} (tolerance {tol:.4f}) — phase "
+            f"accounting leaked or double-booked step time",
+            json.dumps({"phases_s": phases, "wall_s": wall},
+                       sort_keys=True)))
+
+
+def _check_prom(path: str, rel: str, rep: Report) -> None:
+    from tony_tpu.metrics import SERIES
+
+    try:
+        with open(path, encoding="utf-8") as f:
+            text = f.read()
+    except OSError:
+        rep.notes.append(f"{rel}: absent — skipped")
+        return
+    families = 0
+    for lineno, line in enumerate(text.splitlines(), start=1):
+        if not line.startswith("# TYPE "):
+            continue
+        parts = line.split()
+        if len(parts) < 3:
+            continue
+        name = parts[2]
+        if not name.startswith("tony_"):
+            continue
+        families += 1
+        if name not in SERIES:
+            rep.violations.append(Violation(
+                "metrics-unregistered", rel, lineno,
+                f"exported family {name!r} is not registered in "
+                f"tony_tpu.metrics.SERIES — the registry and the "
+                f"exposition drifted", line))
+    rep.checked[rel] = families
+
+
+def _finished_succeeded(job_dir: str) -> bool:
+    """Did this job finalize SUCCEEDED? (From the jhist filename, the
+    same source the history index uses.) Unknown/unfinished → False:
+    the checker then holds only the always-invariants."""
+    from tony_tpu.events import history
+
+    path = history.find_history_file(job_dir)
+    if not path:
+        return False
+    meta = history.parse_metadata(os.path.basename(path))
+    return bool(meta is not None and meta.status == "SUCCEEDED")
+
+
+# ---------------------------------------------------------------------------
+# entry points
+# ---------------------------------------------------------------------------
+def check_job_dir(job_dir: str) -> Report:
+    """Verify one job dir's artifacts. Absent artifacts are notes (a
+    minimal job writes only the journal); present artifacts must hold
+    their invariants."""
+    rep = Report(job_dir=job_dir)
+    strict = _finished_succeeded(job_dir)
+    if not strict:
+        rep.notes.append(
+            "job did not finish SUCCEEDED — end-state invariants "
+            "(dangling resize, span-tree stitching) degrade to notes")
+    journal_path = os.path.join(job_dir, constants.JOURNAL_FILE)
+    clean = False
+    if os.path.exists(journal_path):
+        _, clean = _check_journal(journal_path, constants.JOURNAL_FILE,
+                                  rep, strict)
+    else:
+        rep.notes.append(f"{constants.JOURNAL_FILE}: absent — journal "
+                         f"checks skipped (journal disabled?)")
+    trace_path = os.path.join(job_dir, constants.TRACE_FILE)
+    if os.path.exists(trace_path):
+        _check_spans(trace_path, constants.TRACE_FILE, rep,
+                     strict=strict and clean)
+    else:
+        rep.notes.append(f"{constants.TRACE_FILE}: absent — span checks "
+                         f"skipped (tracing disabled?)")
+    _check_perf(os.path.join(job_dir, constants.PERF_FILE),
+                constants.PERF_FILE, rep)
+    _check_prom(os.path.join(job_dir, constants.METRICS_PROM_FILE),
+                constants.METRICS_PROM_FILE, rep)
+    return rep
+
+
+def find_job_dirs(root: str) -> List[str]:
+    """Every dir under ``root`` holding a session journal — how the
+    pytest artifact fixture and `check` on a history root find the job
+    dirs to verify."""
+    out = []
+    for dirpath, dirnames, filenames in os.walk(root):
+        dirnames[:] = [d for d in dirnames if d != "__pycache__"]
+        if constants.JOURNAL_FILE in filenames:
+            out.append(dirpath)
+    return sorted(out)
+
+
+def check_tree(root: str) -> List[Report]:
+    return [check_job_dir(d) for d in find_job_dirs(root)]
+
+
+def render_text(reports: Sequence[Report]) -> str:
+    lines: List[str] = []
+    for rep in reports:
+        head = "OK" if rep.ok else f"{len(rep.violations)} violation(s)"
+        lines.append(f"{rep.job_dir}: {head}")
+        for v in rep.violations:
+            lines.append(f"  {v}")
+        for n in rep.notes:
+            lines.append(f"  note: {n}")
+    return "\n".join(lines)
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    import argparse
+
+    p = argparse.ArgumentParser(
+        prog="tony-tpu check",
+        description="Cross-artifact trace invariant checker "
+                    "(see docs/development.md).")
+    p.add_argument("target",
+                   help="a job dir, or a tree of job dirs to scan")
+    p.add_argument("--json", action="store_true", dest="as_json")
+    args = p.parse_args(argv)
+    if not os.path.isdir(args.target):
+        print(f"not a directory: {args.target}", file=sys.stderr)
+        return 2
+    if os.path.exists(os.path.join(args.target, constants.JOURNAL_FILE)):
+        reports = [check_job_dir(args.target)]
+    else:
+        reports = check_tree(args.target)
+        if not reports:
+            print(f"no job dirs (no {constants.JOURNAL_FILE}) under "
+                  f"{args.target}", file=sys.stderr)
+            return 2
+    if args.as_json:
+        print(json.dumps([r.to_dict() for r in reports], indent=1,
+                         sort_keys=True))
+    else:
+        print(render_text(reports))
+    return 0 if all(r.ok for r in reports) else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -219,7 +219,7 @@ class SanitizedLock:
     the full primitive surface tony_tpu uses: acquire/release, context
     manager, ``locked()``."""
 
-    def __init__(self, inner: Any, site: str, state: State):
+    def __init__(self, inner: Any, site: str, state: State) -> None:
         self._inner = inner
         self.site = site
         self._state = state

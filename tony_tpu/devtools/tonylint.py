@@ -49,6 +49,14 @@ defaults-md     ``conf/defaults.md`` is exactly the registry's rendered
                 table (the reference keys↔defaults-file parity gate)
 ==============  ============================================================
 
+Six further v2 *protocol* rules (directive-parity, journal-parity,
+fence-coverage, beacon-parity, terminal-state, metrics-registry) extract
+both halves of the coordinator↔executor protocol — heartbeat directives,
+REC_* journal record types, gen/mgen fences, beacon fields, terminal
+task-state discipline, the tony_* metrics registry — and check them
+against each other; they live in ``devtools/protocol.py`` and their
+runtime counterparts in ``devtools/invariants.py`` (``tony-tpu check``).
+
 Output contract: findings carry ``file:line`` + rule id; the CLI
 (``tony-tpu lint``) exits nonzero on any finding and can emit JSON; the
 tier-1 test (``tests/test_lint.py``) asserts a clean repo, so deleting a
@@ -66,6 +74,8 @@ import re
 import sys
 from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
 
+from tony_tpu.devtools.protocol import RULES_V2, run_protocol_rules
+
 #: rule id → one-line description (the ``--list`` surface and the doc table)
 RULES: Dict[str, str] = {
     "conf-key": "tony.* string literals resolve to registered config keys",
@@ -81,6 +91,9 @@ RULES: Dict[str, str] = {
     "bare-except": "no bare except:",
     "defaults-md": "conf/defaults.md matches the key registry",
 }
+# v2 protocol rules (devtools/protocol.py): the coordinator↔executor
+# directive/journal/fence/beacon/terminal/metrics contracts, both sides.
+RULES.update(RULES_V2)
 
 _SUPPRESS_RE = re.compile(r"tony:\s*lint-ignore\[([a-z\-]+)\]")
 _KEY_TOKEN_RE = re.compile(
@@ -133,7 +146,7 @@ class Finding:
 class _Src:
     """One parsed source file."""
 
-    def __init__(self, path: str, rel: str):
+    def __init__(self, path: str, rel: str) -> None:
         self.path = path
         self.rel = rel
         with open(path, "r", encoding="utf-8") as f:
@@ -184,7 +197,7 @@ def _contains_time_time(node: ast.AST) -> Optional[int]:
 
 
 class Linter:
-    def __init__(self, repo_root: Optional[str] = None):
+    def __init__(self, repo_root: Optional[str] = None) -> None:
         if repo_root is None:
             repo_root = os.path.dirname(os.path.dirname(
                 os.path.dirname(os.path.abspath(__file__))))
@@ -253,6 +266,7 @@ class Linter:
             self._check_rpc_parity(pkg_srcs)
         if "defaults-md" in active:
             self._check_defaults_md()
+        run_protocol_rules(self, pkg_srcs, active)
         self.findings.sort(key=lambda f: (f.file, f.line, f.rule))
         return self.findings
 

@@ -690,7 +690,8 @@ class TaskExecutor:
             try:
                 self.client.call(
                     "register_tensorboard_url", task_id=self.task_id,
-                    url=f"http://{self.hostname}:{self.tb_port.port}")
+                    url=f"http://{self.hostname}:{self.tb_port.port}",
+                    session_id=self.session_id)
             except Exception as e:  # noqa: BLE001
                 log.warning("TB registration failed: %s", e)
         port_file = str(self.conf.get(K.TASK_PORT_FILE, "") or "")

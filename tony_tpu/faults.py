@@ -141,7 +141,7 @@ class InjectedFault(ConnectionError):
     special-casing in the code under test.
     """
 
-    def __init__(self, site: str, call_no: int):
+    def __init__(self, site: str, call_no: int) -> None:
         super().__init__(f"injected fault at {site} (call #{call_no})")
         self.site = site
         self.call_no = call_no
@@ -150,7 +150,7 @@ class InjectedFault(ConnectionError):
 class _SiteRule:
     """Parsed spec + deterministic per-site decision state."""
 
-    def __init__(self, site: str, spec: str, seed: int):
+    def __init__(self, site: str, spec: str, seed: int) -> None:
         self.site = site
         self.spec = spec
         self.first = 0
@@ -232,7 +232,7 @@ class _SiteRule:
 
 
 class FaultInjector:
-    def __init__(self, rules: Dict[str, str], seed: int = 0):
+    def __init__(self, rules: Dict[str, str], seed: int = 0) -> None:
         unknown = set(rules) - set(SITES)
         if unknown:
             raise ValueError(
@@ -361,7 +361,7 @@ def install_from_env() -> bool:
     return True
 
 
-def install_from_conf(conf) -> bool:
+def install_from_conf(conf: Any) -> bool:
     """Coordinator/client path: read ``tony.fault.*`` keys. Returns True
     iff any site is configured (callers then export TONY_FAULTS)."""
     from tony_tpu.conf import keys as K

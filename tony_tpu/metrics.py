@@ -44,6 +44,43 @@ from typing import Any, Deque, Dict, List, Optional, Tuple
 DEFAULT_LATENCY_BUCKETS_S = (0.001, 0.0025, 0.005, 0.01, 0.025, 0.05,
                              0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0)
 
+#: THE series-name registry: every ``tony_*`` family the system exports,
+#: in one place. tonylint's ``metrics-registry`` rule enforces it both
+#: ways (an exported name must be registered; a registered name must
+#: have an exporting call site), and ``tony-tpu check`` verifies every
+#: family in a job's ``metrics.prom`` against it — so the docs, the
+#: portal and benchdiff can never drift against what actually exports.
+SERIES: Dict[str, str] = {
+    # -- per-task utilization (heartbeat-beacon-fed gauges) --------------
+    "tony_task_steps_completed": "step counter from the progress beacon",
+    "tony_task_steps_per_sec": "training steps per second",
+    "tony_task_tokens_per_sec": "tokens per second",
+    "tony_task_mfu": "model FLOPs utilization vs peak bf16",
+    "tony_task_hbm_bytes": "device HBM bytes in use",
+    "tony_task_rss_bytes": "process-tree resident set size bytes",
+    "tony_step_phase_seconds": "cumulative step wall per phase",
+    "tony_task_heartbeat_age_seconds": "seconds since last heartbeat",
+    # -- gang / session shape --------------------------------------------
+    "tony_tasks": "tasks by status",
+    "tony_gang_size": "current task count per jobtype gang",
+    "tony_session_epoch": "current retry epoch",
+    "tony_coordinator_generation": "coordinator generation",
+    "tony_membership_generation": "elastic membership generation",
+    # -- RPC plane --------------------------------------------------------
+    "tony_rpc_server_seconds": "coordinator-side RPC dispatch latency",
+    "tony_rpc_client_seconds": "executor-side RPC call latency",
+    "tony_rpc_requests_total": "RPC requests dispatched",
+    "tony_events_total": "job-history events emitted, by type",
+    # -- control-plane self-observation (coordinator/coordphases.py) -----
+    "tony_coord_phase_seconds": "coordinator tick wall per phase",
+    "tony_coord_tick_seconds": "mean active coordinator tick duration",
+    "tony_coord_registered_tasks": "tasks currently registered",
+    "tony_coord_beats_total": "heartbeats received",
+    "tony_journal_records_total": "write-ahead journal records appended",
+    "tony_journal_bytes_total": "write-ahead journal bytes appended",
+    "tony_journal_fsync_seconds": "journal append latency (fsync incl.)",
+}
+
 _LabelsKey = Tuple[Tuple[str, str], ...]
 
 

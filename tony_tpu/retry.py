@@ -73,7 +73,7 @@ def call_with_retry(
     rng: Optional[random.Random] = None,
     on_retry: Optional[Callable[[int, BaseException, float], None]] = None,
     what: str = "operation",
-):
+) -> object:
     """Run ``fn`` under ``policy``. Exceptions in ``give_up_on`` (checked
     first — carve non-retryable subclasses like FileNotFoundError out of
     OSError) and anything not in ``retry_on`` propagate immediately; the

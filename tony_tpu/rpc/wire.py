@@ -210,7 +210,7 @@ class RpcServer:
                  tls: Optional[ssl.SSLContext] = None,
                  generation: int = 0,
                  on_superseded: Optional[Any] = None,
-                 on_request: Optional[Any] = None):
+                 on_request: Optional[Any] = None) -> None:
         self._service = service
         self._token = token or None     # "" = unauthenticated, like None
         self._tls = tls
@@ -422,7 +422,7 @@ class RpcClient:
                  tls: Optional[ssl.SSLContext] = None,
                  generation: int = 0,
                  call_timeout_s: Optional[float] = None,
-                 on_latency: Optional[Any] = None):
+                 on_latency: Optional[Any] = None) -> None:
         self._addr = (host, port)
         self._token = token or None     # "" = unauthenticated, like None
         self._tls = tls

@@ -99,7 +99,8 @@ class Span:
                  "task", "start_us", "_t0_mono", "attrs", "_tracer", "_done")
 
     def __init__(self, tracer: "Tracer", name: str, parent_id: str,
-                 task: str = "", attrs: Optional[Dict[str, Any]] = None):
+                 task: str = "",
+                 attrs: Optional[Dict[str, Any]] = None) -> None:
         self.trace_id = tracer.trace_id
         self.span_id = new_span_id()
         self.parent_id = parent_id
@@ -129,7 +130,8 @@ class Span:
     def __enter__(self) -> "Span":
         return self
 
-    def __exit__(self, exc_type, exc, tb) -> None:
+    def __exit__(self, exc_type: Optional[type],
+                 exc: Optional[BaseException], tb: object) -> None:
         if exc_type is not None and not self._done:
             self.end(error=f"{exc_type.__name__}: {exc}"[:200])
         else:
@@ -150,7 +152,8 @@ class _NullSpan:
     def __enter__(self) -> "_NullSpan":
         return self
 
-    def __exit__(self, exc_type, exc, tb) -> None:
+    def __exit__(self, exc_type: Optional[type],
+                 exc: Optional[BaseException], tb: object) -> None:
         pass
 
 
@@ -180,7 +183,7 @@ class Tracer:
     (tony.trace.enabled)."""
 
     def __init__(self, trace_id: Optional[str] = None, service: str = "",
-                 path: Optional[str] = None, enabled: bool = True):
+                 path: Optional[str] = None, enabled: bool = True) -> None:
         self.trace_id = trace_id or new_trace_id()
         self.service = service
         self.enabled = enabled
