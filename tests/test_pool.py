@@ -178,6 +178,65 @@ def test_status_reports_fleet_states(tmp_path):
 
 
 # ---------------------------------------------------------------------------
+# Elastic grow-back rides the warm path (ROADMAP carried thread): a
+# resize-up's fresh launches go through the SAME backend.launch_task →
+# pool.lease path as the initial gang, at the same coordinator
+# generation — so regrow adopts warm workers instead of cold-spawning.
+# ---------------------------------------------------------------------------
+def test_grow_back_second_wave_leases_at_same_generation(tmp_path):
+    """The grow wave of an elastic resize bumps the MEMBERSHIP
+    generation, not the coordinator generation: the pool daemon's
+    per-app fence (which tracks coordinator generations) must grant the
+    second wave at the unchanged generation — and still refuse a true
+    zombie epoch's lower one."""
+    w1 = _fake_worker(tmp_path, worker_id="w1", pid=4242, adopted=True)
+    w2 = _fake_worker(tmp_path, worker_id="w2", pid=4243, adopted=True)
+    d = _daemon_with(tmp_path, w1, w2)
+    first = d.lease("worker:0", {}, str(tmp_path / "t0"),
+                    app_id="app1", generation=2)
+    assert first["worker_id"] == "w1"
+    # ...time passes, a host is lost and grown back: same app, same
+    # coordinator generation, new task index — the grow-back lease
+    grow = d.lease("worker:2", {}, str(tmp_path / "t2"),
+                   app_id="app1", generation=2)
+    assert grow["worker_id"] == "w2"
+    # a superseded (pre-recovery) coordinator's lease stays fenced
+    with pytest.raises(PoolError):
+        d.lease("worker:3", {}, str(tmp_path / "t3"),
+                app_id="app1", generation=1)
+
+
+def test_grow_back_backend_wave_adopts_warm_workers(tmp_path):
+    """Backend-level half of the grow-back contract: a SECOND wave of
+    launch_task calls (what Coordinator._apply_remesh issues for the
+    grown members, via the shared _launch_task path) adopts from the
+    pool exactly like the first wave — the handle is a _LeasedProc, no
+    cold spawn."""
+    grants = [{"worker_id": "w1", "pid": os.getpid()},
+              {"worker_id": "w2", "pid": os.getpid()}]
+
+    class _WaveStub(_StubPool):
+        def lease(self, task_id, env, workdir, app_id="", generation=0):
+            self.leases.append((task_id, app_id, generation))
+            return dict(grants[len(self.leases) - 1])
+
+    stub = _WaveStub()
+    b = _backend(tmp_path, stub)
+    env = {constants.APP_ID: "app1",
+           constants.COORDINATOR_GENERATION: "3"}
+    first = b._try_pool_lease(_spec("worker:0", env=env),
+                              str(tmp_path / "t0"), env)
+    # the grow wave launches a NEW index at the same generation
+    grow = b._try_pool_lease(_spec("worker:2", env=env),
+                             str(tmp_path / "t2"), env)
+    assert isinstance(first.popen, _LeasedProc)
+    assert isinstance(grow.popen, _LeasedProc)
+    assert grow.popen.worker_id == "w2"
+    assert stub.leases == [("worker:0", "app1", 3),
+                           ("worker:2", "app1", 3)]
+
+
+# ---------------------------------------------------------------------------
 # Backend adoption path (cluster/local.py) — every failure cold-spawns
 # ---------------------------------------------------------------------------
 class _StubPool:

@@ -1003,6 +1003,202 @@ def _cmd_pool(args: argparse.Namespace) -> int:
     return 1
 
 
+def _fleet_dir(args: argparse.Namespace) -> str:
+    return os.path.abspath(os.path.expanduser(
+        args.dir or str(args.conf_obj.get(K.FLEET_DIR, "") or "")
+        or os.path.join(_default_workdir(getattr(args, "workdir", None)),
+                        "fleet")))
+
+
+def _fleet_conf(args: argparse.Namespace):
+    from tony_tpu.conf.config import TonyTpuConfig
+
+    return TonyTpuConfig.from_layers(
+        config_file=getattr(args, "conf_file", None),
+        overrides=tuple(getattr(args, "conf", None) or []))
+
+
+def _render_fleet_top(snap: dict) -> str:
+    """One frame of `tony-tpu fleet top`: pool occupancy, per-tenant
+    usage vs quota, queue depth + wait quantiles, and one row per job
+    (queued jobs show their live wait; denials show why)."""
+    pool = snap.get("pool") or {}
+    qw = snap.get("queue_wait") or {}
+    lines = [
+        f"{snap.get('fleet_dir', '?')}  generation="
+        f"{snap.get('generation', '?')}  hosts: {pool.get('used', '?')}/"
+        f"{pool.get('total', '?')} used ({pool.get('free', '?')} free, "
+        f"{pool.get('slices', '?')}x{pool.get('hosts_per_slice', '?')})"
+        f"  queue={snap.get('queue_depth', '?')}"
+        f"  wait p50={qw.get('p50_s', 0)}s p99={qw.get('p99_s', 0)}s"]
+    tenants = snap.get("tenants") or {}
+    if tenants:
+        lines.append("tenants: " + "  ".join(
+            f"{t}={row.get('used', 0)}/{row.get('quota') or '∞'}"
+            for t, row in sorted(tenants.items())))
+    lines.append(f"{'JOB':<10}{'TENANT':<10}{'PRI':>4} {'STATE':<11}"
+                 f"{'HOSTS':>7}  {'WAIT':>7}  {'APP / NOTE'}")
+    for row in snap.get("jobs", []):
+        wait = row.get("wait_s")
+        note = row.get("app_id") or ""
+        if row.get("state") == "QUEUED" and row.get("denial"):
+            note = row["denial"]
+        hosts = f"{row.get('hosts', 0)}/{row.get('hosts_requested', '?')}"
+        lines.append(
+            f"{row.get('job', '?'):<10}{row.get('tenant', '?'):<10}"
+            f"{row.get('priority', 0):>4} {row.get('state', '?'):<11}"
+            f"{hosts:>7}  "
+            f"{(f'{wait:.1f}s' if wait is not None else '-'):>7}  "
+            f"{note}")
+    return "\n".join(lines)
+
+
+def _cmd_fleet(args: argparse.Namespace) -> int:
+    """Fleet operations (tony_tpu/fleet/): the persistent multi-job
+    gang scheduler. `start` spawns the daemon detached (use --recover
+    after a daemon crash to resume the journaled queue), `submit`
+    queues a job through it, `top` watches the scheduler live — see
+    the Multi-tenancy runbook in docs/operations.md."""
+    import subprocess
+
+    from tony_tpu import constants
+    from tony_tpu.fleet.client import FleetClient, FleetClientError
+    from tony_tpu.utils import proc as procutil
+
+    args.conf_obj = _fleet_conf(args)
+    fleet_dir = _fleet_dir(args)
+    addr_path = os.path.join(fleet_dir, constants.FLEET_ADDR_FILE)
+    if args.fleet_cmd == "start":
+        conf = args.conf_obj
+        if os.path.exists(addr_path):
+            client = FleetClient(fleet_dir)
+            try:
+                st = client.status()
+                print(f"fleet already running under {fleet_dir} "
+                      f"(generation {st.get('generation', '?')}, "
+                      f"{st.get('queue_depth', '?')} queued)")
+                return 0
+            except FleetClientError:
+                os.unlink(addr_path)   # stale addr from a dead daemon
+            finally:
+                client.close()
+        os.makedirs(fleet_dir, exist_ok=True)
+        slices = args.slices if args.slices is not None \
+            else conf.get_int(K.FLEET_SLICES, 1)
+        hps = args.hosts_per_slice if args.hosts_per_slice is not None \
+            else conf.get_int(K.FLEET_HOSTS_PER_SLICE, 8)
+        quotas = args.quotas if args.quotas is not None \
+            else str(conf.get(K.FLEET_QUOTAS, "") or "")
+        pool_dir = args.pool_dir if args.pool_dir is not None \
+            else str(conf.get(K.FLEET_POOL_DIR, "") or "")
+        cache_root = args.cache_root if args.cache_root is not None \
+            else str(conf.get(K.FLEET_COMPILE_CACHE_ROOT, "") or "")
+        tick_s = float(conf.get(K.FLEET_TICK_INTERVAL_S, 0.5) or 0.5)
+        cmd = [sys.executable, "-m", "tony_tpu.fleet", "serve",
+               "--dir", fleet_dir, "--slices", str(slices),
+               "--hosts-per-slice", str(hps), "--tick-s", str(tick_s)]
+        if quotas:
+            cmd += ["--quotas", quotas]
+        if pool_dir:
+            cmd += ["--pool-dir", pool_dir]
+        if cache_root:
+            cmd += ["--cache-root", cache_root]
+        if args.recover:
+            cmd.append("--recover")
+        flog = open(os.path.join(fleet_dir, "fleet.log"), "ab")
+        proc = subprocess.Popen(cmd, stdout=flog,
+                                stderr=subprocess.STDOUT,
+                                start_new_session=True)
+        flog.close()
+
+        def read_addr():
+            if proc.poll() is not None:
+                raise RuntimeError(
+                    f"fleet daemon exited with {proc.returncode}; see "
+                    f"{os.path.join(fleet_dir, 'fleet.log')}")
+            return os.path.exists(addr_path) or None
+
+        if procutil.poll_till_non_null(read_addr, interval_s=0.1,
+                                       timeout_s=60) is None:
+            print(f"fleet daemon never published its endpoint under "
+                  f"{fleet_dir}", file=sys.stderr)
+            return 1
+        print(f"fleet running under {fleet_dir} ({slices} slice(s) x "
+              f"{hps} hosts"
+              + (f", quotas {quotas}" if quotas else "")
+              + (", recovered" if args.recover else "") + ")")
+        print(f"submit with `tony-tpu fleet submit --dir {fleet_dir} "
+              f"--tenant <t> --hosts <n> --conf ...`")
+        return 0
+    client = FleetClient(fleet_dir)
+    try:
+        if args.fleet_cmd == "stop":
+            client.stop()
+            print(f"fleet under {fleet_dir} stopping (running jobs are "
+                  f"left to their tenants)")
+            return 0
+        if args.fleet_cmd == "status":
+            print(_render_fleet_top(client.status()))
+            return 0
+        if args.fleet_cmd == "top":
+            while True:
+                frame = _render_fleet_top(client.status())
+                if args.once:
+                    print(frame)
+                    return 0
+                print("\x1b[2J\x1b[H" + frame
+                      if sys.stdout.isatty() else frame, flush=True)
+                time.sleep(args.interval)
+        if args.fleet_cmd == "cancel":
+            res = client.cancel(args.job)
+            if not res.get("ok"):
+                print(f"cancel refused: {res.get('message', '?')}",
+                      file=sys.stderr)
+                return 1
+            print(f"{args.job}: {res.get('state', '?')}")
+            return 0
+        if args.fleet_cmd == "submit":
+            # Ship only the EXPLICIT conf entries: registry defaults
+            # would shadow the fleet's own grant-time injections
+            # (pool dir, compile cache, elastic knobs are setdefault'd
+            # on the daemon side).
+            reg = K.registry()
+            explicit = {
+                k: v for k, v in args.conf_obj.as_dict().items()
+                if k not in reg or v != reg[k].default}
+            res = client.submit(
+                args.tenant, args.hosts, priority=args.priority,
+                min_hosts=args.min_hosts, model=args.model,
+                conf=explicit)
+            if not res.get("ok"):
+                print(f"submit refused: {res.get('message', '?')}",
+                      file=sys.stderr)
+                return 1
+            job = res["job"]
+            print(f"queued {job} (tenant {args.tenant}, "
+                  f"{args.hosts} host(s), priority {args.priority})")
+            if not args.follow:
+                return 0
+            while True:
+                row = next((r for r in client.status().get("jobs", [])
+                            if r.get("job") == job), None)
+                if row and row.get("state") in ("FINISHED", "FAILED",
+                                                "CANCELLED"):
+                    print(f"{job}: {row['state']}"
+                          + (f" (app {row.get('app_id')})"
+                             if row.get("app_id") else ""))
+                    return 0 if row["state"] == "FINISHED" else 1
+                time.sleep(1.0)
+    except FleetClientError as e:
+        print(f"{e}", file=sys.stderr)
+        return 1
+    except KeyboardInterrupt:
+        return 0
+    finally:
+        client.close()
+    return 1
+
+
 def build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(prog="tony-tpu")
     sub = p.add_subparsers(dest="cmd", required=True)
@@ -1216,6 +1412,86 @@ def build_parser() -> argparse.ArgumentParser:
     pl.add_argument("--conf-file")
     pl.add_argument("--conf", action="append", metavar="K=V")
     pl.set_defaults(fn=_cmd_pool)
+
+    fl = sub.add_parser(
+        "fleet",
+        help="persistent multi-job gang scheduler over a shared slice "
+             "pool: priorities, per-tenant quotas, bin-packing, "
+             "preempt-to-reclaim via elastic shrink (tony.fleet.* keys; "
+             "docs/operations.md Multi-tenancy)")
+    fl_sub = fl.add_subparsers(dest="fleet_cmd", required=True)
+    fs = fl_sub.add_parser("start", help="spawn the fleet daemon "
+                                         "detached and wait for its "
+                                         "endpoint")
+    fs.add_argument("--dir", help="fleet state dir (default: "
+                                  "<workdir>/fleet)")
+    fs.add_argument("--workdir")
+    fs.add_argument("--slices", type=int, default=None,
+                    help="pool slices (default: tony.fleet.slices)")
+    fs.add_argument("--hosts-per-slice", type=int, default=None,
+                    help="hosts per slice (default: "
+                         "tony.fleet.hosts-per-slice)")
+    fs.add_argument("--quotas", default=None,
+                    help="tenant=hosts,... (default: tony.fleet.quotas)")
+    fs.add_argument("--pool-dir", default=None,
+                    help="warm executor pool for every grant "
+                         "(default: tony.fleet.pool-dir)")
+    fs.add_argument("--cache-root", default=None,
+                    help="per-model shared compile-cache root "
+                         "(default: tony.fleet.compile-cache-root)")
+    fs.add_argument("--recover", action="store_true",
+                    help="replay the fleet journal and resume the same "
+                         "queue state (after a daemon crash)")
+    fs.add_argument("--conf-file")
+    fs.add_argument("--conf", action="append", metavar="K=V")
+    fs.set_defaults(fn=_cmd_fleet)
+    for name, hlp in (("stop", "stop the daemon (running jobs keep "
+                               "running)"),
+                      ("status", "one scheduler snapshot"),
+                      ("top", "live scheduler view (pool occupancy, "
+                              "tenants, queue waits)")):
+        fx = fl_sub.add_parser(name, help=hlp)
+        fx.add_argument("--dir")
+        fx.add_argument("--workdir")
+        fx.add_argument("--conf-file")
+        fx.add_argument("--conf", action="append", metavar="K=V")
+        if name == "top":
+            fx.add_argument("--interval", type=float, default=2.0)
+            fx.add_argument("--once", action="store_true")
+        fx.set_defaults(fn=_cmd_fleet)
+    fb = fl_sub.add_parser(
+        "submit",
+        help="queue a job through the fleet: the policy engine grants "
+             "it hosts (or queues it behind priorities/quotas) and the "
+             "daemon runs it through the ordinary submit stack")
+    fb.add_argument("--dir")
+    fb.add_argument("--workdir")
+    fb.add_argument("--tenant", required=True)
+    fb.add_argument("--hosts", type=int, required=True,
+                    help="gang size in pool hosts "
+                         "(becomes tony.worker.instances)")
+    fb.add_argument("--priority", type=int, default=0,
+                    help="higher preempts lower (default 0)")
+    fb.add_argument("--min-hosts", type=int, default=0,
+                    help="elastic shrink floor; >0 marks the job "
+                         "preemptible via elastic resize (never killed)")
+    fb.add_argument("--model", default="",
+                    help="model key for the shared compile-cache mount "
+                         "(tenants sharing a model share warm compiles)")
+    fb.add_argument("--follow", action="store_true",
+                    help="poll until the job reaches a terminal state")
+    fb.add_argument("--conf-file", help="job config (json/yaml)")
+    fb.add_argument("--conf", action="append", metavar="K=V",
+                    help="job config override (repeatable)")
+    fb.set_defaults(fn=_cmd_fleet)
+    fc = fl_sub.add_parser("cancel", help="cancel a queued or running "
+                                          "fleet job")
+    fc.add_argument("job")
+    fc.add_argument("--dir")
+    fc.add_argument("--workdir")
+    fc.add_argument("--conf-file")
+    fc.add_argument("--conf", action="append", metavar="K=V")
+    fc.set_defaults(fn=_cmd_fleet)
 
     ln = sub.add_parser(
         "lint",

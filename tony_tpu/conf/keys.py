@@ -730,6 +730,18 @@ FAULT_COORD_SLOW_TICK = _key(
     "accounting (tony_coord_phase_seconds, tick duration in `top`) must "
     "surface. The call counter is monitor iterations, like "
     "coordinator.crash.")
+FAULT_FLEET_GRANT = _key(
+    "tony.fault.fleet-grant", "", str,
+    "Fail a fleet grant at apply time (tony_tpu/fleet/daemon.py), after "
+    "the placement decision but before the job is spawned — the "
+    "unspawnable-grant shape. The job stays QUEUED and is retried on a "
+    "later tick; a grant failure must never lose a submission.")
+FAULT_FLEET_PREEMPT = _key(
+    "tony.fault.fleet-preempt", "", str,
+    "Fail a fleet preempt-to-reclaim at apply time, before the victim's "
+    "elastic shrink RPC is issued — the unreachable-victim shape. The "
+    "preemption (and the grant waiting on it) is retried on a later "
+    "tick; the victim keeps running undisturbed.")
 FAULT_PROFILE_CAPTURE = _key(
     "tony.fault.profile-capture", "", str,
     "Fail an on-demand device capture at the step boundary that would "
@@ -764,6 +776,57 @@ POOL_PRELOAD = _key(
     "of the always-preloaded executor stack). 'jax' also initializes the "
     "backend — the multi-second cold-start slice the pool exists to "
     "hide. Empty = interpreter + tony_tpu only.")
+
+# --- fleet: persistent multi-job gang scheduler (tony_tpu/fleet/) ---------
+FLEET_DIR = _key(
+    "tony.fleet.dir", "", str,
+    "Directory of a running fleet daemon (tony-tpu fleet start) — the "
+    "persistent cluster scheduler that owns a shared slice pool and "
+    "gang-schedules many jobs against it with priorities, per-tenant "
+    "quotas, bin-packing and preempt-to-reclaim (the YARN-RM role the "
+    "reference outsourced, SURVEY §1 L4/L3). Empty = <workdir>/fleet "
+    "for the fleet CLI verbs.")
+FLEET_SLICES = _key(
+    "tony.fleet.slices", 1, int,
+    "TPU slices the fleet pool owns. Each slice contributes "
+    "tony.fleet.hosts-per-slice hosts; a sub-slice job is bin-packed "
+    "into ONE slice (gang locality), a larger job takes whole slices "
+    "plus a best-fit remainder.")
+FLEET_HOSTS_PER_SLICE = _key(
+    "tony.fleet.hosts-per-slice", 8, int,
+    "Hosts per pool slice. The policy engine accounts grants in hosts; "
+    "granted jobs launch with tony.worker.instances = granted hosts.")
+FLEET_QUOTAS = _key(
+    "tony.fleet.quotas", "", str,
+    "Per-tenant host quotas as 'tenant=hosts,tenant=hosts'. A tenant at "
+    "its quota QUEUES (quota-denied submissions never block other "
+    "tenants' grants — no head-of-line quota starvation); absent "
+    "tenants are unlimited. Empty = no quotas.")
+FLEET_TICK_INTERVAL_S = _key(
+    "tony.fleet.tick-interval-s", 0.5, float,
+    "Fleet scheduler loop cadence: job completion polling, grant/"
+    "preempt plan application, grow-back restores, and the fleet.prom/"
+    "fleet.status.json export all run on this tick.")
+FLEET_POOL_DIR = _key(
+    "tony.fleet.pool-dir", "", str,
+    "Warm executor pool (tony_tpu/pool.py) the fleet points EVERY "
+    "granted job at (tony.pool.dir is set on the grant's conf): each "
+    "tenant's resubmit then adopts pre-warmed executors instead of "
+    "cold-spawning. Empty = granted jobs keep whatever pool their own "
+    "conf names (usually none).")
+FLEET_COMPILE_CACHE_ROOT = _key(
+    "tony.fleet.compile-cache-root", "", str,
+    "Root of the shared per-model XLA compile-cache mounts: a grant "
+    "whose submission names a model gets tony.jax.compilation-cache-dir "
+    "= <root>/<model>, so every tenant resubmitting the same model — "
+    "not just the first — hits the warm-compile path. Empty = no "
+    "shared cache injection.")
+FLEET_PREEMPT_MIN_HOSTS = _key(
+    "tony.fleet.preempt-min-hosts", 1, int,
+    "Default floor a preempt-to-reclaim shrink may take an elastic "
+    "victim down to when the submission does not name its own "
+    "min_hosts. Victims are shrunk via the coordinator's elastic "
+    "resize (drain→remesh, no epoch burned), never killed.")
 
 # --- portal ---------------------------------------------------------------
 PORTAL_PORT = _key(
@@ -857,6 +920,7 @@ _RESERVED_NON_JOB_SEGMENTS = {
     "application", "task", "coordinator", "client", "history", "tpu", "portal",
     "keep-failed-task-dirs", "internal", "fault", "rpc", "trace", "metrics",
     "diagnosis", "pool", "elastic", "profile", "train", "coord", "scale",
+    "fleet",
 }
 
 

@@ -155,6 +155,29 @@ INCIDENT_FILE = "incident.json"
 # JSON in the pool dir, 0600 like the coordinator address file. Backends
 # try a pool.lease against it before cold-spawning; absent file = no pool.
 POOL_ADDR_FILE = "pool.addr"
+# Fleet daemon endpoint (tony_tpu/fleet/): host/port/token/generation JSON
+# in the fleet dir, 0600 — fleet.submit/status/cancel RPCs resolve it.
+FLEET_ADDR_FILE = "fleet.addr"
+# Write-ahead fleet journal (tony_tpu/fleet/journal.py): every submission,
+# grant, preemption and job state transition, fsync'd BEFORE it is acted
+# on — `tony-tpu fleet start --recover` replays it into the same queue
+# state (same REC_*/torn-tail discipline as coordinator/journal.py).
+FLEET_JOURNAL_FILE = "fleet.journal.jsonl"
+# Scheduler status snapshot the daemon atomically replaces every tick
+# (queue, grants, tenant occupancy) — the portal's /fleet view and any
+# RPC-less reader consume this instead of dialing the daemon.
+FLEET_STATUS_FILE = "fleet.status.json"
+# Rendered Prometheus exposition of the tony_fleet_* families, refreshed
+# every scheduler tick (the fleet-dir analogue of metrics.prom).
+FLEET_PROM_FILE = "fleet.prom"
+# Counter snapshot (tony_fleet_grants_total etc.), reloaded on
+# `fleet start --recover` so fleet counters stay monotonic across daemon
+# lives — same contract as METRICS_COUNTERS_FILE.
+FLEET_COUNTERS_FILE = "fleet.counters.json"
+# Fleet event stream (FLEET_JOB_QUEUED/GRANTED/PREEMPTED/...), JSON lines
+# in the fleet dir; append-only across daemon lives (never finalized —
+# the fleet is a daemon, not a job).
+FLEET_EVENTS_FILE = "fleet.events.jsonl"
 # Per-task exit report a POOLED executor writes into its task workdir at
 # exit ({"exit_code": N}): the leased process is the pool daemon's child,
 # not the backend's, so poll_completions reads this instead of waitpid.

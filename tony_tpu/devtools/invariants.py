@@ -27,6 +27,17 @@ phase-sum                perf.json per-phase seconds sum to the
                          attributed wall within tolerance
 metrics-unregistered     every ``tony_*`` family in metrics.prom is in
                          ``tony_tpu.metrics.SERIES``
+fleet-gen-monotonic      fleet daemon generations strictly increase
+fleet-unknown-job        no grant/preempt/state record for a job the
+                         journal never saw submitted
+fleet-double-grant       no second grant for a job without an
+                         intervening terminal state or daemon
+                         generation bump (a recovered daemon may
+                         re-carry a grant out; a live one must not)
+fleet-terminal           no job state transition out of FINISHED/
+                         FAILED/CANCELLED
+fleet-capacity           granted hosts never exceed the journaled pool
+                         (slices × hosts-per-slice) at any point
 =======================  ==================================================
 
 Surfaces: ``tony-tpu check <app|job_dir>`` (and the no-deps module CLI
@@ -343,6 +354,107 @@ def _check_spans(path: str, rel: str, rep: Report,
 
 
 # ---------------------------------------------------------------------------
+# fleet-journal invariants (tony_tpu/fleet/journal.py)
+# ---------------------------------------------------------------------------
+def _check_fleet_journal(path: str, rel: str, rep: Report) -> None:
+    """The fleet scheduler's write-ahead journal holds the multi-job
+    half of the protocol: monotonic daemon generations, every grant for
+    a known submission, at most one live grant per job per daemon life,
+    terminal job states that stay terminal, and host accounting that
+    never exceeds the journaled pool."""
+    from tony_tpu.fleet import journal as fj
+
+    records, torn = _iter_journal_records(path)
+    rep.checked[rel] = len(records)
+    if torn:
+        rep.notes.append(
+            f"{rel}: torn/undecodable tail after {len(records)} good "
+            f"record(s) — the crash window; prefix checked")
+    last_gen: Optional[int] = None
+    capacity = 0
+    submitted: Set[str] = set()
+    # job → current state fold ("QUEUED"/"GRANTED"/lifecycle states)
+    states: Dict[str, str] = {}
+    hosts: Dict[str, int] = {}        # granted hosts per live job
+    for idx, rec in records:
+        t = rec.get("t")
+        ev = json.dumps(rec, sort_keys=True)
+        job = str(rec.get("job", "") or "")
+        if t == fj.REC_FLEET_GEN:
+            gen = int(rec.get("generation", 0) or 0)
+            if last_gen is not None and gen <= last_gen:
+                rep.violations.append(Violation(
+                    "fleet-gen-monotonic", rel, idx,
+                    f"fleet generation {gen} does not supersede "
+                    f"{last_gen} — generations must strictly increase "
+                    f"(the zombie-daemon fence)", ev))
+            last_gen = max(gen, last_gen or 0)
+            capacity = (int(rec.get("slices", 0) or 0)
+                        * int(rec.get("hosts_per_slice", 0) or 0))
+            # A new daemon life re-carries interrupted grants out: its
+            # grant folds restart (the fgen record is the license), and
+            # a granted-but-never-spawned job's hosts were never truly
+            # in use — drop them from the capacity fold.
+            for j, st in list(states.items()):
+                if st == "GRANTED":
+                    states[j] = "QUEUED"
+                    hosts.pop(j, None)
+            continue
+        if t == fj.REC_FLEET_SUBMIT:
+            submitted.add(job)
+            states[job] = "QUEUED"
+            continue
+        if t not in (fj.REC_FLEET_GRANT, fj.REC_FLEET_PREEMPT,
+                     fj.REC_FLEET_STATE):
+            continue
+        if job not in submitted:
+            rep.violations.append(Violation(
+                "fleet-unknown-job", rel, idx,
+                f"record for job {job!r} which the journal never saw "
+                f"submitted — a grant/state without a submission", ev))
+            continue
+        prev = states.get(job, "QUEUED")
+        if t == fj.REC_FLEET_GRANT:
+            if prev in fj.TERMINAL_STATES:
+                rep.violations.append(Violation(
+                    "fleet-terminal", rel, idx,
+                    f"grant for job {job} in terminal state {prev} — a "
+                    f"finished job was re-granted", ev))
+            elif prev != "QUEUED":
+                rep.violations.append(Violation(
+                    "fleet-double-grant", rel, idx,
+                    f"second grant for job {job} (state {prev}) with no "
+                    f"intervening terminal state or generation bump — "
+                    f"a duplicated grant runs the job twice", ev))
+            states[job] = "GRANTED"
+            hosts[job] = int(rec.get("hosts", 0) or 0)
+        elif t == fj.REC_FLEET_PREEMPT:
+            hosts[job] = int(rec.get("to", hosts.get(job, 0)) or 0)
+        else:                        # REC_FLEET_STATE
+            st = str(rec.get("state", "") or "")
+            if prev in fj.TERMINAL_STATES and st != prev:
+                rep.violations.append(Violation(
+                    "fleet-terminal", rel, idx,
+                    f"job {job} transitions {prev} → {st} after a "
+                    f"terminal state — a closed job was resurrected",
+                    ev))
+            states[job] = st if st != fj.STATE_RESTORED \
+                else fj.STATE_RUNNING
+            if st == fj.STATE_RESTORED:
+                hosts[job] = int(rec.get("hosts", hosts.get(job, 0))
+                                 or 0)
+            if st in fj.TERMINAL_STATES:
+                hosts.pop(job, None)
+        in_use = sum(hosts.values())
+        if capacity and in_use > capacity:
+            rep.violations.append(Violation(
+                "fleet-capacity", rel, idx,
+                f"granted hosts total {in_use} exceeds the journaled "
+                f"pool of {capacity} — the scheduler over-committed",
+                ev))
+
+
+# ---------------------------------------------------------------------------
 # perf.json + metrics.prom invariants
 # ---------------------------------------------------------------------------
 def _check_perf(path: str, rel: str, rep: Report) -> None:
@@ -424,8 +536,19 @@ def _finished_succeeded(job_dir: str) -> bool:
 def check_job_dir(job_dir: str) -> Report:
     """Verify one job dir's artifacts. Absent artifacts are notes (a
     minimal job writes only the journal); present artifacts must hold
-    their invariants."""
+    their invariants. A FLEET dir (holds a fleet journal, usually no
+    session journal) is checked by the fleet rules and its per-job
+    artifacts skipped as absent."""
     rep = Report(job_dir=job_dir)
+    fleet_path = os.path.join(job_dir, constants.FLEET_JOURNAL_FILE)
+    if os.path.exists(fleet_path):
+        _check_fleet_journal(fleet_path, constants.FLEET_JOURNAL_FILE,
+                             rep)
+        _check_prom(os.path.join(job_dir, constants.FLEET_PROM_FILE),
+                    constants.FLEET_PROM_FILE, rep)
+        if not os.path.exists(os.path.join(job_dir,
+                                           constants.JOURNAL_FILE)):
+            return rep
     strict = _finished_succeeded(job_dir)
     if not strict:
         rep.notes.append(
@@ -454,13 +577,15 @@ def check_job_dir(job_dir: str) -> Report:
 
 
 def find_job_dirs(root: str) -> List[str]:
-    """Every dir under ``root`` holding a session journal — how the
-    pytest artifact fixture and `check` on a history root find the job
-    dirs to verify."""
+    """Every dir under ``root`` holding a session journal OR a fleet
+    journal — how the pytest artifact fixture and `check` on a history
+    root find the dirs to verify (a fleet drill's tmp_path holds both
+    kinds, and every one is checked)."""
     out = []
     for dirpath, dirnames, filenames in os.walk(root):
         dirnames[:] = [d for d in dirnames if d != "__pycache__"]
-        if constants.JOURNAL_FILE in filenames:
+        if constants.JOURNAL_FILE in filenames \
+                or constants.FLEET_JOURNAL_FILE in filenames:
             out.append(dirpath)
     return sorted(out)
 
@@ -495,12 +620,15 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     if not os.path.isdir(args.target):
         print(f"not a directory: {args.target}", file=sys.stderr)
         return 2
-    if os.path.exists(os.path.join(args.target, constants.JOURNAL_FILE)):
+    if os.path.exists(os.path.join(args.target, constants.JOURNAL_FILE)) \
+            or os.path.exists(os.path.join(args.target,
+                                           constants.FLEET_JOURNAL_FILE)):
         reports = [check_job_dir(args.target)]
     else:
         reports = check_tree(args.target)
         if not reports:
-            print(f"no job dirs (no {constants.JOURNAL_FILE}) under "
+            print(f"no job/fleet dirs (no {constants.JOURNAL_FILE} or "
+                  f"{constants.FLEET_JOURNAL_FILE}) under "
                   f"{args.target}", file=sys.stderr)
             return 2
     if args.as_json:

@@ -269,21 +269,15 @@ def _check_directive_parity(linter, srcs) -> None:
 # journal-parity
 # ---------------------------------------------------------------------------
 def _check_journal_parity(linter, srcs) -> None:
-    journal_src = next(
-        (s for s in srcs
-         if s.rel.endswith(os.path.join("coordinator", "journal.py"))),
-        None)
-    if journal_src is None:
+    # Every write-ahead journal module in the package (the session
+    # journal coordinator/journal.py AND the fleet journal
+    # fleet/journal.py) owes the same parity: REC_* declared ⇒ appended
+    # somewhere ⇒ replayed by ITS OWN replay(). Constant names are
+    # globally unique across journal modules, so the repo-wide
+    # written-set matches writers to the right registry by name.
+    journal_srcs = [s for s in srcs if s.rel.endswith("journal.py")]
+    if not journal_srcs:
         return
-    # REC_* constants: name → (value, line)
-    consts: Dict[str, Tuple[str, int]] = {}
-    for node in journal_src.tree.body:
-        if (isinstance(node, ast.Assign) and len(node.targets) == 1
-                and isinstance(node.targets[0], ast.Name)
-                and node.targets[0].id.startswith("REC_")):
-            val = _const_str(node.value)
-            if val is not None:
-                consts[node.targets[0].id] = (val, node.lineno)
     written: Set[str] = set()
     for src in srcs:
         for node in ast.walk(src.tree):
@@ -300,35 +294,49 @@ def _check_journal_parity(linter, srcs) -> None:
                         f"journal record type {_const_str(v)!r} written "
                         f"as a string literal — use the REC_* constant "
                         f"so replay parity stays checkable", src)
-    replayed: Set[str] = set()
-    for fn in _functions(journal_src.tree):
-        if fn.name != "replay":
+    for journal_src in journal_srcs:
+        # REC_* constants this journal module declares: name → (value, line)
+        consts: Dict[str, Tuple[str, int]] = {}
+        for node in journal_src.tree.body:
+            if (isinstance(node, ast.Assign) and len(node.targets) == 1
+                    and isinstance(node.targets[0], ast.Name)
+                    and node.targets[0].id.startswith("REC_")):
+                val = _const_str(node.value)
+                if val is not None:
+                    consts[node.targets[0].id] = (val, node.lineno)
+        if not consts:
             continue
-        for node in ast.walk(fn):
-            if not isinstance(node, ast.Compare):
+        replayed: Set[str] = set()
+        for fn in _functions(journal_src.tree):
+            if fn.name != "replay":
                 continue
-            for sub in ast.walk(node):
-                if isinstance(sub, ast.Name) and sub.id.startswith("REC_"):
-                    replayed.add(sub.id)
-    for name in sorted(consts):
-        val, line = consts[name]
-        if name not in written:
+            for node in ast.walk(fn):
+                if not isinstance(node, ast.Compare):
+                    continue
+                for sub in ast.walk(node):
+                    if isinstance(sub, ast.Name) \
+                            and sub.id.startswith("REC_"):
+                        replayed.add(sub.id)
+        for name in sorted(consts):
+            val, line = consts[name]
+            if name not in written:
+                linter._emit(
+                    "journal-parity", journal_src.rel, line,
+                    f"journal record type {name} ({val!r}) is declared "
+                    f"but never appended — dead record type (delete it, "
+                    f"or wire the writer)", journal_src)
+            elif name not in replayed:
+                linter._emit(
+                    "journal-parity", journal_src.rel, line,
+                    f"journal record type {name} ({val!r}) is appended "
+                    f"but replay() has no branch for it — a recover "
+                    f"replay silently drops this state transition",
+                    journal_src)
+        for name in sorted(replayed - set(consts)):
             linter._emit(
-                "journal-parity", journal_src.rel, line,
-                f"journal record type {name} ({val!r}) is declared but "
-                f"never appended — dead record type (delete it, or wire "
-                f"the writer)", journal_src)
-        elif name not in replayed:
-            linter._emit(
-                "journal-parity", journal_src.rel, line,
-                f"journal record type {name} ({val!r}) is appended but "
-                f"replay() has no branch for it — a --recover replay "
-                f"silently drops this state transition", journal_src)
-    for name in sorted(replayed - set(consts)):
-        linter._emit(
-            "journal-parity", journal_src.rel, 1,
-            f"replay() references record type {name} which is not a "
-            f"declared REC_* constant", journal_src)
+                "journal-parity", journal_src.rel, 1,
+                f"replay() references record type {name} which is not "
+                f"a declared REC_* constant", journal_src)
 
 
 # ---------------------------------------------------------------------------

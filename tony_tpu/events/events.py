@@ -68,6 +68,26 @@ class EventType(str, enum.Enum):
     # status ("captured" with the artifact dir, or "failed" with the
     # error — a failed capture never kills or stalls training).
     TASK_PROFILED = "TASK_PROFILED"
+    # Fleet scheduler events (tony_tpu/fleet/daemon.py — the multi-job
+    # gang scheduler's own stream, written into the fleet dir, not a job
+    # dir). A submission entered the queue; payload: job, tenant,
+    # priority, hosts.
+    FLEET_JOB_QUEUED = "FLEET_JOB_QUEUED"
+    # A queued submission was granted capacity and spawned; payload:
+    # job, hosts, placement, wait_s (queue wait — the p50/p99 source).
+    FLEET_JOB_GRANTED = "FLEET_JOB_GRANTED"
+    # A running job was shrunk via its coordinator's elastic resize to
+    # reclaim hosts for a higher-priority submission (preempt-to-
+    # reclaim: drain→remesh, no victim epoch burned, never a kill);
+    # payload: job, from/to hosts, the demanding job.
+    FLEET_JOB_PREEMPTED = "FLEET_JOB_PREEMPTED"
+    # A grant was deferred because the tenant is at its host quota
+    # (emitted once per queued→quota-denied transition, not per tick);
+    # payload: job, tenant, used, quota.
+    FLEET_QUOTA_DENIED = "FLEET_QUOTA_DENIED"
+    # A fleet job reached a terminal state (finished/failed/cancelled);
+    # payload: job, state, exit, app_id.
+    FLEET_JOB_FINISHED = "FLEET_JOB_FINISHED"
 
 
 @dataclasses.dataclass

@@ -73,6 +73,17 @@ site                      where it fires
                           surface (tick duration in ``top``, the
                           control-plane verdicts); the call counter is
                           monitor iterations
+``fleet.grant``           fleet daemon grant application, after the
+                          placement decision and the write-ahead grant
+                          record but before the job spawn — the
+                          unspawnable-grant shape; the job must stay
+                          QUEUED and be retried, never lost
+``fleet.preempt``         fleet daemon preempt-to-reclaim, before the
+                          victim's elastic shrink RPC — the
+                          unreachable-victim shape; the preemption (and
+                          the grant waiting on the reclaimed hosts) is
+                          retried on a later tick, the victim keeps
+                          running
 ========================  =====================================================
 
 Spec grammar (the value of ``tony.fault.<site>`` conf keys, or one
@@ -129,7 +140,8 @@ SITES = ("rpc.connect", "rpc.send", "rpc.slow", "heartbeat",
          "user.hang", "user.slow_step",
          "pool.lease", "pool.stale", "pool.adopt",
          "host.loss", "resize.barrier", "resize.remesh",
-         "profile.capture", "quant.probe", "coord.slow-tick")
+         "profile.capture", "quant.probe", "coord.slow-tick",
+         "fleet.grant", "fleet.preempt")
 
 
 class InjectedFault(ConnectionError):

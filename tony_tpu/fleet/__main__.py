@@ -1,0 +1,55 @@
+"""Fleet daemon entrypoint: ``python -m tony_tpu.fleet serve``.
+
+The operator-facing wrapper is ``tony-tpu fleet start`` (spawns this
+detached and waits for the endpoint); running ``serve`` directly keeps
+the daemon in the foreground — the systemd/supervisor deployment shape.
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+import signal
+import sys
+from typing import List, Optional
+
+from tony_tpu.fleet.daemon import FleetDaemon, FleetError
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    p = argparse.ArgumentParser(prog="tony-tpu-fleet")
+    sub = p.add_subparsers(dest="role", required=True)
+    s = sub.add_parser("serve", help="run the fleet daemon (foreground)")
+    s.add_argument("--dir", required=True, help="fleet state directory")
+    s.add_argument("--slices", type=int, default=1)
+    s.add_argument("--hosts-per-slice", type=int, default=8)
+    s.add_argument("--quotas", default="",
+                   help="per-tenant host quotas: tenant=hosts,...")
+    s.add_argument("--pool-dir", default="",
+                   help="warm executor pool granted jobs adopt from")
+    s.add_argument("--cache-root", default="",
+                   help="root of the per-model shared compile caches")
+    s.add_argument("--tick-s", type=float, default=0.5)
+    s.add_argument("--recover", action="store_true",
+                   help="replay the fleet journal and resume the queue "
+                        "(required when the dir holds non-terminal jobs)")
+    args = p.parse_args(argv)
+    logging.basicConfig(
+        level=logging.INFO,
+        format="%(asctime)s %(levelname)s %(name)s: %(message)s")
+    try:
+        daemon = FleetDaemon(args.dir, slices=args.slices,
+                             hosts_per_slice=args.hosts_per_slice,
+                             quotas=args.quotas, pool_dir=args.pool_dir,
+                             cache_root=args.cache_root,
+                             tick_s=args.tick_s, recover=args.recover)
+    except (FleetError, ValueError) as e:
+        print(f"fleet: {e}", file=sys.stderr)
+        return 1
+    signal.signal(signal.SIGTERM, lambda *_: daemon.request_stop())
+    signal.signal(signal.SIGINT, lambda *_: daemon.request_stop())
+    return daemon.run()
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,812 @@
+"""Fleet daemon: the persistent cluster scheduler process.
+
+``tony-tpu fleet start`` (or ``python -m tony_tpu.fleet serve``) runs one
+of these per cluster. It owns a pool of TPU slices (LocalSim hosts in
+drills), accepts submissions over the ordinary token-authed RPC plane
+(``fleet.submit`` / ``fleet.status`` / ``fleet.cancel`` / ``fleet.stop``,
+generation-fenced like every other surface), lets the stdlib policy
+engine (``fleet/policy.py``) decide who runs where, and carries out the
+decisions:
+
+- a **grant** spawns the granted job through the ordinary single-job
+  stack — one ``tony-tpu submit`` client subprocess per job, with the
+  fleet's injections on its conf: granted gang size, elastic knobs for
+  preemptible jobs, the shared warm executor pool (``tony.pool.dir``)
+  and the per-model compile-cache mount
+  (``tony.jax.compilation-cache-dir = <root>/<model>``) so every
+  tenant's resubmit rides the warm paths;
+- a **preemption** shrinks the victim through its coordinator's elastic
+  resize RPC (``coordinator/elastic.py`` drain→remesh — the absorb path:
+  no kill, no epoch burned) and hands the reclaimed hosts to the
+  higher-priority demander;
+- a **grow-back** restores shrunk victims once the queue drains.
+
+Every decision is write-ahead journaled (``fleet/journal.py``) so a
+SIGKILLed daemon restarted with ``--recover`` resumes the same queue
+state, re-adopts still-running jobs by their recorded pid (the client
+subprocesses are session leaders and survive the daemon), and re-spawns
+granted-but-never-started jobs — zero duplicated or lost grants.
+Scheduler state surfaces as FLEET_* events, the ``tony_fleet_*`` metric
+families (``<fleet_dir>/fleet.prom``), an atomically replaced
+``fleet.status.json`` (the portal's /fleet source), and ``tony-tpu
+fleet top``.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import subprocess
+import sys
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+from tony_tpu import constants, faults
+from tony_tpu.conf import keys as K
+from tony_tpu.events.events import Event, EventHandler, EventType
+from tony_tpu.fleet import journal as fjournal
+from tony_tpu.fleet.policy import (CAPACITY_DENIED, GRANT, QUOTA_DENIED,
+                                   SHRINK, JobRequest, PolicyEngine,
+                                   parse_quotas)
+from tony_tpu.metrics import MetricsRegistry
+from tony_tpu.utils.durable import atomic_write
+
+log = logging.getLogger(__name__)
+
+#: daemon-side job states (journal STATE_* plus the pre-grant ones)
+QUEUED = "QUEUED"
+GRANTED = "GRANTED"
+RUNNING = "RUNNING"
+
+
+class FleetError(RuntimeError):
+    pass
+
+
+def _pid_alive(pid: int) -> bool:
+    try:
+        os.kill(pid, 0)
+        return True
+    except ProcessLookupError:
+        return False
+    except PermissionError:
+        return True
+
+
+class _FleetJob:
+    def __init__(self, req: JobRequest, conf: Dict[str, str],
+                 workdir: str) -> None:
+        self.req = req
+        self.conf = conf
+        self.workdir = workdir
+        self.state = QUEUED
+        self.hosts = 0
+        self.placement: Dict[int, int] = {}
+        self.app_id = ""
+        self.pid = 0
+        self.exit_code: Optional[int] = None
+        self.handle: Optional[Any] = None
+        self.submitted_mono = time.monotonic()
+        self.wait_s: Optional[float] = None    # queue wait, set at grant
+        self.denial = ""                       # last quota/capacity note
+        self.cancelled = False
+
+
+class _AdoptedHandle:
+    """Popen-shaped handle over a RECOVERED job's client process: not
+    our child (the previous daemon life spawned it), so liveness is a
+    signal-0 probe and the outcome comes from the job's finalized
+    history file — the same adopt-a-foreign-process shape as the pool
+    backend's _LeasedProc."""
+
+    def __init__(self, pid: int, history_root: str, job: "_FleetJob"):
+        self.pid = pid
+        self.history_root = history_root
+        self.job = job
+        self.returncode: Optional[int] = None
+
+    def poll(self) -> Optional[int]:
+        if self.returncode is not None:
+            return self.returncode
+        if _pid_alive(self.pid):
+            return None
+        status = self._history_status()
+        self.returncode = 0 if status == "SUCCEEDED" else 1
+        return self.returncode
+
+    def _history_status(self) -> str:
+        from tony_tpu.events import history
+
+        app_id = self.job.app_id or _discover_app(self.job.workdir) or ""
+        if not app_id:
+            return ""
+        job_dir = history.list_job_dirs(self.history_root).get(app_id)
+        if not job_dir:
+            return ""
+        path = history.find_history_file(job_dir)
+        if not path:
+            return ""
+        meta = history.parse_metadata(os.path.basename(path))
+        return meta.status if meta is not None else ""
+
+
+def _discover_app(job_workdir: str) -> Optional[str]:
+    """The app id of the single job submitted from ``job_workdir`` (the
+    client creates ``jobs/<app_id>/`` there); newest wins if a re-grant
+    ever left a sibling."""
+    jobs_dir = os.path.join(job_workdir, "jobs")
+    try:
+        entries = sorted(os.listdir(jobs_dir))
+    except OSError:
+        return None
+    return entries[-1] if entries else None
+
+
+class SubprocessJobRunner:
+    """Carries fleet decisions out against the real single-job stack:
+    spawn = one ``tony-tpu submit`` client subprocess (session leader —
+    it survives a daemon SIGKILL), resize/kill = RPCs against the job's
+    coordinator address file. Tests substitute a fake with the same
+    surface."""
+
+    def __init__(self, python: str = sys.executable) -> None:
+        self.python = python
+
+    def spawn(self, job_workdir: str,
+              overrides: Dict[str, str]) -> subprocess.Popen:
+        os.makedirs(job_workdir, exist_ok=True)
+        cmd = [self.python, "-m", "tony_tpu.cli", "submit",
+               "--workdir", job_workdir]
+        for k in sorted(overrides):
+            cmd += ["--conf", f"{k}={overrides[k]}"]
+        env = dict(os.environ)
+        repo_root = os.path.dirname(os.path.dirname(
+            os.path.dirname(os.path.abspath(__file__))))
+        env["PYTHONPATH"] = (repo_root + os.pathsep +
+                             env.get("PYTHONPATH", "")).rstrip(os.pathsep)
+        clog = open(os.path.join(job_workdir, "client.log"), "ab")
+        popen = subprocess.Popen(cmd, stdout=clog,
+                                 stderr=subprocess.STDOUT, env=env,
+                                 start_new_session=True)
+        clog.close()
+        return popen
+
+    def poll(self, handle: Any) -> Optional[int]:
+        return handle.poll()
+
+    def _coordinator_rpc(self, job_workdir: str) -> Optional[Any]:
+        app_id = _discover_app(job_workdir)
+        if app_id is None:
+            return None
+        addr_path = os.path.join(job_workdir, "jobs", app_id,
+                                 "coordinator.addr")
+        try:
+            with open(addr_path, encoding="utf-8") as f:
+                addr = json.load(f)
+        except (OSError, ValueError):
+            return None
+        from tony_tpu.rpc.wire import RpcClient
+
+        return RpcClient(addr["host"], int(addr["port"]),
+                         token=addr.get("token") or None,
+                         max_retries=2, retry_sleep_s=0.2,
+                         connect_timeout_s=5.0, call_timeout_s=15.0)
+
+    def resize(self, job_workdir: str, size: int) -> bool:
+        """Elastic resize (shrink = preempt-to-reclaim, grow =
+        grow-back restore) via the job's own resize_application RPC."""
+        rpc = self._coordinator_rpc(job_workdir)
+        if rpc is None:
+            return False
+        try:
+            res = rpc.call("resize_application", size=int(size), job="")
+            return bool(isinstance(res, dict) and res.get("ok"))
+        except Exception as e:  # noqa: BLE001 — a dead victim is a no
+            log.warning("fleet resize of %s to %d failed: %s",
+                        job_workdir, size, e)
+            return False
+        finally:
+            rpc.close()
+
+    def kill(self, job_workdir: str) -> bool:
+        rpc = self._coordinator_rpc(job_workdir)
+        if rpc is None:
+            return False
+        try:
+            rpc.call("kill_application")
+            return True
+        except Exception as e:  # noqa: BLE001
+            log.warning("fleet kill of %s failed: %s", job_workdir, e)
+            return False
+        finally:
+            rpc.close()
+
+
+class _FleetService:
+    """RPC surface (rpc/wire.py namespacing: ``fleet.submit`` etc.)."""
+
+    def __init__(self, daemon: "FleetDaemon") -> None:
+        self._d = daemon
+
+    def fleet__submit(self, tenant: str, hosts: int, priority: int = 0,
+                      min_hosts: int = 0, model: str = "",
+                      conf: Optional[dict] = None) -> dict:
+        return self._d.submit(str(tenant), int(hosts),
+                              priority=int(priority or 0),
+                              min_hosts=int(min_hosts or 0),
+                              model=str(model or ""),
+                              conf=dict(conf or {}))
+
+    def fleet__status(self) -> dict:
+        return self._d.status()
+
+    def fleet__cancel(self, job: str) -> dict:
+        return self._d.cancel(str(job))
+
+    def fleet__stop(self) -> bool:
+        self._d.request_stop()
+        return True
+
+
+class FleetDaemon:
+    def __init__(self, fleet_dir: str, slices: int = 1,
+                 hosts_per_slice: int = 8, quotas: str = "",
+                 pool_dir: str = "", cache_root: str = "",
+                 tick_s: float = 0.5, recover: bool = False,
+                 runner: Optional[Any] = None,
+                 python: str = sys.executable) -> None:
+        self.fleet_dir = os.path.abspath(os.path.expanduser(fleet_dir))
+        os.makedirs(self.fleet_dir, exist_ok=True)
+        self.slices = max(1, int(slices))
+        self.hosts_per_slice = max(1, int(hosts_per_slice))
+        self.quotas = parse_quotas(quotas)
+        self.pool_dir = pool_dir
+        self.cache_root = cache_root
+        self.tick_s = max(0.05, float(tick_s))
+        self.history_root = os.path.join(self.fleet_dir, "history")
+        self.runner = runner if runner is not None \
+            else SubprocessJobRunner(python)
+        self.engine = PolicyEngine(self.slices, self.hosts_per_slice,
+                                   self.quotas)
+        self.jobs: Dict[str, _FleetJob] = {}
+        self._seq = 0
+        self._lock = threading.Lock()
+        self._stop_evt = threading.Event()
+        self._started = False
+
+        journal_path = os.path.join(self.fleet_dir,
+                                    constants.FLEET_JOURNAL_FILE)
+        replayed: Optional[fjournal.FleetReplayState] = None
+        if os.path.exists(journal_path):
+            replayed = fjournal.replay(journal_path)
+            live = [f for f in replayed.jobs.values()
+                    if f.state not in fjournal.TERMINAL_STATES]
+            if live and not recover:
+                raise FleetError(
+                    f"fleet dir {self.fleet_dir} holds journaled state "
+                    f"for {len(live)} non-terminal job(s) — start with "
+                    f"--recover to resume it, or point --dir elsewhere")
+        # Generation: strictly monotonic across daemon lives (journal-
+        # persisted, fences zombie daemons out of the RPC plane).
+        self.generation = (replayed.generation if replayed else 0) + 1
+        self.journal = fjournal.FleetJournal(journal_path)
+        self.journal.generation(self.generation, self.slices,
+                                self.hosts_per_slice)
+
+        self.metrics = MetricsRegistry()
+        self._counters_path = os.path.join(self.fleet_dir,
+                                           constants.FLEET_COUNTERS_FILE)
+        self.metrics.load_counters(self._counters_path)
+        self.events = EventHandler(self.fleet_dir,
+                                   constants.FLEET_EVENTS_FILE,
+                                   on_emit=self._count_event)
+        # The writer thread runs from construction (not start()): every
+        # scheduler decision is evented, including ones taken before the
+        # RPC plane is up (recovery re-folds, embedded/test daemons).
+        self.events.start()
+        import secrets
+
+        self.token = secrets.token_hex(16)
+        from tony_tpu.rpc.wire import RpcServer
+
+        self.rpc = RpcServer(_FleetService(self), host="127.0.0.1",
+                             port=0, token=self.token,
+                             generation=self.generation)
+        if replayed is not None and recover:
+            self._recover(replayed)
+
+    # -- recovery ---------------------------------------------------------
+    def _recover(self, st: fjournal.FleetReplayState) -> None:
+        """Rebuild queue + accounting from the replayed journal: queued
+        jobs re-enqueue in submission order; running jobs are re-adopted
+        by their recorded client pid; granted-but-never-started jobs
+        re-spawn against their journaled grant; finished jobs keep their
+        verdicts for the status surface."""
+        self._seq = st.seq
+        for fold in sorted(st.jobs.values(), key=lambda f: f.seq):
+            req = JobRequest(fold.job_id, fold.tenant,
+                             priority=fold.priority,
+                             hosts=fold.hosts_requested,
+                             min_hosts=fold.min_hosts, model=fold.model,
+                             seq=fold.seq)
+            job = _FleetJob(req, fold.conf,
+                            os.path.join(self.fleet_dir, "jobs",
+                                         fold.job_id))
+            job.app_id = fold.app_id
+            job.pid = fold.pid
+            job.exit_code = fold.exit_code
+            self.jobs[fold.job_id] = job
+            if fold.state in fjournal.TERMINAL_STATES:
+                job.state = fold.state
+                continue
+            if fold.state == "QUEUED":
+                self.engine.submit(req)
+                continue
+            # GRANTED / SPAWNED / RUNNING: the grant stands — decide
+            # between adopt, respawn, and post-mortem.
+            app_id = fold.app_id or _discover_app(job.workdir)
+            if fold.pid and _pid_alive(fold.pid):
+                self.engine.force_grant(req, fold.hosts, fold.placement)
+                job.state = RUNNING
+                job.hosts = fold.hosts
+                job.placement = dict(fold.placement)
+                job.handle = _AdoptedHandle(fold.pid, self.history_root,
+                                            job)
+                log.info("fleet recover: adopted running job %s "
+                         "(client pid %d, app %s)", fold.job_id,
+                         fold.pid, app_id or "?")
+            elif app_id:
+                # The client is gone but the job got as far as an app
+                # dir: read its outcome from history (an unfinished
+                # app with a dead client is a crashed job).
+                job.app_id = app_id
+                handle = _AdoptedHandle(fold.pid or 1, self.history_root,
+                                        job)
+                status = handle._history_status()
+                exit_code = 0 if status == "SUCCEEDED" else 1
+                state = fjournal.STATE_FINISHED if exit_code == 0 \
+                    else fjournal.STATE_FAILED
+                self.journal.state(fold.job_id, state, app_id=app_id,
+                                   exit_code=exit_code)
+                job.state = state
+                job.exit_code = exit_code
+                log.info("fleet recover: job %s finished %s while the "
+                         "daemon was down", fold.job_id, state)
+            else:
+                # Granted (journaled write-ahead) but the spawn never
+                # produced an app: carry the grant out now — this is
+                # the zero-LOST-grants half of the recovery contract
+                # (the fgen record above licenses the re-grant).
+                self.engine.submit(req)
+                job.state = QUEUED
+                log.info("fleet recover: re-queued granted-but-never-"
+                         "started job %s", fold.job_id)
+
+    # -- lifecycle --------------------------------------------------------
+    def start(self) -> None:
+        self._started = True
+        self.rpc.start()
+        host, port = self.rpc.address
+        atomic_write(
+            os.path.join(self.fleet_dir, constants.FLEET_ADDR_FILE),
+            json.dumps({"host": host, "port": port, "token": self.token,
+                        "pid": os.getpid(),
+                        "generation": self.generation}).encode("utf-8"),
+            mode=0o600)
+        log.info("fleet daemon up at %s:%d (generation %d, %d slice(s) "
+                 "x %d hosts, quotas %s)", host, port, self.generation,
+                 self.slices, self.hosts_per_slice, self.quotas or "none")
+
+    def run(self) -> int:
+        self.start()
+        try:
+            while not self._stop_evt.wait(self.tick_s):
+                try:
+                    self.tick()
+                except Exception:  # noqa: BLE001 — the daemon must live
+                    log.exception("fleet tick failed")
+        finally:
+            self._shutdown()
+        return 0
+
+    def request_stop(self) -> None:
+        self._stop_evt.set()
+
+    def _shutdown(self) -> None:
+        # Running jobs are NOT killed: they belong to their tenants and
+        # their client/coordinator processes are independent session
+        # leaders — the same leave-leased-work-alone posture as the
+        # pool daemon's shutdown.
+        self._export()
+        try:
+            os.unlink(os.path.join(self.fleet_dir,
+                                   constants.FLEET_ADDR_FILE))
+        except OSError:
+            pass
+        if self._started:
+            # Stopping a never-serving TCP server deadlocks in
+            # shutdown(); unit tests drive tick() without start().
+            self.rpc.stop()
+        # Final name == in-progress name: the fleet stream is append-only
+        # across daemon lives, never finalized like a job's jhist.
+        self.events.stop(constants.FLEET_EVENTS_FILE)
+        self.journal.close()
+
+    def _count_event(self, ev: Event) -> None:
+        self.metrics.counter("tony_events_total",
+                             {"type": ev.type.value},
+                             help="job-history events emitted, "
+                                  "by type").inc()
+
+    # -- RPC behaviour ----------------------------------------------------
+    def submit(self, tenant: str, hosts: int, priority: int = 0,
+               min_hosts: int = 0, model: str = "",
+               conf: Optional[Dict[str, str]] = None) -> dict:
+        if not tenant:
+            return {"ok": False, "message": "submission needs a tenant"}
+        if hosts <= 0 or hosts > self.engine.pool.total:
+            return {"ok": False,
+                    "message": f"hosts must be 1..{self.engine.pool.total} "
+                               f"(the pool), got {hosts}"}
+        if min_hosts > hosts:
+            return {"ok": False,
+                    "message": f"min_hosts {min_hosts} > hosts {hosts}"}
+        quota = self.quotas.get(tenant, 0)
+        if quota > 0 and hosts > quota:
+            # Refuse outright rather than queue forever: this request
+            # can never be granted under the tenant's quota.
+            return {"ok": False,
+                    "message": f"{hosts} hosts exceeds tenant "
+                               f"{tenant!r}'s quota of {quota}"}
+        conf = {str(k): str(v) for k, v in (conf or {}).items()}
+        with self._lock:
+            self._seq += 1
+            seq = self._seq
+        job_id = f"fj-{seq:04d}"
+        req = JobRequest(job_id, tenant, priority=priority, hosts=hosts,
+                         min_hosts=min_hosts, model=model, seq=seq)
+        # Write-ahead of the ack: a submission the caller saw accepted
+        # must survive a daemon crash into the recovered queue.
+        self.journal.submit(job_id, tenant, priority, hosts, min_hosts,
+                            model, seq, conf)
+        job = _FleetJob(req, conf,
+                        os.path.join(self.fleet_dir, "jobs", job_id))
+        with self._lock:
+            self.jobs[job_id] = job
+            self.engine.submit(req)
+        self.events.emit(Event(EventType.FLEET_JOB_QUEUED, {
+            "job": job_id, "tenant": tenant, "priority": priority,
+            "hosts": hosts, "min_hosts": min_hosts, "model": model}))
+        log.info("fleet submit: %s tenant=%s priority=%d hosts=%d",
+                 job_id, tenant, priority, hosts)
+        return {"ok": True, "job": job_id, "state": QUEUED}
+
+    def cancel(self, job_id: str) -> dict:
+        with self._lock:
+            job = self.jobs.get(job_id)
+            if job is None:
+                return {"ok": False, "message": f"unknown job {job_id!r}"}
+            if job.state in fjournal.TERMINAL_STATES:
+                return {"ok": False,
+                        "message": f"{job_id} already {job.state}"}
+            was_queued = job.state == QUEUED
+            job.cancelled = True
+            if was_queued:
+                self.engine.withdraw(job_id)
+                job.state = fjournal.STATE_CANCELLED
+        if was_queued:
+            self.journal.state(job_id, fjournal.STATE_CANCELLED)
+            self._finish_event(job_id, fjournal.STATE_CANCELLED, None)
+            return {"ok": True, "state": fjournal.STATE_CANCELLED}
+        # Running: ask its coordinator to die; the poll loop records the
+        # exit as CANCELLED (job.cancelled wins over the exit code).
+        self.runner.kill(job.workdir)
+        return {"ok": True, "state": "CANCELLING"}
+
+    def status(self) -> dict:
+        from tony_tpu.coordinator.coordphases import histogram_quantile
+
+        with self._lock:
+            used = self.engine.tenant_used()
+            rows = []
+            now = time.monotonic()
+            for job in sorted(self.jobs.values(),
+                              key=lambda j: j.req.seq):
+                wait = job.wait_s if job.wait_s is not None else (
+                    now - job.submitted_mono
+                    if job.state == QUEUED else None)
+                rows.append({
+                    "job": job.req.job_id, "tenant": job.req.tenant,
+                    "priority": job.req.priority, "state": job.state,
+                    "hosts_requested": job.req.hosts,
+                    "hosts": job.hosts, "model": job.req.model,
+                    "app_id": job.app_id, "exit": job.exit_code,
+                    "wait_s": round(wait, 3) if wait is not None
+                    else None,
+                    "denial": job.denial})
+            queue_depth = self.engine.queue_depth
+            free = self.engine.pool.free_total
+        hist = self.metrics.histogram(
+            "tony_fleet_queue_wait_seconds",
+            help="submit-to-grant wait latency").snapshot()
+        total = self.slices * self.hosts_per_slice
+        return {
+            "fleet_dir": self.fleet_dir, "generation": self.generation,
+            "pool": {"slices": self.slices,
+                     "hosts_per_slice": self.hosts_per_slice,
+                     "total": total, "used": total - free, "free": free},
+            "tenants": {t: {"used": n,
+                            "quota": self.quotas.get(t, 0) or None}
+                        for t, n in sorted(used.items())},
+            "queue_depth": queue_depth,
+            "jobs": rows,
+            "queue_wait": {
+                "p50_s": round(histogram_quantile(hist, 0.5), 4),
+                "p99_s": round(histogram_quantile(hist, 0.99), 4),
+                "count": hist.get("count", 0)},
+        }
+
+    # -- the scheduler tick ----------------------------------------------
+    def tick(self) -> None:
+        self._poll_jobs()
+        self._discover_apps()
+        self._apply_plan()
+        self._restore()
+        self._export()
+
+    def _poll_jobs(self) -> None:
+        done: List[_FleetJob] = []
+        with self._lock:
+            candidates = [j for j in self.jobs.values()
+                          if j.handle is not None
+                          and j.state in (GRANTED, RUNNING)]
+        for job in candidates:
+            rc = self.runner.poll(job.handle)
+            if rc is None:
+                continue
+            if job.cancelled:
+                state = fjournal.STATE_CANCELLED
+            elif rc == 0:
+                state = fjournal.STATE_FINISHED
+            else:
+                state = fjournal.STATE_FAILED
+            self.journal.state(job.req.job_id, state,
+                               app_id=job.app_id, exit_code=int(rc))
+            with self._lock:
+                job.state = state
+                job.exit_code = int(rc)
+                job.handle = None
+                self.engine.release(job.req.job_id)
+            done.append(job)
+            self._finish_event(job.req.job_id, state, int(rc))
+        if done:
+            log.info("fleet: %d job(s) finished this tick (%s)",
+                     len(done), ", ".join(j.req.job_id for j in done))
+
+    def _finish_event(self, job_id: str, state: str,
+                      exit_code: Optional[int]) -> None:
+        job = self.jobs.get(job_id)
+        self.events.emit(Event(EventType.FLEET_JOB_FINISHED, {
+            "job": job_id, "state": state, "exit": exit_code,
+            "app_id": job.app_id if job else ""}))
+
+    def _discover_apps(self) -> None:
+        with self._lock:
+            pending = [j for j in self.jobs.values()
+                       if j.state == RUNNING and not j.app_id]
+        for job in pending:
+            app_id = _discover_app(job.workdir)
+            if app_id is None:
+                continue
+            self.journal.state(job.req.job_id, fjournal.STATE_RUNNING,
+                               app_id=app_id, pid=job.pid)
+            with self._lock:
+                job.app_id = app_id
+
+    def _apply_plan(self) -> None:
+        with self._lock:
+            plan = self.engine.schedule()
+        for d in plan:
+            if d.action == GRANT:
+                if not self._apply_grant(d.job_id, d.placement):
+                    return          # retry the rest next tick
+            elif d.action == SHRINK:
+                if not self._apply_preempt(d.job_id, d.hosts, d.for_job,
+                                           d.reason):
+                    return
+            elif d.action in (QUOTA_DENIED, CAPACITY_DENIED):
+                self._note_denial(d.job_id, d.action, d.reason)
+
+    def _note_denial(self, job_id: str, kind: str, reason: str) -> None:
+        with self._lock:
+            job = self.jobs.get(job_id)
+            if job is None:
+                return
+            first = job.denial != reason
+            job.denial = reason
+        if first and kind == QUOTA_DENIED:
+            self.metrics.counter(
+                "tony_fleet_quota_denials_total",
+                help="grants deferred by tenant quota").inc()
+            self.events.emit(Event(EventType.FLEET_QUOTA_DENIED, {
+                "job": job_id, "reason": reason}))
+
+    def _grant_overrides(self, job: _FleetJob) -> Dict[str, str]:
+        """The fleet's injections on a granted job's conf: granted gang
+        size, elastic preemptibility, the shared warm pool, the
+        per-model compile cache, and the fleet history root (one portal
+        over every tenant's jobs). The submission's own keys win where
+        they name the same knob explicitly."""
+        ov = dict(job.conf)
+        ov["tony.worker.instances"] = str(job.hosts)
+        if 0 < job.req.min_hosts < job.req.hosts:
+            ov.setdefault(K.ELASTIC_ENABLED, "true")
+            ov.setdefault(K.ELASTIC_MIN_TASKS, str(job.req.min_hosts))
+        if self.pool_dir:
+            ov.setdefault(K.POOL_DIR, self.pool_dir)
+        if self.cache_root and job.req.model:
+            ov.setdefault(K.JAX_COMPILE_CACHE_DIR,
+                          os.path.join(self.cache_root, job.req.model))
+        ov.setdefault(K.HISTORY_LOCATION, self.history_root)
+        return ov
+
+    def _apply_grant(self, job_id: str,
+                     placement: Dict[int, int]) -> bool:
+        try:
+            faults.check("fleet.grant")
+        except faults.InjectedFault as e:
+            # The job stays QUEUED (nothing journaled, nothing
+            # accounted) and the next tick retries — a grant failure
+            # must never lose a submission.
+            log.warning("fleet grant of %s failed (%s); job stays "
+                        "queued", job_id, e)
+            return False
+        with self._lock:
+            job = self.jobs.get(job_id)
+            if job is None or job.state != QUEUED:
+                return True         # cancelled mid-plan: skip
+        hosts = sum(placement.values())
+        # Write-ahead: the grant record lands before the spawn, so a
+        # crash in between recovers into "re-carry the grant out", never
+        # a lost grant.
+        self.journal.grant(job_id, hosts, placement)
+        with self._lock:
+            try:
+                self.engine.grant(job_id, placement)
+            except KeyError:
+                return True         # withdrawn between plan and apply
+            job.state = GRANTED
+            job.hosts = hosts
+            job.placement = dict(placement)
+            job.wait_s = time.monotonic() - job.submitted_mono
+            job.denial = ""
+        try:
+            popen = self.runner.spawn(job.workdir,
+                                      self._grant_overrides(job))
+        except OSError as e:
+            log.error("fleet: spawn of %s failed: %s", job_id, e)
+            self.journal.state(job_id, fjournal.STATE_FAILED,
+                               exit_code=1)
+            with self._lock:
+                job.state = fjournal.STATE_FAILED
+                job.exit_code = 1
+                self.engine.release(job_id)
+            self._finish_event(job_id, fjournal.STATE_FAILED, 1)
+            return True
+        self.journal.state(job_id, fjournal.STATE_SPAWNED,
+                           pid=popen.pid)
+        with self._lock:
+            job.handle = popen
+            job.pid = popen.pid
+            job.state = RUNNING
+        self.metrics.counter("tony_fleet_grants_total",
+                             help="job grants applied").inc()
+        self.metrics.histogram(
+            "tony_fleet_queue_wait_seconds",
+            help="submit-to-grant wait latency").observe(job.wait_s)
+        self.events.emit(Event(EventType.FLEET_JOB_GRANTED, {
+            "job": job_id, "tenant": job.req.tenant, "hosts": hosts,
+            "placement": {str(i): n for i, n in placement.items()},
+            "wait_s": round(job.wait_s, 3)}))
+        log.info("fleet grant: %s -> %d host(s) on slice(s) %s "
+                 "(waited %.2fs)", job_id, hosts,
+                 sorted(placement), job.wait_s)
+        return True
+
+    def _apply_preempt(self, victim_id: str, to_hosts: int,
+                       for_job: str, reason: str) -> bool:
+        try:
+            faults.check("fleet.preempt")
+        except faults.InjectedFault as e:
+            log.warning("fleet preempt of %s failed (%s); retried next "
+                        "tick", victim_id, e)
+            return False
+        with self._lock:
+            victim = self.jobs.get(victim_id)
+            if victim is None or victim.state != RUNNING:
+                return True
+            from_hosts = victim.hosts
+        # The victim shrinks through its own elastic machinery
+        # (drain→remesh→barrier — coordinator/elastic.py): the epoch
+        # survives, nothing is killed. The resize lands first, then the
+        # accounting: a crash between the two under-frees for one
+        # recovery (grow-back reconciles) rather than double-booking.
+        if not self.runner.resize(victim.workdir, to_hosts):
+            log.warning("fleet preempt: %s resize to %d refused/"
+                        "unreachable; retried next tick", victim_id,
+                        to_hosts)
+            return False
+        with self._lock:
+            new_placement = self.engine.shrink_applied(victim_id,
+                                                       to_hosts)
+            victim.hosts = to_hosts
+            victim.placement = new_placement
+        self.journal.preempt(victim_id, from_hosts, to_hosts, for_job,
+                             new_placement)
+        self.metrics.counter(
+            "tony_fleet_preemptions_total",
+            help="preempt-to-reclaim shrinks applied").inc()
+        self.events.emit(Event(EventType.FLEET_JOB_PREEMPTED, {
+            "job": victim_id, "from": from_hosts, "to": to_hosts,
+            "for": for_job, "reason": reason}))
+        log.warning("fleet preempt: %s shrunk %d->%d host(s) for %s",
+                    victim_id, from_hosts, to_hosts, for_job)
+        return True
+
+    def _restore(self) -> None:
+        """Grow shrunk victims back toward their requested size once the
+        queue has drained — preemption is a loan. The grow rides the
+        same elastic resize path (and, with a warm pool configured, the
+        fresh members adopt pre-warmed executors — the ≤2s regrow)."""
+        with self._lock:
+            candidates = self.engine.restore_candidates()
+        for job_id, new_hosts, delta in candidates:
+            with self._lock:
+                job = self.jobs.get(job_id)
+                if job is None or job.state != RUNNING:
+                    continue
+            if not self.runner.resize(job.workdir, new_hosts):
+                continue
+            with self._lock:
+                placement = self.engine.grow_applied(job_id, delta)
+                job.hosts = new_hosts
+                job.placement = placement
+            self.journal.state(job_id, fjournal.STATE_RESTORED,
+                               hosts=new_hosts, placement=placement)
+            log.info("fleet restore: %s grown back to %d host(s)",
+                     job_id, new_hosts)
+
+    # -- surfaces ---------------------------------------------------------
+    def _export(self) -> None:
+        snap = self.status()
+        pool = snap["pool"]
+        for state in ("total", "used", "free"):
+            self.metrics.gauge("tony_fleet_hosts", {"state": state},
+                               help="pool hosts by state").set(
+                pool[state])
+        by_state = {s: 0 for s in (QUEUED, GRANTED, RUNNING)
+                    + fjournal.TERMINAL_STATES}
+        for row in snap["jobs"]:
+            by_state[row["state"]] = by_state.get(row["state"], 0) + 1
+        for state, n in by_state.items():
+            # Zero-filled over the full state set so a drained queue
+            # reads as 0, not as a frozen last value.
+            self.metrics.gauge("tony_fleet_jobs", {"state": state},
+                               help="fleet jobs by state").set(n)
+        self.metrics.gauge("tony_fleet_queue_depth",
+                           help="submissions waiting for a grant").set(
+            snap["queue_depth"])
+        for tenant, row in snap["tenants"].items():
+            self.metrics.gauge("tony_fleet_tenant_hosts",
+                               {"tenant": tenant},
+                               help="granted hosts per tenant").set(
+                row["used"])
+        atomic_write(
+            os.path.join(self.fleet_dir, constants.FLEET_PROM_FILE),
+            self.metrics.render().encode("utf-8"))
+        atomic_write(
+            os.path.join(self.fleet_dir, constants.FLEET_STATUS_FILE),
+            json.dumps(snap, sort_keys=True).encode("utf-8"))
+        self.metrics.save_counters(self._counters_path)

@@ -1,0 +1,267 @@
+"""Write-ahead fleet journal: the scheduler's crash-survivable memory.
+
+Same discipline as the per-job session journal (``coordinator/
+journal.py`` — whose module docstring is the contract's full statement):
+every scheduler state transition — submission, grant, preemption, job
+state change, daemon generation bump — is appended as one JSON line and
+fsync'd BEFORE the transition is acted on, so a SIGKILLed daemon
+restarted with ``tony-tpu fleet start --recover`` replays into the SAME
+queue state with zero duplicated or lost grants. Torn/undecodable tails
+replay as the prefix (write-ahead means the lost record was never acted
+on). Record types are ``REC_FLEET_*`` constants (never string literals)
+so the tonylint ``journal-parity`` rule checks both halves — every type
+appended somewhere, every type replayed — exactly as it does for the
+session journal.
+
+The ``fgen`` record additionally carries the pool shape (slices ×
+hosts-per-slice): ``tony-tpu check`` uses it to assert that granted
+hosts never exceed the pool at any point in the journal's history
+(devtools/invariants.py ``fleet-capacity``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import logging
+import os
+import time
+from typing import Any, Dict, List, Optional
+
+from tony_tpu.utils.durable import AppendLog
+
+log = logging.getLogger(__name__)
+
+#: record types (the "t" field) — globally unique against the session
+#: journal's REC_* values so the parity rule can match writers by name.
+REC_FLEET_GEN = "fgen"          # daemon (re)start: generation + pool shape
+REC_FLEET_SUBMIT = "fsubmit"    # a submission entered the queue
+REC_FLEET_GRANT = "fgrant"      # capacity granted (write-ahead of spawn)
+REC_FLEET_PREEMPT = "fpreempt"  # victim shrunk to reclaim hosts
+REC_FLEET_STATE = "fstate"      # job state transition (spawned/running/...)
+
+#: job states the fstate record carries (QUEUED/GRANTED are implied by
+#: fsubmit/fgrant; these are the post-grant lifecycle).
+STATE_SPAWNED = "SPAWNED"       # client subprocess forked (pid recorded)
+STATE_RUNNING = "RUNNING"       # app dir discovered (app_id recorded)
+STATE_RESTORED = "RESTORED"     # grow-back resize landed (hosts recorded)
+STATE_FINISHED = "FINISHED"
+STATE_FAILED = "FAILED"
+STATE_CANCELLED = "CANCELLED"
+TERMINAL_STATES = (STATE_FINISHED, STATE_FAILED, STATE_CANCELLED)
+
+
+class FleetJournalError(RuntimeError):
+    pass
+
+
+@dataclasses.dataclass
+class JobFold:
+    """Folded per-job state."""
+
+    job_id: str = ""
+    tenant: str = ""
+    priority: int = 0
+    hosts_requested: int = 0
+    min_hosts: int = 0
+    model: str = ""
+    seq: int = 0
+    conf: Dict[str, str] = dataclasses.field(default_factory=dict)
+    state: str = "QUEUED"
+    hosts: int = 0                 # currently granted
+    placement: Dict[int, int] = dataclasses.field(default_factory=dict)
+    app_id: str = ""
+    pid: int = 0
+    exit_code: Optional[int] = None
+
+
+@dataclasses.dataclass
+class FleetReplayState:
+    """What a recovering daemon reconstructs from the journal."""
+
+    generation: int = 0
+    slices: int = 0
+    hosts_per_slice: int = 0
+    seq: int = 0                   # highest submission sequence seen
+    jobs: Dict[str, JobFold] = dataclasses.field(default_factory=dict)
+    records: int = 0
+    torn_tail: bool = False
+
+
+class FleetJournal:
+    """Append side. Appends are serialized by an I/O lock (the lock
+    exists solely to keep the fsync'd record order equal to the decision
+    order — submit handlers and the scheduler tick both append)."""
+
+    def __init__(self, path: str, enabled: bool = True) -> None:
+        from tony_tpu.devtools.sanitizer import io_lock
+
+        self.path = path
+        self.enabled = enabled
+        self._log: Optional[AppendLog] = AppendLog(path) if enabled else None
+        self._lock = io_lock()
+
+    def append(self, record: Dict[str, Any]) -> None:
+        if self._log is None:
+            return
+        record.setdefault("ts", int(time.time() * 1000))
+        data = (json.dumps(record, sort_keys=True) + "\n").encode("utf-8")
+        with self._lock:
+            self._log.append(data)
+
+    # -- typed appenders --------------------------------------------------
+    def generation(self, generation: int, slices: int,
+                   hosts_per_slice: int) -> None:
+        self.append({"t": REC_FLEET_GEN, "generation": int(generation),
+                     "slices": int(slices),
+                     "hosts_per_slice": int(hosts_per_slice)})
+
+    def submit(self, job_id: str, tenant: str, priority: int, hosts: int,
+               min_hosts: int, model: str, seq: int,
+               conf: Dict[str, str]) -> None:
+        self.append({"t": REC_FLEET_SUBMIT, "job": job_id,
+                     "tenant": tenant, "priority": int(priority),
+                     "hosts": int(hosts), "min_hosts": int(min_hosts),
+                     "model": model, "seq": int(seq),
+                     "conf": dict(conf)})
+
+    def grant(self, job_id: str, hosts: int,
+              placement: Dict[int, int]) -> None:
+        self.append({"t": REC_FLEET_GRANT, "job": job_id,
+                     "hosts": int(hosts),
+                     "placement": {str(i): int(n)
+                                   for i, n in placement.items()}})
+
+    def preempt(self, job_id: str, from_hosts: int, to_hosts: int,
+                for_job: str, placement: Dict[int, int]) -> None:
+        """Write-ahead of the victim's shrink: the post-shrink placement
+        is journaled so replay re-accounts the pool exactly."""
+        self.append({"t": REC_FLEET_PREEMPT, "job": job_id,
+                     "from": int(from_hosts), "to": int(to_hosts),
+                     "for": for_job,
+                     "placement": {str(i): int(n)
+                                   for i, n in placement.items()}})
+
+    def state(self, job_id: str, state: str, app_id: str = "",
+              pid: int = 0, exit_code: Optional[int] = None,
+              hosts: int = 0,
+              placement: Optional[Dict[int, int]] = None) -> None:
+        rec: Dict[str, Any] = {"t": REC_FLEET_STATE, "job": job_id,
+                               "state": state}
+        if app_id:
+            rec["app_id"] = app_id
+        if pid:
+            rec["pid"] = int(pid)
+        if exit_code is not None:
+            rec["exit"] = int(exit_code)
+        if hosts:
+            rec["hosts"] = int(hosts)
+        if placement is not None:
+            rec["placement"] = {str(i): int(n)
+                                for i, n in placement.items()}
+        self.append(rec)
+
+    def close(self) -> None:
+        if self._log is not None:
+            self._log.close()
+            self._log = None
+
+
+def _placement(rec: Dict[str, Any]) -> Dict[int, int]:
+    out: Dict[int, int] = {}
+    for k, v in (rec.get("placement") or {}).items():
+        try:
+            out[int(k)] = int(v)
+        except (TypeError, ValueError):
+            continue
+    return out
+
+
+def replay(path: str) -> FleetReplayState:
+    """Fold the fleet journal into a FleetReplayState (same torn-tail
+    posture as the session journal's replay: decode in order, stop at
+    the first bad line, the prefix is the truth)."""
+    if not os.path.exists(path):
+        raise FleetJournalError(
+            f"no fleet journal at {path} — this directory never ran a "
+            f"fleet daemon, or the wrong --dir was given")
+    from tony_tpu.coordinator.journal import _iter_complete_lines
+
+    state = FleetReplayState()
+    lines, torn = _iter_complete_lines(path)
+    state.torn_tail = bool(torn)
+    for raw in lines:
+        raw = raw.strip()
+        if not raw:
+            continue
+        try:
+            rec = json.loads(raw.decode("utf-8"))
+            if not isinstance(rec, dict):
+                raise ValueError("record is not an object")
+        except (ValueError, UnicodeDecodeError) as e:
+            log.warning("fleet journal %s: undecodable record after %d "
+                        "good ones (%s) — replaying the prefix", path,
+                        state.records, e)
+            state.torn_tail = True
+            break
+        state.records += 1
+        t = rec.get("t")
+        if t == REC_FLEET_GEN:
+            state.generation = max(state.generation,
+                                   int(rec.get("generation", 0) or 0))
+            state.slices = int(rec.get("slices", 0) or 0)
+            state.hosts_per_slice = int(
+                rec.get("hosts_per_slice", 0) or 0)
+        elif t == REC_FLEET_SUBMIT:
+            job = str(rec.get("job", "") or "")
+            seq = int(rec.get("seq", 0) or 0)
+            state.seq = max(state.seq, seq)
+            state.jobs[job] = JobFold(
+                job_id=job, tenant=str(rec.get("tenant", "") or ""),
+                priority=int(rec.get("priority", 0) or 0),
+                hosts_requested=int(rec.get("hosts", 0) or 0),
+                min_hosts=int(rec.get("min_hosts", 0) or 0),
+                model=str(rec.get("model", "") or ""), seq=seq,
+                conf={str(k): str(v)
+                      for k, v in (rec.get("conf") or {}).items()})
+        elif t == REC_FLEET_GRANT:
+            fold = state.jobs.get(str(rec.get("job", "") or ""))
+            if fold is None:
+                continue           # unknown job: invariants flag it
+            fold.state = "GRANTED"
+            fold.hosts = int(rec.get("hosts", 0) or 0)
+            fold.placement = _placement(rec)
+        elif t == REC_FLEET_PREEMPT:
+            fold = state.jobs.get(str(rec.get("job", "") or ""))
+            if fold is None:
+                continue
+            fold.hosts = int(rec.get("to", fold.hosts) or 0)
+            fold.placement = _placement(rec)
+        elif t == REC_FLEET_STATE:
+            fold = state.jobs.get(str(rec.get("job", "") or ""))
+            if fold is None:
+                continue
+            st = str(rec.get("state", "") or "")
+            fold.state = st
+            if rec.get("app_id"):
+                fold.app_id = str(rec["app_id"])
+            if rec.get("pid"):
+                fold.pid = int(rec["pid"])
+            if "exit" in rec:
+                fold.exit_code = int(rec["exit"])
+            if st == STATE_RESTORED:
+                fold.hosts = int(rec.get("hosts", fold.hosts) or 0)
+                if rec.get("placement") is not None:
+                    fold.placement = _placement(rec)
+                fold.state = STATE_RUNNING
+        else:
+            log.warning("fleet journal %s: unknown record type %r "
+                        "skipped", path, t)
+    return state
+
+
+def queued_folds(state: FleetReplayState) -> List[JobFold]:
+    """Still-queued jobs in original submission order (the queue a
+    recovered daemon re-enqueues)."""
+    return sorted((f for f in state.jobs.values() if f.state == "QUEUED"),
+                  key=lambda f: f.seq)

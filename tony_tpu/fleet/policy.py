@@ -1,0 +1,422 @@
+"""Fleet policy engine: who runs, where, and at whose expense.
+
+The YARN ResourceManager decided this for the reference (CapacityScheduler
+queues + container preemption); here the decision logic is one small,
+deterministic, stdlib-only module so it can be unit-tested exhaustively
+and smoke-run in the no-deps CI lint job (``python -m
+tony_tpu.fleet.policy``). The daemon (``fleet/daemon.py``) owns every
+side effect — journal records, spawns, resize RPCs — and calls in here
+only to decide and to account.
+
+Model:
+
+- The pool is ``slices × hosts_per_slice`` hosts. A **sub-slice** job
+  (fewer hosts than a slice) must land in ONE slice — a gang wants ICI
+  locality — and slices are shared, best-fit, between sub-slice jobs.
+  A larger job takes whole free slices plus a best-fit remainder.
+- **Priority** orders the queue (higher first), submission sequence
+  breaks ties (FIFO within a priority band).
+- **Quotas** cap a tenant's granted hosts. A quota-denied submission
+  stays queued and is SKIPPED — it never blocks other tenants' grants
+  (no head-of-line quota starvation).
+- A **capacity-denied** job at the head of the queue holds the line:
+  nothing behind it is granted this pass (strict priority — backfill
+  behind a starving large job is how large jobs starve forever), but
+  quota-denials never hold.
+- **Preempt-to-reclaim**: when the head job cannot fit, victims are
+  chosen among strictly-lower-priority running jobs that declared a
+  shrink floor (``min_hosts``), lowest priority first, youngest first
+  within a priority, each shrunk only as far as needed and never below
+  its floor. The plan reserves the reclaimed hosts for the demander;
+  the daemon applies the shrinks through the victims' elastic resize
+  (drain→remesh — no victim epoch burned) and the grant lands on a
+  later pass once the hosts are free.
+- **Grow-back**: with the queue drained and hosts free, previously
+  shrunk jobs are restored toward their requested size, highest
+  priority first — preemption is a loan, not a confiscation.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Optional, Tuple
+
+#: decision kinds (Decision.action)
+GRANT = "grant"
+SHRINK = "shrink"          # preempt-to-reclaim: victim shrinks via resize
+QUOTA_DENIED = "quota"     # tenant at quota: stays queued, never holds
+CAPACITY_DENIED = "capacity"  # pool full and nothing preemptible: holds
+
+
+@dataclasses.dataclass(frozen=True)
+class JobRequest:
+    """One submission as the policy engine sees it. ``min_hosts`` > 0
+    marks the job elastic-shrinkable (a preemption victim candidate and
+    a grow-back beneficiary); 0 means never preempt it."""
+
+    job_id: str
+    tenant: str
+    priority: int = 0
+    hosts: int = 1
+    min_hosts: int = 0
+    model: str = ""
+    seq: int = 0
+
+
+@dataclasses.dataclass
+class Decision:
+    """One step of a scheduling plan, applied in order by the daemon."""
+
+    action: str
+    job_id: str
+    hosts: int = 0                       # grant size / shrink target
+    placement: Dict[int, int] = dataclasses.field(default_factory=dict)
+    reason: str = ""
+    for_job: str = ""                    # SHRINK: the demanding job
+
+
+@dataclasses.dataclass
+class _Running:
+    req: JobRequest
+    hosts: int
+    placement: Dict[int, int]
+
+
+class SlicePool:
+    """Host accounting over ``slices`` slices of ``hosts_per_slice``."""
+
+    def __init__(self, slices: int, hosts_per_slice: int) -> None:
+        self.slices = max(1, int(slices))
+        self.hosts_per_slice = max(1, int(hosts_per_slice))
+        self._free: List[int] = [self.hosts_per_slice] * self.slices
+
+    @property
+    def total(self) -> int:
+        return self.slices * self.hosts_per_slice
+
+    @property
+    def free_total(self) -> int:
+        return sum(self._free)
+
+    def clone(self) -> "SlicePool":
+        c = SlicePool(self.slices, self.hosts_per_slice)
+        c._free = list(self._free)
+        return c
+
+    def place(self, hosts: int) -> Optional[Dict[int, int]]:
+        """Placement for a gang of ``hosts``, or None when it cannot be
+        packed. Sub-slice gangs go best-fit into ONE slice (tightest
+        fitting slice — leaves big holes big); larger gangs take whole
+        free slices first, then a best-fit remainder. Deterministic:
+        ties break on the lowest slice index."""
+        hosts = int(hosts)
+        if hosts <= 0 or hosts > self.free_total:
+            return None
+        hps = self.hosts_per_slice
+        if hosts < hps:
+            best: Optional[int] = None
+            for i, free in enumerate(self._free):
+                if free >= hosts and (best is None
+                                      or free < self._free[best]):
+                    best = i
+            return None if best is None else {best: hosts}
+        placement: Dict[int, int] = {}
+        remaining = hosts
+        for i, free in enumerate(self._free):
+            if remaining < hps:
+                break
+            if free == hps:
+                placement[i] = hps
+                remaining -= hps
+        if remaining > 0:
+            best = None
+            for i, free in enumerate(self._free):
+                if i in placement:
+                    continue
+                if free >= remaining and (best is None
+                                          or free < self._free[best]):
+                    best = i
+            if best is None:
+                return None
+            placement[best] = remaining
+        return placement
+
+    def allocate(self, placement: Dict[int, int]) -> None:
+        for i, n in placement.items():
+            if self._free[i] < n:
+                raise ValueError(
+                    f"slice {i} has {self._free[i]} free, need {n}")
+            self._free[i] -= n
+
+    def release(self, placement: Dict[int, int]) -> None:
+        for i, n in placement.items():
+            self._free[i] = min(self.hosts_per_slice, self._free[i] + n)
+
+    def shrink(self, placement: Dict[int, int],
+               by: int) -> Dict[int, int]:
+        """Free ``by`` hosts from ``placement``, CONCENTRATED: each
+        host comes off the placement slice already closest to free
+        (ties → lowest index), so shrinks vacate whole slices instead
+        of fragmenting one hole per slice — a waiting gang needs
+        contiguous slice capacity, not a scattered host count. Mutates
+        and returns the placement; the preemption planner relies on
+        plan-time and apply-time shrinks freeing the SAME slices."""
+        for _ in range(int(by)):
+            if not placement:
+                break
+            best = min(sorted(placement), key=lambda i: -self._free[i])
+            placement[best] -= 1
+            self._free[best] = min(self.hosts_per_slice,
+                                   self._free[best] + 1)
+            if placement[best] == 0:
+                del placement[best]
+        return placement
+
+
+class PolicyEngine:
+    """Queue + accounting state; ``schedule()`` computes a plan, the
+    mutators apply what the daemon actually carried out (write-ahead:
+    the daemon journals each step before calling its mutator)."""
+
+    def __init__(self, slices: int, hosts_per_slice: int,
+                 quotas: Optional[Dict[str, int]] = None) -> None:
+        self.pool = SlicePool(slices, hosts_per_slice)
+        self.quotas: Dict[str, int] = dict(quotas or {})
+        self._queued: Dict[str, JobRequest] = {}
+        self._running: Dict[str, _Running] = {}
+
+    # -- queries ---------------------------------------------------------
+    @property
+    def queue_depth(self) -> int:
+        return len(self._queued)
+
+    def queued_order(self) -> List[JobRequest]:
+        return sorted(self._queued.values(),
+                      key=lambda r: (-r.priority, r.seq))
+
+    def running(self, job_id: str) -> Optional[Tuple[int, Dict[int, int]]]:
+        r = self._running.get(job_id)
+        return (r.hosts, dict(r.placement)) if r is not None else None
+
+    def tenant_used(self) -> Dict[str, int]:
+        used: Dict[str, int] = {}
+        for r in self._running.values():
+            used[r.req.tenant] = used.get(r.req.tenant, 0) + r.hosts
+        return used
+
+    # -- lifecycle mutators (the daemon journals, then calls these) ------
+    def submit(self, req: JobRequest) -> None:
+        if req.job_id in self._queued or req.job_id in self._running:
+            raise ValueError(f"job {req.job_id!r} already known")
+        if req.hosts > self.pool.total:
+            raise ValueError(
+                f"job {req.job_id!r} wants {req.hosts} hosts; the pool "
+                f"only has {self.pool.total}")
+        self._queued[req.job_id] = req
+
+    def withdraw(self, job_id: str) -> bool:
+        """Cancel a still-queued submission."""
+        return self._queued.pop(job_id, None) is not None
+
+    def grant(self, job_id: str, placement: Dict[int, int]) -> None:
+        req = self._queued.pop(job_id)
+        self.pool.allocate(placement)
+        self._running[job_id] = _Running(req, sum(placement.values()),
+                                         dict(placement))
+
+    def force_grant(self, req: JobRequest, hosts: int,
+                    placement: Dict[int, int]) -> None:
+        """Recovery path: re-account a job the journal says is running
+        (no queue transit, placement replayed verbatim)."""
+        self.pool.allocate(placement)
+        self._queued.pop(req.job_id, None)
+        self._running[req.job_id] = _Running(req, hosts, dict(placement))
+
+    def shrink_applied(self, job_id: str, to_hosts: int) -> Dict[int, int]:
+        """A preemption shrink (or any downward resize) landed: free the
+        difference and return the new placement."""
+        r = self._running[job_id]
+        by = r.hosts - int(to_hosts)
+        if by > 0:
+            self.pool.shrink(r.placement, by)
+            r.hosts = int(to_hosts)
+        return dict(r.placement)
+
+    def grow_applied(self, job_id: str,
+                     placement_delta: Dict[int, int]) -> Dict[int, int]:
+        """A grow-back resize landed: account the extra hosts."""
+        r = self._running[job_id]
+        self.pool.allocate(placement_delta)
+        for i, n in placement_delta.items():
+            r.placement[i] = r.placement.get(i, 0) + n
+        r.hosts += sum(placement_delta.values())
+        return dict(r.placement)
+
+    def release(self, job_id: str) -> None:
+        """Terminal job: free everything it held."""
+        r = self._running.pop(job_id, None)
+        if r is not None:
+            self.pool.release(r.placement)
+        else:
+            self._queued.pop(job_id, None)
+
+    # -- the scheduling pass ---------------------------------------------
+    def schedule(self) -> List[Decision]:
+        """One scheduling pass over the queue (pure: mutates nothing —
+        the daemon applies each Decision write-ahead and calls the
+        mutators above for the ones that actually happened)."""
+        plan: List[Decision] = []
+        tentative = self.pool.clone()
+        used = self.tenant_used()
+        for req in self.queued_order():
+            quota = self.quotas.get(req.tenant, 0)
+            if quota > 0 and used.get(req.tenant, 0) + req.hosts > quota:
+                plan.append(Decision(
+                    QUOTA_DENIED, req.job_id, hosts=req.hosts,
+                    reason=f"tenant {req.tenant!r} at quota "
+                           f"({used.get(req.tenant, 0)}/{quota} hosts)"))
+                continue            # quota never blocks other tenants
+            placement = tentative.place(req.hosts)
+            if placement is not None:
+                tentative.allocate(placement)
+                used[req.tenant] = used.get(req.tenant, 0) + req.hosts
+                plan.append(Decision(GRANT, req.job_id, hosts=req.hosts,
+                                     placement=placement))
+                continue
+            shrinks = self._plan_preemption(req, tentative)
+            if shrinks:
+                plan.extend(shrinks)
+            else:
+                plan.append(Decision(
+                    CAPACITY_DENIED, req.job_id, hosts=req.hosts,
+                    reason=f"{req.hosts} hosts do not fit "
+                           f"({tentative.free_total} free) and no "
+                           f"lower-priority elastic capacity exists"))
+            # Head-of-line hold: the reclaimed (or awaited) hosts belong
+            # to THIS job; granting anything behind it would re-consume
+            # them and starve the large/high-priority job forever.
+            break
+        return plan
+
+    def _plan_preemption(self, req: JobRequest,
+                         tentative: SlicePool) -> List[Decision]:
+        """Shrink plan reclaiming enough PACKABLE capacity for ``req``
+        from strictly lower-priority elastic jobs, or [] when
+        impossible. Victim order: lowest priority first, then youngest
+        (highest seq) — the job that has run longest is disturbed last.
+        Placement-aware: each victim is shrunk one host at a time until
+        the demander actually places (quantity alone is not enough — 3
+        free hosts on one slice plus 2 on another never fit a 4-host
+        gang), so victims are disturbed minimally and a geometrically
+        unsatisfiable demand preempts nobody."""
+        victims = sorted(
+            (r for r in self._running.values()
+             if r.req.priority < req.priority
+             and r.req.min_hosts > 0 and r.hosts > r.req.min_hosts),
+            key=lambda r: (r.req.priority, -r.req.seq))
+        shrinks: List[Decision] = []
+        for v in victims:
+            if tentative.place(req.hosts) is not None:
+                break
+            placement = dict(v.placement)
+            to = v.hosts
+            while to > v.req.min_hosts \
+                    and tentative.place(req.hosts) is None:
+                tentative.shrink(placement, 1)
+                to -= 1
+            if to < v.hosts:
+                shrinks.append(Decision(
+                    SHRINK, v.req.job_id, hosts=to,
+                    for_job=req.job_id,
+                    reason=f"reclaim {v.hosts - to} host(s) for "
+                           f"{req.job_id!r} (priority {req.priority} > "
+                           f"{v.req.priority})"))
+        # tentative stays mutated on failure too — harmless: schedule()
+        # holds the head of the line right after this either way.
+        return shrinks if tentative.place(req.hosts) is not None else []
+
+    def restore_candidates(self) -> List[Tuple[str, int, Dict[int, int]]]:
+        """Grow-back plan: with an empty queue and free hosts, restore
+        shrunk jobs toward their requested size, highest priority
+        first. Returns (job_id, new_total_hosts, placement_delta)."""
+        if self._queued:
+            return []               # reclaimed space belongs to the queue
+        out: List[Tuple[str, int, Dict[int, int]]] = []
+        tentative = self.pool.clone()
+        for r in sorted(self._running.values(),
+                        key=lambda r: (-r.req.priority, r.req.seq)):
+            want = r.req.hosts - r.hosts
+            if want <= 0:
+                continue
+            grow = min(want, tentative.free_total)
+            if grow <= 0:
+                continue
+            delta = tentative.place(grow)
+            if delta is None:
+                continue
+            tentative.allocate(delta)
+            out.append((r.req.job_id, r.hosts + grow, delta))
+        return out
+
+
+def parse_quotas(spec: str) -> Dict[str, int]:
+    """'teamA=8,teamB=4' → {'teamA': 8, 'teamB': 4} (the
+    tony.fleet.quotas grammar; blank entries skipped, bad ones raise)."""
+    out: Dict[str, int] = {}
+    for part in (spec or "").split(","):
+        part = part.strip()
+        if not part:
+            continue
+        tenant, sep, hosts = part.partition("=")
+        if not sep:
+            raise ValueError(
+                f"bad quota entry {part!r} (need tenant=hosts)")
+        out[tenant.strip()] = int(hosts)
+    return out
+
+
+def _self_check() -> None:
+    """Deterministic scenario asserting the four policy behaviours —
+    the no-deps CI smoke (``python -m tony_tpu.fleet.policy``)."""
+    eng = PolicyEngine(2, 4, quotas={"capped": 2})
+    # Bin-pack: two sub-slice jobs share one slice (best-fit).
+    eng.submit(JobRequest("a", "t1", hosts=2, seq=1))
+    eng.submit(JobRequest("b", "t1", hosts=2, seq=2))
+    plan = eng.schedule()
+    assert [d.action for d in plan] == [GRANT, GRANT], plan
+    assert plan[0].placement == {0: 2} and plan[1].placement == {0: 2}
+    for d in plan:
+        eng.grant(d.job_id, d.placement)
+    # Quota: the capped tenant queues WITHOUT blocking others.
+    eng.submit(JobRequest("q", "capped", hosts=4, seq=3))
+    eng.submit(JobRequest("c", "t2", hosts=4, seq=4))
+    plan = eng.schedule()
+    assert [(d.action, d.job_id) for d in plan] == [
+        (QUOTA_DENIED, "q"), (GRANT, "c")], plan
+    eng.grant("c", plan[1].placement)
+    # Priority + preempt-to-reclaim: a priority-10 job arrives into a
+    # full pool; with no declared floors nothing is preemptible...
+    eng._queued.pop("q")
+    eng.submit(JobRequest("hi", "t3", priority=10, hosts=3, seq=5))
+    plan = eng.schedule()
+    assert [d.action for d in plan] == [CAPACITY_DENIED], plan
+    # ...but once the lower-priority job declares a shrink floor, the
+    # plan reclaims exactly what the demander needs via elastic shrink.
+    eng._running["c"].req = dataclasses.replace(
+        eng._running["c"].req, min_hosts=1)
+    plan = eng.schedule()
+    assert [d.action for d in plan] == [SHRINK], plan
+    assert plan[0].job_id == "c" and plan[0].hosts == 1
+    eng.shrink_applied("c", plan[0].hosts)
+    plan = eng.schedule()
+    assert [(d.action, d.job_id) for d in plan] == [(GRANT, "hi")], plan
+    eng.grant("hi", plan[0].placement)
+    # Grow-back: the demander leaves, the victim is restored.
+    eng.release("hi")
+    restores = eng.restore_candidates()
+    assert restores and restores[0][0] == "c" and restores[0][1] == 4
+    print("fleet policy self-check OK")
+
+
+if __name__ == "__main__":
+    _self_check()

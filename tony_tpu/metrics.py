@@ -71,6 +71,15 @@ SERIES: Dict[str, str] = {
     "tony_rpc_client_seconds": "executor-side RPC call latency",
     "tony_rpc_requests_total": "RPC requests dispatched",
     "tony_events_total": "job-history events emitted, by type",
+    # -- fleet: multi-job gang scheduler (tony_tpu/fleet/daemon.py) ------
+    "tony_fleet_hosts": "pool hosts by state (total/used/free)",
+    "tony_fleet_jobs": "fleet jobs by state",
+    "tony_fleet_queue_depth": "submissions waiting for a grant",
+    "tony_fleet_tenant_hosts": "granted hosts per tenant",
+    "tony_fleet_grants_total": "job grants applied",
+    "tony_fleet_preemptions_total": "preempt-to-reclaim shrinks applied",
+    "tony_fleet_quota_denials_total": "grants deferred by tenant quota",
+    "tony_fleet_queue_wait_seconds": "submit-to-grant wait latency",
     # -- control-plane self-observation (coordinator/coordphases.py) -----
     "tony_coord_phase_seconds": "coordinator tick wall per phase",
     "tony_coord_tick_seconds": "mean active coordinator tick duration",

@@ -65,7 +65,8 @@ class PortalServer:
                  host: str = "127.0.0.1", mover_interval_s: float = 300.0,
                  purger_interval_s: float = 3600.0,
                  retention_days: int = 30, token: str = "",
-                 tls_cert: str = "", tls_key: str = ""):
+                 tls_cert: str = "", tls_key: str = "",
+                 fleet_dir: str = ""):
         # Optional bearer auth: with a token set, every request must carry
         # "Authorization: Bearer <token>" or gets 401. The reference portal
         # ran behind keytab-login Play infra (hadoop/Requirements.java:
@@ -74,6 +75,15 @@ class PortalServer:
         # portal` / module main.
         self.token = token
         self.history_root = history_root
+        # Fleet scheduler view (/fleet): explicit dir, else discovered —
+        # a fleet daemon's history root lives INSIDE its fleet dir, so
+        # the parent holding a fleet journal is the fleet.
+        if not fleet_dir:
+            parent = os.path.dirname(os.path.abspath(history_root))
+            if os.path.exists(os.path.join(
+                    parent, constants.FLEET_JOURNAL_FILE)):
+                fleet_dir = parent
+        self.fleet_dir = fleet_dir
         self.cache = _Cache()
         self._mover = history.HistoryFileMover(history_root)
         self._purger = history.HistoryFilePurger(history_root, retention_days)
@@ -168,6 +178,11 @@ class PortalServer:
                 # LIVE job — the scrape endpoint (per-job HTML stays at
                 # /metrics/<job>).
                 return self._prom_view(req)
+            if parts == ["fleet"]:
+                # Fleet scheduler row (tony_tpu/fleet/): the daemon's
+                # atomically replaced status snapshot + tony_fleet_*
+                # exposition — never cached, the fleet is always live.
+                return self._fleet_view(req, as_json)
             view, *rest = parts
             if view in ("config", "jobs", "logs", "logfile",
                         "profiles", "profile", "metrics", "trace",
@@ -205,9 +220,13 @@ class PortalServer:
             payload = [dict(app_id=r.app_id, status=r.status, user=r.user,
                             started_ms=r.started_ms) for r in rows]
             return self._send_json(req, payload)
-        body = ["<h1>tony-tpu jobs</h1><table border=1 cellpadding=4>",
-                "<tr><th>job</th><th>status</th><th>user</th>"
-                "<th>started</th><th></th></tr>"]
+        body = ["<h1>tony-tpu jobs</h1>"]
+        if self.fleet_dir:
+            body.append("<p><a href='/fleet'>fleet scheduler</a> — "
+                        "queue, tenants, grants</p>")
+        body += ["<table border=1 cellpadding=4>",
+                 "<tr><th>job</th><th>status</th><th>user</th>"
+                 "<th>started</th><th></th></tr>"]
         for r in rows:
             a = html.escape(r.app_id)
             body.append(
@@ -225,6 +244,71 @@ class PortalServer:
 
     def _job_dir(self, job_id: str) -> Optional[str]:
         return history.list_job_dirs(self.history_root).get(job_id)
+
+    def _fleet_view(self, req, as_json: bool) -> None:
+        """Scheduler snapshot + tony_fleet_* families from the fleet
+        dir's atomically replaced artifacts (no RPC: the portal reads
+        what the daemon exports, same as /metrics reads metrics.prom)."""
+        if not self.fleet_dir:
+            return self._send(req, 404, "text/plain",
+                              b"no fleet dir configured or discovered")
+        snap = None
+        try:
+            with open(os.path.join(self.fleet_dir,
+                                   constants.FLEET_STATUS_FILE),
+                      encoding="utf-8") as f:
+                snap = json.load(f)
+        except (OSError, ValueError):
+            pass
+        if snap is None:
+            return self._send(req, 404, "text/plain",
+                              b"no fleet status snapshot yet")
+        if as_json:
+            return self._send_json(req, snap)
+        pool = snap.get("pool") or {}
+        qw = snap.get("queue_wait") or {}
+        body = [f"<h1>fleet — {html.escape(str(snap.get('fleet_dir')))}"
+                f"</h1>",
+                f"<p>generation {snap.get('generation', '?')} — hosts "
+                f"{pool.get('used', '?')}/{pool.get('total', '?')} used "
+                f"({pool.get('free', '?')} free), queue depth "
+                f"{snap.get('queue_depth', '?')}, wait p50 "
+                f"{qw.get('p50_s', 0)}s / p99 {qw.get('p99_s', 0)}s</p>"]
+        tenants = snap.get("tenants") or {}
+        if tenants:
+            body.append("<p>tenants: " + "  ".join(
+                f"{html.escape(t)}={row.get('used', 0)}/"
+                f"{row.get('quota') or '∞'}"
+                for t, row in sorted(tenants.items())) + "</p>")
+        body.append("<table border=1 cellpadding=4><tr><th>job</th>"
+                    "<th>tenant</th><th>pri</th><th>state</th>"
+                    "<th>hosts</th><th>wait</th><th>app</th></tr>")
+        for row in snap.get("jobs", []):
+            app = str(row.get("app_id") or "")
+            app_cell = (f"<a href='/jobs/{html.escape(app)}'>"
+                        f"{html.escape(app)}</a>") if app else \
+                html.escape(str(row.get("denial") or ""))
+            wait = row.get("wait_s")
+            body.append(
+                f"<tr><td>{html.escape(str(row.get('job')))}</td>"
+                f"<td>{html.escape(str(row.get('tenant')))}</td>"
+                f"<td>{row.get('priority', 0)}</td>"
+                f"<td>{html.escape(str(row.get('state')))}</td>"
+                f"<td>{row.get('hosts', 0)}/"
+                f"{row.get('hosts_requested', '?')}</td>"
+                f"<td>{(f'{wait:.1f}s' if wait is not None else '')}</td>"
+                f"<td>{app_cell}</td></tr>")
+        body.append("</table>")
+        try:
+            with open(os.path.join(self.fleet_dir,
+                                   constants.FLEET_PROM_FILE),
+                      encoding="utf-8") as f:
+                prom = f.read()
+            body.append("<h2>tony_fleet_* exposition</h2><pre>"
+                        + html.escape(prom) + "</pre>")
+        except OSError:
+            pass
+        self._send_html(req, "".join(body))
 
     def _config_view(self, req, job_id: str, as_json: bool) -> None:
         conf = self.cache.get("config", job_id)
@@ -618,6 +702,10 @@ def main(argv=None) -> int:
                    help="PEM cert path: serve HTTPS (pair with --tls-key)")
     p.add_argument("--tls-key", default="",
                    help="PEM private-key path for --tls-cert")
+    p.add_argument("--fleet-dir", default="",
+                   help="fleet daemon dir for the /fleet view (default: "
+                        "auto-discovered when the history root lives "
+                        "inside a fleet dir)")
     args = p.parse_args(argv)
     if bool(args.tls_cert) != bool(args.tls_key):
         p.error("--tls-cert and --tls-key must be set together")
@@ -629,7 +717,8 @@ def main(argv=None) -> int:
         mover_interval_s=conf.get_int(K.HISTORY_MOVER_INTERVAL_S, 300),
         purger_interval_s=conf.get_int(K.HISTORY_PURGER_INTERVAL_S, 3600),
         retention_days=conf.get_int(K.HISTORY_RETENTION_DAYS, 30),
-        token=args.token, tls_cert=args.tls_cert, tls_key=args.tls_key)
+        token=args.token, tls_cert=args.tls_cert, tls_key=args.tls_key,
+        fleet_dir=args.fleet_dir)
     srv.start()
     log.info("portal serving %s at %s", args.history_root, srv.url)
     try:
