@@ -663,6 +663,126 @@ def run_scale_suite(widths=None, sustain_s=6.0):
     }
 
 
+def run_fleet_suite(n_jobs=50, tick_s=0.2, timeout_s=420):
+    """The BENCH_FLEET family (persisted as BENCH_FLEET_r*.json, gated
+    by `tony-tpu bench diff` like every other family): the 50-job
+    synthetic tenant mix — 3 tenants, quotas, priorities 0-10, sizes
+    1-8, one whole-pool elastic victim preempted by a priority-10
+    arrival — drained through ONE in-process fleet daemon spawning
+    real `tony-tpu submit` clients on LocalSim virtual executors.
+    Headline = fleet goodput_fraction from the ledger; queue-wait
+    p50/p99, preemptions/job, warm-start fraction ride along. CPU-only,
+    no jax in this process (the virtual executors beat, they don't
+    compute)."""
+    import shutil
+    import tempfile
+    import threading
+
+    from tony_tpu.fleet.daemon import FleetDaemon
+
+    tmp = tempfile.mkdtemp(prefix="tony-bench-fleet-")
+    fleet_dir = os.path.join(tmp, "fleet")
+    virtual = {
+        "tony.worker.command": "virtual",
+        "tony.scale.virtual-executors": "true",
+        "tony.task.heartbeat-interval-ms": "300",
+        "tony.coordinator.monitor-interval-ms": "100",
+        "tony.diagnosis.enabled": "false",
+    }
+
+    def conf(run_s):
+        c = dict(virtual)
+        c["tony.scale.virtual-run-s"] = str(run_s)
+        return c
+
+    daemon = FleetDaemon(fleet_dir, slices=2, hosts_per_slice=4,
+                         quotas="capped=2", tick_s=tick_s,
+                         ledger_interval_s=2.0)
+    runner = threading.Thread(target=daemon.run, daemon=True,
+                              name="bench-fleet-daemon")
+    point = {"jobs": n_jobs, "pool_hosts": 8}
+    try:
+        t0 = time.monotonic()
+        runner.start()
+        # One whole-pool elastic victim; once it RUNS, a priority-10
+        # demander arrives into the full pool — the preempt-to-reclaim
+        # + grow-back shape in the mix (submitted after the victim is
+        # up, else priority ordering simply grants the demander first).
+        daemon.submit("bulk", 8, priority=0, min_hosts=2,
+                      conf=conf(15.0))
+        victim_deadline = t0 + 60
+        while time.monotonic() < victim_deadline:
+            rows = {r["job"]: r for r in daemon.status()["jobs"]}
+            row = rows.get("fj-0001", {})
+            if row.get("state") == "RUNNING" and row.get("app_id"):
+                break
+            time.sleep(0.5)
+        daemon.submit("prod", 4, priority=10, conf=conf(1.0))
+        sizes = (1, 2, 3, 4)
+        submitted = 2
+        for i in range(n_jobs - 10):
+            tenant = "alpha" if i % 2 == 0 else "bravo"
+            daemon.submit(tenant, sizes[i % 4], priority=i % 3,
+                          conf=conf(0.5))
+            submitted += 1
+        for i in range(n_jobs - submitted):
+            daemon.submit("capped", 1 + i % 2, conf=conf(0.5))
+        deadline = t0 + timeout_s
+        while time.monotonic() < deadline:
+            snap = daemon.status()
+            rows = snap.get("jobs", [])
+            if len(rows) == n_jobs and all(
+                    r["state"] in ("FINISHED", "FAILED", "CANCELLED")
+                    for r in rows):
+                break
+            time.sleep(1.0)
+        else:
+            raise RuntimeError(
+                f"fleet mix did not drain within {timeout_s}s "
+                f"({sum(1 for r in daemon.status()['jobs'] if r['state'] in ('FINISHED', 'FAILED', 'CANCELLED'))}/{n_jobs})")
+        point["drain_s"] = round(time.monotonic() - t0, 1)
+        snap = daemon.status()
+        failed = [r["job"] for r in snap["jobs"]
+                  if r["state"] != "FINISHED"]
+        point["failed_jobs"] = len(failed)
+        qw = snap.get("queue_wait") or {}
+        point["queue_wait_p50_s"] = qw.get("p50_s")
+        point["queue_wait_p99_s"] = qw.get("p99_s")
+        grants = daemon.metrics.counter("tony_fleet_grants_total").value
+        preempts = daemon.metrics.counter(
+            "tony_fleet_preemptions_total").value
+        point["preemptions_per_job"] = round(
+            preempts / max(1.0, grants), 4)
+        led = (snap.get("ledger") or {}).get("fleet") or {}
+        point["fleet_goodput_fraction"] = led.get("goodput_fraction")
+        point["warm_start_fraction"] = led.get("warm_start_fraction")
+        point["held_chip_s"] = led.get("held_chip_s")
+        point["lost_preempted_chip_s"] = led.get(
+            "lost_preempted_chip_s")
+        point["phase_chip_s"] = led.get("phase_chip_s")
+        incident = None
+        try:
+            with open(os.path.join(
+                    fleet_dir, "fleet.incident.json")) as f:
+                incident = json.load(f)
+        except (OSError, ValueError):
+            pass
+        if incident:
+            point["verdict"] = (incident.get("verdict")
+                                or {}).get("category")
+    finally:
+        daemon.request_stop()
+        runner.join(timeout=60)
+        shutil.rmtree(tmp, ignore_errors=True)
+    return {
+        "metric": "fleet_goodput_fraction",
+        "value": point.get("fleet_goodput_fraction"),
+        "unit": "chip-seconds useful / chip-seconds held",
+        "vs_baseline": None,
+        "detail": {"suite": "fleet", "mix": point},
+    }
+
+
 def main(argv=None):
     import argparse
 
@@ -674,19 +794,24 @@ def main(argv=None):
                          "regression")
     ap.add_argument("--tolerance", type=float, default=0.10,
                     help="relative regression tolerance for --against")
-    ap.add_argument("--suite", choices=("default", "scale"),
+    ap.add_argument("--suite", choices=("default", "scale", "fleet"),
                     default="default",
                     help="'scale' runs the control-plane width family "
                          "(BENCH_SCALE: rendezvous/beats/tick/journal/"
                          "resize vs gang size on virtual executors — "
-                         "CPU-only, no jax) instead of the training "
+                         "CPU-only, no jax); 'fleet' replays the "
+                         "50-job synthetic tenant mix through one "
+                         "fleet daemon (BENCH_FLEET: goodput fraction, "
+                         "queue-wait p50/p99, preemptions/job, warm-"
+                         "start fraction) instead of the training "
                          "bench")
     ap.add_argument("--out", default="",
                     help="also write the bench json to this path")
     args = ap.parse_args(argv)
 
-    if args.suite == "scale":
-        doc = run_scale_suite()
+    if args.suite in ("scale", "fleet"):
+        doc = run_scale_suite() if args.suite == "scale" \
+            else run_fleet_suite()
         print(json.dumps(doc))
         if args.out:
             with open(args.out, "w", encoding="utf-8") as f:

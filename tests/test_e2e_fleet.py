@@ -185,6 +185,41 @@ def test_fleet_50_job_tenant_mix_preempt_kill_recover(tmp_path):
     snap = _snapshot(fleet_dir)
     assert (snap["tenants"].get("capped") or {}).get("used", 0) <= 2
 
+    # -- the decision explainer names the quota blocker (ISSUE 14) -----
+    held_row = next(r for r in _rows(fleet_dir).values()
+                    if r["tenant"] == "capped" and r["state"] == "QUEUED"
+                    and "quota" in (r.get("held") or r.get("denial")
+                                    or ""))
+    c = FleetClient(fleet_dir)
+    try:
+        explained = c.explain(held_row["job"])
+    finally:
+        c.close()
+    assert explained["ok"], explained
+    quota_holds = [d for d in explained["decisions"]
+                   if d["action"] == "quota"]
+    assert quota_holds, explained["decisions"]
+    # the blocker is NAMED: the capped tenant's own running job(s)
+    assert quota_holds[-1]["blocking"], quota_holds[-1]
+    # the CLI renders the causal timeline (exit 0 through main())
+    assert cli_main(["fleet", "explain", held_row["job"],
+                     "--dir", fleet_dir]) == 0
+    # fleet diagnose (offline rule engine over journal + ledger): the
+    # capped mix reads as QUOTA_SATURATED, evidence-backed
+    from tony_tpu.fleet import diagnose as fdiagnose
+
+    incident = fdiagnose.build_incident(
+        fdiagnose.bundle_from_dir(fleet_dir))
+    assert incident["verdict"]["category"] == "QUOTA_SATURATED", \
+        incident["verdict"]
+    assert any("capped" in e for e in incident["verdict"]["evidence"])
+    assert cli_main(["fleet", "diagnose", "--dir", fleet_dir]) == 0
+    # the daemon's own periodic incident export agrees on the verdict
+    live_incident = fdiagnose.load_incident(fleet_dir)
+    assert live_incident is not None
+    assert live_incident["verdict"]["category"] in (
+        "QUOTA_SATURATED", "STARVATION")
+
     # SIGKILL the daemon mid-drain...
     with open(os.path.join(fleet_dir, constants.FLEET_ADDR_FILE)) as f:
         daemon_pid = json.load(f)["pid"]
@@ -246,9 +281,37 @@ def test_fleet_50_job_tenant_mix_preempt_kill_recover(tmp_path):
                and e.payload.get("phase") == "completed"]
     assert len(resized) >= 2          # the shrink AND the grow-back
 
-    # the real-CLI status surface renders the drained fleet
+    # the real-CLI status surface renders the drained fleet (incl. the
+    # per-tenant goodput column riding the ledger rollup)
     assert cli_main(["fleet", "status", "--dir", fleet_dir]) == 0
+    snap = _snapshot(fleet_dir)
+    fleet_led = (snap.get("ledger") or {}).get("fleet") or {}
+    assert fleet_led.get("goodput_fraction") is not None
+    assert fleet_led.get("held_chip_s", 0) > 0
     _stop_fleet(fleet_dir)
+
+    # -- one --fleet Perfetto export stitches the whole pool -----------
+    out_path = str(tmp_path / "fleet_trace.json")
+    assert cli_main(["trace", "--fleet", fleet_dir,
+                     "--out", out_path]) == 0
+    with open(out_path, encoding="utf-8") as f:
+        payload = json.load(f)
+    # queue → grant → run → preempt, one shared fleet trace id, ZERO
+    # unclosed spans across the daemon (SIGKILLed + recovered life
+    # included) and every job's stitched tree
+    assert payload["traceId"], "no fleet trace id"
+    assert payload["unclosedSpans"] == [], payload["unclosedSpans"]
+    x_names = {e["name"] for e in payload["traceEvents"]
+               if e.get("ph") == "X"}
+    assert {"fleet.queue", "fleet.job", "client.submit",
+            "coordinator.run"} <= x_names, sorted(x_names)[:40]
+    i_names = {e["name"] for e in payload["traceEvents"]
+               if e.get("ph") == "i"}
+    assert "fleet.preempt" in i_names
+    # every fleet-spawned job adopted the ONE fleet trace id
+    trace_ids = {e["args"].get("trace") for e in payload["traceEvents"]
+                 if e.get("ph") == "X" and e["args"].get("trace")}
+    assert trace_ids == {payload["traceId"]}, trace_ids
 
 
 @pytest.mark.timeout_s(420)

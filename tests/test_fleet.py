@@ -22,9 +22,10 @@ from tony_tpu.events.events import EventType, read_events
 from tony_tpu.fleet import journal as fj
 from tony_tpu.fleet.daemon import (FleetDaemon, FleetError, _AdoptedHandle,
                                    QUEUED, RUNNING)
-from tony_tpu.fleet.policy import (CAPACITY_DENIED, GRANT, QUOTA_DENIED,
-                                   SHRINK, JobRequest, PolicyEngine,
-                                   SlicePool, parse_quotas)
+from tony_tpu.fleet.policy import (CAPACITY_DENIED, GRANT, PREEMPT_WAIT,
+                                   PRIORITY_HELD, QUOTA_DENIED, SHRINK,
+                                   JobRequest, PolicyEngine, SlicePool,
+                                   parse_quotas)
 
 pytestmark = pytest.mark.faults
 
@@ -166,9 +167,12 @@ def test_capacity_denied_head_of_line_holds_no_backfill():
     eng.submit(JobRequest("big2", "t", priority=5, hosts=4, seq=3))
     plan = eng.schedule()
     # big2 can't fit and can't preempt (no floors): it holds the line —
-    # the small job behind it is NOT backfilled into its wait.
+    # the small job behind it is NOT backfilled into its wait, and the
+    # explainer records WHO it is held behind (PRIORITY_HELD decision).
     assert [(d.action, d.job_id) for d in plan] == \
-        [(CAPACITY_DENIED, "big2")]
+        [(CAPACITY_DENIED, "big2"), (PRIORITY_HELD, "small")]
+    held = plan[1]
+    assert held.blocking == ["big2"] and "head-of-line" in held.reason
 
 
 def test_preemption_picks_lowest_priority_victims_respecting_floors():
@@ -579,7 +583,7 @@ def test_fleet_fixture_golden_passes_and_bad_fails():
     rules = {v.rule for v in bad.violations}
     assert rules == {"fleet-gen-monotonic", "fleet-unknown-job",
                      "fleet-double-grant", "fleet-terminal",
-                     "fleet-capacity"}
+                     "fleet-capacity", "fleet-decision"}
 
 
 def test_daemon_lifecycle_artifacts_pass_invariants(tmp_path):

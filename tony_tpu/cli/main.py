@@ -554,6 +554,12 @@ def _cmd_trace(args: argparse.Namespace) -> int:
     from tony_tpu import constants, tracing
     from tony_tpu.events import history
 
+    if args.fleet:
+        return _trace_fleet(args)
+    if not args.app_id:
+        print("trace needs an app_id (or --fleet <fleet_dir>)",
+              file=sys.stderr)
+        return 2
     root = _history_root(args)
     job_dir = history.list_job_dirs(root).get(args.app_id)
     if job_dir is None:
@@ -600,6 +606,57 @@ def _cmd_trace(args: argparse.Namespace) -> int:
     unclosed = payload.get("unclosedSpans", [])
     print(f"{n_spans} spans, {len(unclosed)} unclosed"
           + (f" ({', '.join(unclosed)})" if unclosed else ""),
+          file=sys.stderr)
+    return 0
+
+
+def _trace_fleet(args: argparse.Namespace) -> int:
+    """`tony-tpu trace --fleet <fleet_dir>`: merge the fleet daemon's
+    own span log (queue spans, fleet.job lifetimes, preempt/restore
+    instants) with EVERY job's span log under the fleet's history root
+    — all sharing the fleet trace id the grants injected — into one
+    Perfetto export of the whole pool."""
+    from tony_tpu import constants, tracing
+    from tony_tpu.fleet import ledger as fledger
+
+    fleet_dir = os.path.abspath(os.path.expanduser(args.fleet))
+    fleet_trace_path = os.path.join(fleet_dir, constants.TRACE_FILE)
+    if not os.path.exists(fleet_trace_path):
+        print(f"no fleet span log at {fleet_trace_path} — not a fleet "
+              f"dir, or the daemon predates fleet tracing",
+              file=sys.stderr)
+        return 1
+    records = tracing.load_records(fleet_trace_path)
+    n_jobs = 0
+    for app_id, job_dir in sorted(
+            fledger.job_history_dirs(fleet_dir).items()):
+        path = os.path.join(job_dir, constants.TRACE_FILE)
+        if not os.path.exists(path):
+            continue
+        job_records = tracing.load_records(path)
+        # Prefix the task track with the app id so 40 jobs' worker:0
+        # rows stay distinguishable on the merged timeline.
+        for rec in job_records:
+            if rec.get("task"):
+                rec["task"] = f"{app_id}/{rec['task']}"
+            elif rec.get("svc") in ("client", "coordinator"):
+                rec["task"] = app_id
+        records.extend(job_records)
+        n_jobs += 1
+    payload = tracing.to_trace_events(records)
+    text = json.dumps(payload, indent=1)
+    if args.out:
+        with open(args.out, "w", encoding="utf-8") as f:
+            f.write(text)
+        print(f"wrote {args.out}", file=sys.stderr)
+    else:
+        print(text)
+    n_spans = sum(1 for e in payload["traceEvents"]
+                  if e.get("ph") == "X")
+    unclosed = payload.get("unclosedSpans", [])
+    print(f"fleet trace {payload.get('traceId', '?')}: {n_jobs} "
+          f"job(s), {n_spans} spans, {len(unclosed)} unclosed"
+          + (f" ({', '.join(unclosed[:8])})" if unclosed else ""),
           file=sys.stderr)
     return 0
 
@@ -1020,8 +1077,10 @@ def _fleet_conf(args: argparse.Namespace):
 
 def _render_fleet_top(snap: dict) -> str:
     """One frame of `tony-tpu fleet top`: pool occupancy, per-tenant
-    usage vs quota, queue depth + wait quantiles, and one row per job
-    (queued jobs show their live wait; denials show why)."""
+    usage vs quota WITH ledger goodput%, the fleet goodput headline,
+    queue depth + wait quantiles, and one row per job — queued jobs
+    show their live wait and a `held:` column (the explainer's
+    top-line answer; `fleet explain <job>` has the full timeline)."""
     pool = snap.get("pool") or {}
     qw = snap.get("queue_wait") or {}
     lines = [
@@ -1031,18 +1090,34 @@ def _render_fleet_top(snap: dict) -> str:
         f"{pool.get('slices', '?')}x{pool.get('hosts_per_slice', '?')})"
         f"  queue={snap.get('queue_depth', '?')}"
         f"  wait p50={qw.get('p50_s', 0)}s p99={qw.get('p99_s', 0)}s"]
+    ledger = snap.get("ledger") or {}
+    fleet_led = ledger.get("fleet") or {}
+    if fleet_led.get("goodput_fraction") is not None:
+        warm = fleet_led.get("warm_start_fraction")
+        lines.append(
+            f"goodput: {float(fleet_led['goodput_fraction']):.1%} of "
+            f"{fleet_led.get('held_chip_s', 0)} chip-seconds held"
+            + (f"  warm starts: {float(warm):.0%}"
+               if warm is not None else "")
+            + (f"  preempt-lost: "
+               f"{fleet_led.get('lost_preempted_chip_s', 0)} chip-s"
+               if fleet_led.get("lost_preempted_chip_s") else ""))
     tenants = snap.get("tenants") or {}
     if tenants:
+        def _tenant_cell(t, row):
+            cell = f"{t}={row.get('used', 0)}/{row.get('quota') or '∞'}"
+            if row.get("goodput") is not None:
+                cell += f" gp={float(row['goodput']):.0%}"
+            return cell
         lines.append("tenants: " + "  ".join(
-            f"{t}={row.get('used', 0)}/{row.get('quota') or '∞'}"
-            for t, row in sorted(tenants.items())))
+            _tenant_cell(t, row) for t, row in sorted(tenants.items())))
     lines.append(f"{'JOB':<10}{'TENANT':<10}{'PRI':>4} {'STATE':<11}"
-                 f"{'HOSTS':>7}  {'WAIT':>7}  {'APP / NOTE'}")
+                 f"{'HOSTS':>7}  {'WAIT':>7}  {'APP / HELD'}")
     for row in snap.get("jobs", []):
         wait = row.get("wait_s")
         note = row.get("app_id") or ""
-        if row.get("state") == "QUEUED" and row.get("denial"):
-            note = row["denial"]
+        if row.get("state") == "QUEUED":
+            note = row.get("held") or row.get("denial") or note
         hosts = f"{row.get('hosts', 0)}/{row.get('hosts_requested', '?')}"
         lines.append(
             f"{row.get('job', '?'):<10}{row.get('tenant', '?'):<10}"
@@ -1094,9 +1169,14 @@ def _cmd_fleet(args: argparse.Namespace) -> int:
         cache_root = args.cache_root if args.cache_root is not None \
             else str(conf.get(K.FLEET_COMPILE_CACHE_ROOT, "") or "")
         tick_s = float(conf.get(K.FLEET_TICK_INTERVAL_S, 0.5) or 0.5)
+        ring = conf.get_int(K.FLEET_DECISION_RING, 64)
+        ledger_s = float(conf.get(K.FLEET_LEDGER_INTERVAL_S, 5.0)
+                         or 5.0)
         cmd = [sys.executable, "-m", "tony_tpu.fleet", "serve",
                "--dir", fleet_dir, "--slices", str(slices),
-               "--hosts-per-slice", str(hps), "--tick-s", str(tick_s)]
+               "--hosts-per-slice", str(hps), "--tick-s", str(tick_s),
+               "--decision-ring", str(ring),
+               "--ledger-interval-s", str(ledger_s)]
         if quotas:
             cmd += ["--quotas", quotas]
         if pool_dir:
@@ -1129,6 +1209,51 @@ def _cmd_fleet(args: argparse.Namespace) -> int:
               + (", recovered" if args.recover else "") + ")")
         print(f"submit with `tony-tpu fleet submit --dir {fleet_dir} "
               f"--tenant <t> --hosts <n> --conf ...`")
+        return 0
+    if args.fleet_cmd == "diagnose":
+        # Offline by design: the verdict must survive the daemon (a
+        # dead scheduler is exactly when you want to diagnose the
+        # fleet). The daemon's own periodic fleet.incident.json is the
+        # live twin; this recomputes fresh from the fleet dir.
+        from tony_tpu.fleet import diagnose as fdiagnose
+        from tony_tpu.fleet.journal import FleetJournalError
+
+        try:
+            doc = fdiagnose.build_incident(
+                fdiagnose.bundle_from_dir(fleet_dir))
+        except FleetJournalError as e:
+            print(f"{e}", file=sys.stderr)
+            return 1
+        if args.json:
+            print(json.dumps(doc, indent=1, sort_keys=True))
+        else:
+            print(fdiagnose.render_text(doc))
+        return 0
+    if args.fleet_cmd == "explain":
+        from tony_tpu.fleet import diagnose as fdiagnose
+        from tony_tpu.fleet.journal import FleetJournalError
+
+        client = FleetClient(fleet_dir)
+        try:
+            res = client.explain(args.job)
+        except FleetClientError:
+            # No live daemon: replay the journal's decision records —
+            # the ring is bounded, the journal is the full history.
+            try:
+                res = fdiagnose.offline_explain(fleet_dir, args.job)
+            except FleetJournalError as e:
+                print(f"{e}", file=sys.stderr)
+                return 1
+        finally:
+            client.close()
+        if not res.get("ok"):
+            print(f"explain refused: {res.get('message', '?')}",
+                  file=sys.stderr)
+            return 1
+        if args.json:
+            print(json.dumps(res, indent=1, sort_keys=True))
+        else:
+            print(fdiagnose.render_explain(res))
         return 0
     client = FleetClient(fleet_dir)
     try:
@@ -1326,8 +1451,15 @@ def build_parser() -> argparse.ArgumentParser:
         "trace",
         help="export a job's control-plane trace as Chrome/Perfetto "
              "trace_events JSON (submit → rendezvous → first step → "
-             "teardown, one stitched tree)")
-    tr.add_argument("app_id")
+             "teardown, one stitched tree); --fleet <fleet_dir> "
+             "exports the WHOLE pool — queue spans, grants, every "
+             "job's lifecycle, preempt/grow-back resizes — on one "
+             "timeline under the shared fleet trace id")
+    tr.add_argument("app_id", nargs="?", default="",
+                    help="application id (omit with --fleet)")
+    tr.add_argument("--fleet", metavar="FLEET_DIR", default="",
+                    help="export a fleet dir's stitched pool-wide "
+                         "trace instead of one job's")
     tr.add_argument("--history-root")
     tr.add_argument("--out", help="write JSON here instead of stdout")
     tr.add_argument("--cold-start", action="store_true",
@@ -1492,6 +1624,35 @@ def build_parser() -> argparse.ArgumentParser:
     fc.add_argument("--conf-file")
     fc.add_argument("--conf", action="append", metavar="K=V")
     fc.set_defaults(fn=_cmd_fleet)
+    fe = fl_sub.add_parser(
+        "explain",
+        help="why is my job queued: the causal hold timeline — every "
+             "scheduler decision transition (quota / capacity / "
+             "fragmentation / priority-held / preempt-wait) with the "
+             "blocking jobs/tenants named; falls back to journal "
+             "replay when the daemon is down")
+    fe.add_argument("job")
+    fe.add_argument("--dir")
+    fe.add_argument("--workdir")
+    fe.add_argument("--json", action="store_true",
+                    help="print the raw decision/milestone document")
+    fe.add_argument("--conf-file")
+    fe.add_argument("--conf", action="append", metavar="K=V")
+    fe.set_defaults(fn=_cmd_fleet)
+    fd = fl_sub.add_parser(
+        "diagnose",
+        help="fleet-level rule engine over the goodput ledger + "
+             "decision records: STARVATION / QUOTA_SATURATED / "
+             "FRAGMENTATION / PREEMPT_STORM / POOL_COLD / "
+             "FLEET_HEALTHY, evidence-backed (works offline from the "
+             "fleet dir; docs/operations.md 'Fleet triage')")
+    fd.add_argument("--dir")
+    fd.add_argument("--workdir")
+    fd.add_argument("--json", action="store_true",
+                    help="print the raw fleet.incident.json document")
+    fd.add_argument("--conf-file")
+    fd.add_argument("--conf", action="append", metavar="K=V")
+    fd.set_defaults(fn=_cmd_fleet)
 
     ln = sub.add_parser(
         "lint",

@@ -73,7 +73,15 @@ class TonyTpuClient:
         # span hangs under, and the anchor bench.py measures
         # submit→first-step from. Buffered locally, shipped over
         # trace.push once the coordinator answers its first report.
+        # A FLEET-granted job adopts the fleet's trace id instead of
+        # minting one (the daemon stamps tony.internal.fleet-trace-id
+        # on the grant's conf), so `tony-tpu trace --fleet` renders the
+        # whole pool — queue waits, grants, every job's lifecycle — on
+        # one timeline.
+        fleet_trace = str(conf.get(K.INTERNAL_FLEET_TRACE_ID, "")
+                          or "")
         self._tracer = tracing.Tracer(
+            trace_id=fleet_trace or None,
             service="client",
             enabled=conf.get_bool(K.TRACE_ENABLED, True))
         self._submit_span = tracing.NULL_SPAN
@@ -264,8 +272,16 @@ class TonyTpuClient:
         os.makedirs(self.job_dir, exist_ok=True)
         for lst in self.listeners:
             lst.on_application_id_received(self.app_id)
+        # The fleet.job span id rides as an ATTR, not the span parent:
+        # the job's own span tree stays self-contained (trace-parent
+        # invariant), the --fleet export stitches by shared trace id.
+        submit_attrs = {"app": self.app_id}
+        fleet_parent = str(self.conf.get(
+            K.INTERNAL_FLEET_TRACE_PARENT, "") or "")
+        if fleet_parent:
+            submit_attrs["fleet_parent"] = fleet_parent
         self._submit_span = self._tracer.start_span(
-            "client.submit", attrs={"app": self.app_id})
+            "client.submit", attrs=submit_attrs)
         frozen = os.path.join(self.job_dir, constants.FINAL_CONFIG_FILE)
         addr_file = os.path.join(self.job_dir, "coordinator.addr")
         try:

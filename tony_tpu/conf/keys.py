@@ -742,6 +742,19 @@ FAULT_FLEET_PREEMPT = _key(
     "elastic shrink RPC is issued — the unreachable-victim shape. The "
     "preemption (and the grant waiting on it) is retried on a later "
     "tick; the victim keeps running undisturbed.")
+FAULT_FLEET_LEDGER = _key(
+    "tony.fault.fleet-ledger", "", str,
+    "Fail a fleet goodput-ledger fold (tony_tpu/fleet/ledger.py via the "
+    "daemon) — the corrupt-artifact shape. The fleet degrades to "
+    "counters-only (no goodput gauges, ledger omitted from status) with "
+    "a one-time warning; the scheduler tick never blocks or fails.")
+FAULT_FLEET_EXPLAIN = _key(
+    "tony.fault.fleet-explain", "", str,
+    "Fail the write of a REC_FLEET_DECISION journal record (the "
+    "scheduler decision explainer's write-ahead stream) — the full-disk "
+    "shape on the observability path. The decision is still applied to "
+    "the in-memory ring and the FLEET_JOB_HELD event still fires; one "
+    "warning, scheduling unaffected.")
 FAULT_PROFILE_CAPTURE = _key(
     "tony.fault.profile-capture", "", str,
     "Fail an on-demand device capture at the step boundary that would "
@@ -827,6 +840,20 @@ FLEET_PREEMPT_MIN_HOSTS = _key(
     "victim down to when the submission does not name its own "
     "min_hosts. Victims are shrunk via the coordinator's elastic "
     "resize (drain→remesh, no epoch burned), never killed.")
+FLEET_DECISION_RING = _key(
+    "tony.fleet.decision-ring", 64, int,
+    "Bound on the per-job scheduler-decision ring behind `tony-tpu "
+    "fleet explain`: the last N hold-reason transitions (quota / "
+    "capacity / fragmentation / priority-held / preempt-wait) are kept "
+    "in memory per job; the full history is in the REC_FLEET_DECISION "
+    "journal records.")
+FLEET_LEDGER_INTERVAL_S = _key(
+    "tony.fleet.ledger-interval-s", 5.0, float,
+    "Cadence of the goodput-ledger refresh for RUNNING jobs (terminal "
+    "jobs fold exactly once at finish). Each refresh reads the running "
+    "jobs' span trees / perf artifacts into queued/startup/train/stall "
+    "phase accounting — too hot for every scheduler tick at 50 jobs, "
+    "cheap at this interval.")
 
 # --- portal ---------------------------------------------------------------
 PORTAL_PORT = _key(
@@ -890,6 +917,23 @@ INTERNAL_REVISION = _key(
 INTERNAL_BRANCH = _key(
     "tony.internal.branch", "", str,
     "Stamped by the client at submit: git branch of the framework build.")
+INTERNAL_FLEET_TRACE_ID = _key(
+    "tony.internal.fleet-trace-id", "", str,
+    "Stamped by the fleet daemon on every grant's conf: the fleet-wide "
+    "trace id (tony_tpu/tracing.py). The client adopts it as the job's "
+    "trace id instead of minting a fresh one, so one `tony-tpu trace "
+    "--fleet` export renders every job in the pool — queue spans, "
+    "grants, job lifetimes, preempt/grow-back resizes — on ONE "
+    "timeline. Empty = the job mints its own trace id (non-fleet "
+    "submits).")
+INTERNAL_FLEET_TRACE_PARENT = _key(
+    "tony.internal.fleet-trace-parent", "", str,
+    "Stamped by the fleet daemon on every grant's conf: span id of the "
+    "fleet.job span this grant opened. Recorded as the fleet_parent "
+    "attr on the job's client.submit root span (an attr, not a span "
+    "parent — the job's own span tree stays self-contained for the "
+    "trace-parent invariant; the --fleet export stitches by shared "
+    "trace id).")
 
 # --- per-jobtype dynamic keys (reference TonyConfigurationKeys.java:171-239)
 INSTANCES_FORMAT = "tony.{job}.instances"

@@ -178,6 +178,13 @@ FLEET_COUNTERS_FILE = "fleet.counters.json"
 # in the fleet dir; append-only across daemon lives (never finalized —
 # the fleet is a daemon, not a job).
 FLEET_EVENTS_FILE = "fleet.events.jsonl"
+# Fleet-level incident document (tony_tpu/fleet/diagnose.py): the rule
+# engine's verdict over the goodput ledger + scheduler decision records
+# (STARVATION / QUOTA_SATURATED / FRAGMENTATION / PREEMPT_STORM /
+# POOL_COLD / FLEET_HEALTHY), atomically replaced by the daemon every
+# export and recomputed on demand by `tony-tpu fleet diagnose`. Readers
+# treat a torn/absent file as "recompute from the fleet dir".
+FLEET_INCIDENT_FILE = "fleet.incident.json"
 # Per-task exit report a POOLED executor writes into its task workdir at
 # exit ({"exit_code": N}): the leased process is the pool daemon's child,
 # not the backend's, so poll_completions reads this instead of waitpid.

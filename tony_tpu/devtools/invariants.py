@@ -38,6 +38,20 @@ fleet-terminal           no job state transition out of FINISHED/
                          FAILED/CANCELLED
 fleet-capacity           granted hosts never exceed the journaled pool
                          (slices × hosts-per-slice) at any point
+fleet-decision           every REC_FLEET_DECISION names a journaled
+                         submission, never lands after the job's
+                         terminal state, and reason transitions are
+                         deduplicated (no two consecutive identical
+                         holds for one job within a daemon life —
+                         the bounded-journal contract)
+fleet-ledger             the goodput ledger re-folded offline books
+                         non-negative phases that sum to each
+                         terminal job's wall within 1% (the PR 9
+                         sum-to-wall discipline at the fleet layer)
+fleet-trace-stitch       every granted job's span tree carries the
+                         fleet's trace id (the grant's injected
+                         tony.internal.fleet-trace-id reached the
+                         client) so one --fleet export stitches
 =======================  ==================================================
 
 Surfaces: ``tony-tpu check <app|job_dir>`` (and the no-deps module CLI
@@ -376,6 +390,10 @@ def _check_fleet_journal(path: str, rel: str, rep: Report) -> None:
     # job → current state fold ("QUEUED"/"GRANTED"/lifecycle states)
     states: Dict[str, str] = {}
     hosts: Dict[str, int] = {}        # granted hosts per live job
+    # job → (action, reason) of its last decision record this life —
+    # the fleet-decision dedup fence (reset at fgen: a recovered daemon
+    # legitimately re-records the holds it re-derives).
+    last_decision: Dict[str, Tuple[str, str]] = {}
     for idx, rec in records:
         t = rec.get("t")
         ev = json.dumps(rec, sort_keys=True)
@@ -399,13 +417,14 @@ def _check_fleet_journal(path: str, rel: str, rep: Report) -> None:
                 if st == "GRANTED":
                     states[j] = "QUEUED"
                     hosts.pop(j, None)
+            last_decision.clear()
             continue
         if t == fj.REC_FLEET_SUBMIT:
             submitted.add(job)
             states[job] = "QUEUED"
             continue
         if t not in (fj.REC_FLEET_GRANT, fj.REC_FLEET_PREEMPT,
-                     fj.REC_FLEET_STATE):
+                     fj.REC_FLEET_STATE, fj.REC_FLEET_DECISION):
             continue
         if job not in submitted:
             rep.violations.append(Violation(
@@ -414,7 +433,28 @@ def _check_fleet_journal(path: str, rel: str, rep: Report) -> None:
                 f"submitted — a grant/state without a submission", ev))
             continue
         prev = states.get(job, "QUEUED")
+        if t == fj.REC_FLEET_DECISION:
+            action = str(rec.get("action", "") or "")
+            reason = str(rec.get("reason", "") or "")
+            if prev in fj.TERMINAL_STATES:
+                rep.violations.append(Violation(
+                    "fleet-decision", rel, idx,
+                    f"decision record for job {job} in terminal state "
+                    f"{prev} — the explainer recorded a hold for a "
+                    f"finished job", ev))
+            elif last_decision.get(job) == (action, reason):
+                rep.violations.append(Violation(
+                    "fleet-decision", rel, idx,
+                    f"consecutive identical decision for job {job} "
+                    f"([{action}] {reason[:80]!r}) — decisions must be "
+                    f"recorded per reason TRANSITION, never per tick "
+                    f"(the bounded-journal contract)", ev))
+            last_decision[job] = (action, reason)
+            continue
         if t == fj.REC_FLEET_GRANT:
+            # A grant closes the hold episode: the same hold may
+            # legitimately recur after a preemption re-queues the job.
+            last_decision.pop(job, None)
             if prev in fj.TERMINAL_STATES:
                 rep.violations.append(Violation(
                     "fleet-terminal", rel, idx,
@@ -452,6 +492,100 @@ def _check_fleet_journal(path: str, rel: str, rep: Report) -> None:
                 f"granted hosts total {in_use} exceeds the journaled "
                 f"pool of {capacity} — the scheduler over-committed",
                 ev))
+
+
+def _check_fleet_ledger(fleet_dir: str, rep: Report) -> None:
+    """Re-fold the goodput ledger offline (fleet/ledger.py) and hold
+    its own invariant: every terminal job's phases are non-negative and
+    sum to its wall within 1% — the acceptance discipline that makes
+    the per-tenant goodput numbers trustworthy."""
+    from tony_tpu.fleet import ledger as fledger
+
+    try:
+        folded = fledger.fold_fleet_dir(fleet_dir)
+    except Exception as e:  # noqa: BLE001 — a broken fold IS the finding
+        rep.violations.append(Violation(
+            "fleet-ledger", constants.FLEET_JOURNAL_FILE, 0,
+            f"goodput-ledger fold failed over this fleet dir: {e}"))
+        return
+    checked = 0
+    for job_id, led in sorted(folded.get("jobs", {}).items()):
+        wall = float(led.get("wall_s", 0.0) or 0.0)
+        if led.get("provisional") or wall <= 0:
+            continue            # live jobs have no terminal anchor
+        checked += 1
+        phases = led.get("phases_s") or {}
+        negative = {p: v for p, v in phases.items() if float(v) < 0}
+        if negative:
+            rep.violations.append(Violation(
+                "fleet-ledger", constants.FLEET_JOURNAL_FILE, 0,
+                f"job {job_id}: negative ledger phase(s) {negative} — "
+                f"the wall partition went inconsistent",
+                json.dumps(phases, sort_keys=True)))
+            continue
+        err = fledger.sum_to_wall_error(led)
+        if err:
+            total = sum(float(v) for v in phases.values())
+            rep.violations.append(Violation(
+                "fleet-ledger", constants.FLEET_JOURNAL_FILE, 0,
+                f"job {job_id}: ledger phases sum to {total:.4f}s but "
+                f"the wall is {wall:.4f}s (off by {err:.4f}s beyond "
+                f"tolerance) — phase accounting leaked or double-"
+                f"booked", json.dumps(phases, sort_keys=True)))
+    rep.checked["fleet-ledger"] = checked
+
+
+def _check_fleet_trace(fleet_dir: str, rep: Report) -> None:
+    """Fleet span-log hygiene + cross-layer stitching: the fleet dir's
+    own span log must be tree-consistent (non-strict: a killed daemon
+    life's opens are closed by the recovering life), and every granted
+    job's span tree must carry the FLEET's trace id — the proof the
+    grant's injected trace context reached the client."""
+    from tony_tpu import tracing
+    from tony_tpu.fleet import journal as fj
+    from tony_tpu.fleet import ledger as fledger
+
+    trace_path = os.path.join(fleet_dir, constants.TRACE_FILE)
+    if not os.path.exists(trace_path):
+        rep.notes.append(f"{constants.TRACE_FILE}: absent — fleet "
+                         f"trace checks skipped (pre-ledger fleet dir "
+                         f"or tracing disabled)")
+        return
+    _check_spans(trace_path, constants.TRACE_FILE, rep, strict=False)
+    fleet_trace = tracing.existing_trace_id(trace_path)
+    if not fleet_trace:
+        return
+    try:
+        st = fj.replay(os.path.join(fleet_dir,
+                                    constants.FLEET_JOURNAL_FILE))
+    except fj.FleetJournalError:
+        return
+    dirs = fledger.job_history_dirs(fleet_dir)
+    stitched = 0
+    for job_id, fold in sorted(st.jobs.items()):
+        if not fold.granted_ms or not fold.app_id:
+            continue
+        job_dir = dirs.get(fold.app_id)
+        if job_dir is None:
+            continue
+        job_trace_path = os.path.join(job_dir, constants.TRACE_FILE)
+        if not os.path.exists(job_trace_path):
+            rep.notes.append(
+                f"{job_id} ({fold.app_id}): no span log — stitching "
+                f"unverifiable (job tracing disabled?)")
+            continue
+        job_trace = tracing.existing_trace_id(job_trace_path)
+        if job_trace and job_trace != fleet_trace:
+            rep.violations.append(Violation(
+                "fleet-trace-stitch", constants.TRACE_FILE, 0,
+                f"job {job_id} ({fold.app_id}) traces under "
+                f"{job_trace!r}, not the fleet's {fleet_trace!r} — the "
+                f"grant's injected trace id never reached the client, "
+                f"so a --fleet export cannot stitch this job",
+                job_trace_path))
+        else:
+            stitched += 1
+    rep.checked["fleet-trace-stitch"] = stitched
 
 
 # ---------------------------------------------------------------------------
@@ -546,6 +680,8 @@ def check_job_dir(job_dir: str) -> Report:
                              rep)
         _check_prom(os.path.join(job_dir, constants.FLEET_PROM_FILE),
                     constants.FLEET_PROM_FILE, rep)
+        _check_fleet_ledger(job_dir, rep)
+        _check_fleet_trace(job_dir, rep)
         if not os.path.exists(os.path.join(job_dir,
                                            constants.JOURNAL_FILE)):
             return rep

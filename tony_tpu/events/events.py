@@ -85,6 +85,15 @@ class EventType(str, enum.Enum):
     # (emitted once per queued→quota-denied transition, not per tick);
     # payload: job, tenant, used, quota.
     FLEET_QUOTA_DENIED = "FLEET_QUOTA_DENIED"
+    # A queued job's not-placed reason TRANSITIONED (the scheduler
+    # decision explainer, tony_tpu/fleet/daemon.py): the policy engine
+    # held the job this tick for a DIFFERENT reason than last tick —
+    # quota / capacity / fragmentation / priority-held / preempt-wait.
+    # Emitted per transition, never per tick (the per-tick stream is the
+    # REC_FLEET_DECISION journal + the in-memory decision ring behind
+    # `tony-tpu fleet explain`); payload: job, action, reason, blocking
+    # (the job ids / tenants holding the capacity).
+    FLEET_JOB_HELD = "FLEET_JOB_HELD"
     # A fleet job reached a terminal state (finished/failed/cancelled);
     # payload: job, state, exit, app_id.
     FLEET_JOB_FINISHED = "FLEET_JOB_FINISHED"

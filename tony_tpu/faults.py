@@ -84,6 +84,17 @@ site                      where it fires
                           the grant waiting on the reclaimed hosts) is
                           retried on a later tick, the victim keeps
                           running
+``fleet.ledger``          fleet goodput-ledger fold (reading a job's
+                          span tree / perf.json / events into phase
+                          accounting) — a firing simulates a corrupt
+                          artifact; the fleet degrades to counters-only
+                          with a one-time warning and the scheduler
+                          tick never blocks or fails
+``fleet.explain``         fleet decision-record journal write
+                          (REC_FLEET_DECISION) — a firing simulates a
+                          full/failed disk on the observability path;
+                          the decision is still applied (ring + event),
+                          one-time warning, scheduling unaffected
 ========================  =====================================================
 
 Spec grammar (the value of ``tony.fault.<site>`` conf keys, or one
@@ -141,7 +152,7 @@ SITES = ("rpc.connect", "rpc.send", "rpc.slow", "heartbeat",
          "pool.lease", "pool.stale", "pool.adopt",
          "host.loss", "resize.barrier", "resize.remesh",
          "profile.capture", "quant.probe", "coord.slow-tick",
-         "fleet.grant", "fleet.preempt")
+         "fleet.grant", "fleet.preempt", "fleet.ledger", "fleet.explain")
 
 
 class InjectedFault(ConnectionError):

@@ -30,6 +30,12 @@ def main(argv: Optional[List[str]] = None) -> int:
     s.add_argument("--cache-root", default="",
                    help="root of the per-model shared compile caches")
     s.add_argument("--tick-s", type=float, default=0.5)
+    s.add_argument("--decision-ring", type=int, default=64,
+                   help="per-job scheduler-decision ring bound "
+                        "(tony.fleet.decision-ring)")
+    s.add_argument("--ledger-interval-s", type=float, default=5.0,
+                   help="goodput-ledger refresh cadence for running "
+                        "jobs (tony.fleet.ledger-interval-s)")
     s.add_argument("--recover", action="store_true",
                    help="replay the fleet journal and resume the queue "
                         "(required when the dir holds non-terminal jobs)")
@@ -42,7 +48,9 @@ def main(argv: Optional[List[str]] = None) -> int:
                              hosts_per_slice=args.hosts_per_slice,
                              quotas=args.quotas, pool_dir=args.pool_dir,
                              cache_root=args.cache_root,
-                             tick_s=args.tick_s, recover=args.recover)
+                             tick_s=args.tick_s, recover=args.recover,
+                             decision_ring=args.decision_ring,
+                             ledger_interval_s=args.ledger_interval_s)
     except (FleetError, ValueError) as e:
         print(f"fleet: {e}", file=sys.stderr)
         return 1

@@ -88,6 +88,16 @@ class FleetClient:
             raise FleetClientError(f"malformed cancel response: {res!r}")
         return res
 
+    def explain(self, job: str) -> dict:
+        """The scheduler decision explainer: the job's causal hold
+        timeline (reason transitions with blockers named) plus its
+        grant/resize/finish milestones."""
+        res = self.call("fleet.explain", job=job)
+        if not isinstance(res, dict):
+            raise FleetClientError(
+                f"malformed explain response: {res!r}")
+        return res
+
     def stop(self) -> None:
         self.call("fleet.stop")
 

@@ -34,6 +34,7 @@ fleet top``.
 
 from __future__ import annotations
 
+import collections
 import json
 import logging
 import os
@@ -41,15 +42,16 @@ import subprocess
 import sys
 import threading
 import time
-from typing import Any, Dict, List, Optional
+from typing import Any, Deque, Dict, List, Optional
 
-from tony_tpu import constants, faults
+from tony_tpu import constants, faults, tracing
 from tony_tpu.conf import keys as K
 from tony_tpu.events.events import Event, EventHandler, EventType
 from tony_tpu.fleet import journal as fjournal
-from tony_tpu.fleet.policy import (CAPACITY_DENIED, GRANT, QUOTA_DENIED,
-                                   SHRINK, JobRequest, PolicyEngine,
-                                   parse_quotas)
+from tony_tpu.fleet import ledger as fledger
+from tony_tpu.fleet.policy import (GRANT, HOLD_ACTIONS, QUOTA_DENIED,
+                                   SHRINK, Decision, JobRequest,
+                                   PolicyEngine, parse_quotas)
 from tony_tpu.metrics import MetricsRegistry
 from tony_tpu.utils.durable import atomic_write
 
@@ -59,6 +61,13 @@ log = logging.getLogger(__name__)
 QUEUED = "QUEUED"
 GRANTED = "GRANTED"
 RUNNING = "RUNNING"
+
+#: queue-wait histogram buckets (seconds): submit→grant waits live in
+#: the seconds-to-minutes range, not the sub-ms RPC-latency range the
+#: default buckets cover — without these, any wait past 10s saturates
+#: the top bucket and p99 reads as a flat 10.0.
+QUEUE_WAIT_BUCKETS_S = (0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 20.0,
+                        40.0, 60.0, 120.0, 300.0, 600.0, 1800.0)
 
 
 class FleetError(RuntimeError):
@@ -77,7 +86,7 @@ def _pid_alive(pid: int) -> bool:
 
 class _FleetJob:
     def __init__(self, req: JobRequest, conf: Dict[str, str],
-                 workdir: str) -> None:
+                 workdir: str, decision_ring: int = 64) -> None:
         self.req = req
         self.conf = conf
         self.workdir = workdir
@@ -92,6 +101,18 @@ class _FleetJob:
         self.wait_s: Optional[float] = None    # queue wait, set at grant
         self.denial = ""                       # last quota/capacity note
         self.cancelled = False
+        # --- observability (ledger + explainer + trace) ----------------
+        # Wall-clock anchors for the goodput ledger (ms; the journal
+        # records carry the same clock, so offline re-folds agree).
+        self.submitted_ms = int(time.time() * 1000)
+        self.granted_ms = 0
+        self.finished_ms = 0
+        self.host_events: List[Any] = []       # [(ts_ms, hosts)]
+        # Bounded hold-reason transition ring behind `fleet explain`.
+        self.decisions: Deque[Dict[str, Any]] = collections.deque(
+            maxlen=max(2, int(decision_ring)))
+        self.queue_span: Any = tracing.NULL_SPAN
+        self.job_span: Any = tracing.NULL_SPAN
 
 
 class _AdoptedHandle:
@@ -245,6 +266,9 @@ class _FleetService:
     def fleet__cancel(self, job: str) -> dict:
         return self._d.cancel(str(job))
 
+    def fleet__explain(self, job: str) -> dict:
+        return self._d.explain(str(job))
+
     def fleet__stop(self) -> bool:
         self._d.request_stop()
         return True
@@ -256,7 +280,9 @@ class FleetDaemon:
                  pool_dir: str = "", cache_root: str = "",
                  tick_s: float = 0.5, recover: bool = False,
                  runner: Optional[Any] = None,
-                 python: str = sys.executable) -> None:
+                 python: str = sys.executable,
+                 decision_ring: int = 64,
+                 ledger_interval_s: float = 5.0) -> None:
         self.fleet_dir = os.path.abspath(os.path.expanduser(fleet_dir))
         os.makedirs(self.fleet_dir, exist_ok=True)
         self.slices = max(1, int(slices))
@@ -265,6 +291,8 @@ class FleetDaemon:
         self.pool_dir = pool_dir
         self.cache_root = cache_root
         self.tick_s = max(0.05, float(tick_s))
+        self.decision_ring = max(2, int(decision_ring))
+        self.ledger_interval_s = max(0.0, float(ledger_interval_s))
         self.history_root = os.path.join(self.fleet_dir, "history")
         self.runner = runner if runner is not None \
             else SubprocessJobRunner(python)
@@ -275,6 +303,16 @@ class FleetDaemon:
         self._lock = threading.Lock()
         self._stop_evt = threading.Event()
         self._started = False
+        # Goodput ledger (fleet/ledger.py): per-job folds + rollup,
+        # refreshed on a throttle; a fold failure (fleet.ledger fault
+        # site) degrades the fleet to counters-only, never a dead tick.
+        self._ledgers: Dict[str, Dict[str, Any]] = {}
+        self._ledger_rollup: Optional[Dict[str, Any]] = None
+        self._ledger_degraded = False
+        self._ledger_next_mono = 0.0
+        self._explain_warned = False
+        self._grant_waits: List[float] = []
+        self._preempts_per_job: Dict[str, int] = {}
 
         journal_path = os.path.join(self.fleet_dir,
                                     constants.FLEET_JOURNAL_FILE)
@@ -293,7 +331,20 @@ class FleetDaemon:
         self.generation = (replayed.generation if replayed else 0) + 1
         self.journal = fjournal.FleetJournal(journal_path)
         self.journal.generation(self.generation, self.slices,
-                                self.hosts_per_slice)
+                                self.hosts_per_slice,
+                                quotas=self.quotas)
+        # Fleet-wide trace (tony_tpu/tracing.py): queue spans, job
+        # lifetimes, preempt/restore instants — and the trace id every
+        # grant injects into its job so `tony-tpu trace --fleet`
+        # renders the whole pool on one timeline. A recovered daemon
+        # rejoins the original trace id (same contract as a recovered
+        # coordinator) and closes the dead life's dangling spans.
+        trace_path = os.path.join(self.fleet_dir, constants.TRACE_FILE)
+        self.tracer = tracing.Tracer(
+            trace_id=tracing.existing_trace_id(trace_path) or None,
+            service="fleet", path=trace_path)
+        if replayed is not None and recover:
+            self._close_stale_spans(trace_path)
 
         self.metrics = MetricsRegistry()
         self._counters_path = os.path.join(self.fleet_dir,
@@ -317,6 +368,25 @@ class FleetDaemon:
         if replayed is not None and recover:
             self._recover(replayed)
 
+    def _close_stale_spans(self, trace_path: str) -> None:
+        """A SIGKILLed daemon life leaves its queue/job spans open (B
+        with no E). The recovering life owns the log: close them with a
+        recovered marker so the fleet export stays zero-unclosed, then
+        open fresh spans for the jobs it re-adopts."""
+        opens: Dict[str, bool] = {}
+        for rec in tracing.load_records(trace_path):
+            span = str(rec.get("span", "") or "")
+            if rec.get("ev") == "B":
+                opens[span] = True
+            elif rec.get("ev") == "E":
+                opens.pop(span, None)
+        now = tracing.now_us()
+        self.tracer.write_records([
+            {"ev": "E", "span": span, "ts_us": now,
+             "args": {"recovered": True,
+                      "note": "closed by the recovering daemon"}}
+            for span in opens])
+
     # -- recovery ---------------------------------------------------------
     def _recover(self, st: fjournal.FleetReplayState) -> None:
         """Rebuild queue + accounting from the replayed journal: queued
@@ -333,16 +403,33 @@ class FleetDaemon:
                              seq=fold.seq)
             job = _FleetJob(req, fold.conf,
                             os.path.join(self.fleet_dir, "jobs",
-                                         fold.job_id))
+                                         fold.job_id),
+                            decision_ring=self.decision_ring)
             job.app_id = fold.app_id
             job.pid = fold.pid
             job.exit_code = fold.exit_code
+            # Ledger anchors + explain ring survive the daemon: the
+            # journal is their write-ahead home, the fold re-seeds them.
+            job.submitted_ms = fold.submitted_ms or job.submitted_ms
+            job.granted_ms = fold.granted_ms
+            job.finished_ms = fold.finished_ms
+            job.host_events = list(fold.host_events)
+            job.decisions.extend(fold.decisions)
+            if fold.decisions:
+                # Restore the dedup fence: the recovered life must not
+                # re-journal the hold reason it already recorded.
+                job.denial = str(fold.decisions[-1].get("reason", ""))
             self.jobs[fold.job_id] = job
             if fold.state in fjournal.TERMINAL_STATES:
                 job.state = fold.state
                 continue
             if fold.state == "QUEUED":
                 self.engine.submit(req)
+                job.queue_span = self.tracer.start_span(
+                    "fleet.queue", task=fold.job_id,
+                    attrs={"tenant": fold.tenant, "recovered": True,
+                           "priority": fold.priority,
+                           "hosts": fold.hosts_requested})
                 continue
             # GRANTED / SPAWNED / RUNNING: the grant stands — decide
             # between adopt, respawn, and post-mortem.
@@ -354,6 +441,10 @@ class FleetDaemon:
                 job.placement = dict(fold.placement)
                 job.handle = _AdoptedHandle(fold.pid, self.history_root,
                                             job)
+                job.job_span = self.tracer.start_span(
+                    "fleet.job", task=fold.job_id,
+                    attrs={"tenant": fold.tenant, "hosts": fold.hosts,
+                           "app_id": app_id or "", "recovered": True})
                 log.info("fleet recover: adopted running job %s "
                          "(client pid %d, app %s)", fold.job_id,
                          fold.pid, app_id or "?")
@@ -372,6 +463,7 @@ class FleetDaemon:
                                    exit_code=exit_code)
                 job.state = state
                 job.exit_code = exit_code
+                job.finished_ms = int(time.time() * 1000)
                 log.info("fleet recover: job %s finished %s while the "
                          "daemon was down", fold.job_id, state)
             else:
@@ -381,6 +473,10 @@ class FleetDaemon:
                 # (the fgen record above licenses the re-grant).
                 self.engine.submit(req)
                 job.state = QUEUED
+                job.queue_span = self.tracer.start_span(
+                    "fleet.queue", task=fold.job_id,
+                    attrs={"tenant": fold.tenant, "recovered": True,
+                           "regrant": True})
                 log.info("fleet recover: re-queued granted-but-never-"
                          "started job %s", fold.job_id)
 
@@ -432,6 +528,17 @@ class FleetDaemon:
         # Final name == in-progress name: the fleet stream is append-only
         # across daemon lives, never finalized like a job's jhist.
         self.events.stop(constants.FLEET_EVENTS_FILE)
+        # Close every span this life still holds open (queued jobs at
+        # daemon stop, jobs still running when the operator stops the
+        # daemon) so an orderly stop leaves zero unclosed spans.
+        with self._lock:
+            jobs = list(self.jobs.values())
+        for job in jobs:
+            job.queue_span.end(daemon_stopped=True)
+            job.queue_span = tracing.NULL_SPAN
+            job.job_span.end(daemon_stopped=True)
+            job.job_span = tracing.NULL_SPAN
+        self.tracer.close()
         self.journal.close()
 
     def _count_event(self, ev: Event) -> None:
@@ -472,7 +579,13 @@ class FleetDaemon:
         self.journal.submit(job_id, tenant, priority, hosts, min_hosts,
                             model, seq, conf)
         job = _FleetJob(req, conf,
-                        os.path.join(self.fleet_dir, "jobs", job_id))
+                        os.path.join(self.fleet_dir, "jobs", job_id),
+                        decision_ring=self.decision_ring)
+        job.queue_span = self.tracer.start_span(
+            "fleet.queue", task=job_id,
+            attrs={"tenant": tenant, "priority": priority,
+                   "hosts": hosts, "min_hosts": min_hosts,
+                   "model": model})
         with self._lock:
             self.jobs[job_id] = job
             self.engine.submit(req)
@@ -495,10 +608,8 @@ class FleetDaemon:
             job.cancelled = True
             if was_queued:
                 self.engine.withdraw(job_id)
-                job.state = fjournal.STATE_CANCELLED
         if was_queued:
-            self.journal.state(job_id, fjournal.STATE_CANCELLED)
-            self._finish_event(job_id, fjournal.STATE_CANCELLED, None)
+            self._finish_job(job_id, fjournal.STATE_CANCELLED, None)
             return {"ok": True, "state": fjournal.STATE_CANCELLED}
         # Running: ask its coordinator to die; the poll loop records the
         # exit as CANCELLED (job.cancelled wins over the exit code).
@@ -508,6 +619,8 @@ class FleetDaemon:
     def status(self) -> dict:
         from tony_tpu.coordinator.coordphases import histogram_quantile
 
+        ledger = self._ledger_snapshot()
+        tenant_ledgers = (ledger or {}).get("tenants", {})
         with self._lock:
             used = self.engine.tenant_used()
             rows = []
@@ -517,6 +630,12 @@ class FleetDaemon:
                 wait = job.wait_s if job.wait_s is not None else (
                     now - job.submitted_mono
                     if job.state == QUEUED else None)
+                last = job.decisions[-1] if job.decisions else None
+                held = ""
+                if job.state == QUEUED and last \
+                        and last.get("action") != "granted":
+                    held = f"{last.get('action')}: " \
+                           f"{last.get('reason', '')}"
                 rows.append({
                     "job": job.req.job_id, "tenant": job.req.tenant,
                     "priority": job.req.priority, "state": job.state,
@@ -525,27 +644,44 @@ class FleetDaemon:
                     "app_id": job.app_id, "exit": job.exit_code,
                     "wait_s": round(wait, 3) if wait is not None
                     else None,
-                    "denial": job.denial})
+                    "denial": job.denial,
+                    "held": held})
             queue_depth = self.engine.queue_depth
             free = self.engine.pool.free_total
         hist = self.metrics.histogram(
             "tony_fleet_queue_wait_seconds",
+            buckets=QUEUE_WAIT_BUCKETS_S,
             help="submit-to-grant wait latency").snapshot()
         total = self.slices * self.hosts_per_slice
+        tenants = {}
+        for t, n in sorted(used.items()):
+            row: Dict[str, Any] = {
+                "used": n, "quota": self.quotas.get(t, 0) or None}
+            lrow = tenant_ledgers.get(t)
+            if lrow is not None:
+                row["goodput"] = lrow.get("goodput_fraction")
+            tenants[t] = row
+        # Tenants with a ledger but nothing running still get a row —
+        # a tenant whose jobs all finished keeps its goodput visible.
+        for t, lrow in sorted(tenant_ledgers.items()):
+            tenants.setdefault(t, {
+                "used": 0, "quota": self.quotas.get(t, 0) or None,
+                "goodput": lrow.get("goodput_fraction")})
         return {
             "fleet_dir": self.fleet_dir, "generation": self.generation,
             "pool": {"slices": self.slices,
                      "hosts_per_slice": self.hosts_per_slice,
                      "total": total, "used": total - free, "free": free},
-            "tenants": {t: {"used": n,
-                            "quota": self.quotas.get(t, 0) or None}
-                        for t, n in sorted(used.items())},
+            "tenants": tenants,
             "queue_depth": queue_depth,
             "jobs": rows,
             "queue_wait": {
                 "p50_s": round(histogram_quantile(hist, 0.5), 4),
                 "p99_s": round(histogram_quantile(hist, 0.99), 4),
                 "count": hist.get("count", 0)},
+            "ledger": ledger,
+            "pool_dir": self.pool_dir,
+            "trace_id": self.tracer.trace_id,
         }
 
     # -- the scheduler tick ----------------------------------------------
@@ -572,25 +708,42 @@ class FleetDaemon:
                 state = fjournal.STATE_FINISHED
             else:
                 state = fjournal.STATE_FAILED
-            self.journal.state(job.req.job_id, state,
-                               app_id=job.app_id, exit_code=int(rc))
-            with self._lock:
-                job.state = state
-                job.exit_code = int(rc)
-                job.handle = None
-                self.engine.release(job.req.job_id)
-            done.append(job)
-            self._finish_event(job.req.job_id, state, int(rc))
+            if self._finish_job(job.req.job_id, state, int(rc)):
+                done.append(job)
         if done:
             log.info("fleet: %d job(s) finished this tick (%s)",
                      len(done), ", ".join(j.req.job_id for j in done))
 
-    def _finish_event(self, job_id: str, state: str,
-                      exit_code: Optional[int]) -> None:
-        job = self.jobs.get(job_id)
+    def _finish_job(self, job_id: str, state: str,
+                    exit_code: Optional[int]) -> bool:
+        """THE terminal-accounting path — every way a fleet job ends
+        (poll exit, cancel, spawn failure, recovery post-mortem) funnels
+        here so the journal record, pool release, span closure, ledger
+        fold and FLEET_JOB_FINISHED event each happen EXACTLY once per
+        job. The terminal claim is atomic under the lock: a cancel RPC
+        racing the poll tick cannot double-book. Returns False when the
+        job was already terminal (nothing re-emitted)."""
+        with self._lock:
+            job = self.jobs.get(job_id)
+            if job is None or job.state in fjournal.TERMINAL_STATES:
+                return False
+            job.state = state
+            job.exit_code = None if exit_code is None else int(exit_code)
+            job.handle = None
+            job.finished_ms = int(time.time() * 1000)
+            self.engine.release(job_id)
+            app_id = job.app_id
+        self.journal.state(job_id, state, app_id=app_id,
+                           exit_code=exit_code)
+        job.queue_span.end(state=state)        # cancelled while queued
+        job.queue_span = tracing.NULL_SPAN
+        job.job_span.end(state=state, exit=exit_code)
+        job.job_span = tracing.NULL_SPAN
+        self._fold_ledger_job(job)
         self.events.emit(Event(EventType.FLEET_JOB_FINISHED, {
             "job": job_id, "state": state, "exit": exit_code,
-            "app_id": job.app_id if job else ""}))
+            "app_id": app_id}))
+        return True
 
     def _discover_apps(self) -> None:
         with self._lock:
@@ -616,22 +769,58 @@ class FleetDaemon:
                 if not self._apply_preempt(d.job_id, d.hosts, d.for_job,
                                            d.reason):
                     return
-            elif d.action in (QUOTA_DENIED, CAPACITY_DENIED):
-                self._note_denial(d.job_id, d.action, d.reason)
+            elif d.action in HOLD_ACTIONS:
+                self._note_decision(d)
 
-    def _note_denial(self, job_id: str, kind: str, reason: str) -> None:
+    def _note_decision(self, d: Decision) -> None:
+        """The scheduler decision explainer's recorder: a queued job's
+        not-placed reason TRANSITIONED. Dedup per transition (never per
+        tick), then three sinks — the bounded per-job ring behind
+        `fleet explain`, a write-ahead REC_FLEET_DECISION journal
+        record (fault site ``fleet.explain``: a failed write warns once
+        and never blocks the decision), and a FLEET_JOB_HELD event."""
         with self._lock:
-            job = self.jobs.get(job_id)
-            if job is None:
+            job = self.jobs.get(d.job_id)
+            if job is None or job.state != QUEUED:
                 return
-            first = job.denial != reason
-            job.denial = reason
-        if first and kind == QUOTA_DENIED:
+            if job.denial == d.reason:
+                return             # same hold as last tick: no news
+            prev_action = job.decisions[-1].get("action") \
+                if job.decisions else ""
+            job.denial = d.reason
+            entry = {"ts_ms": int(time.time() * 1000),
+                     "action": d.action, "reason": d.reason,
+                     "blocking": list(d.blocking), "free": int(d.free)}
+            job.decisions.append(entry)
+        try:
+            faults.check("fleet.explain")
+            self.journal.decision(d.job_id, d.action, d.reason,
+                                  blocking=d.blocking, free=d.free)
+        except faults.InjectedFault as e:
+            if not self._explain_warned:
+                self._explain_warned = True
+                log.warning(
+                    "fleet: decision-record write failed (%s) — the "
+                    "decision ring and events still carry the "
+                    "explainer; the journal will miss hold records "
+                    "until the daemon restarts", e)
+        self.tracer.instant("fleet.held", parent=job.queue_span,
+                            task=d.job_id,
+                            attrs={"action": d.action,
+                                   "reason": d.reason,
+                                   "blocking": list(d.blocking)})
+        self.events.emit(Event(EventType.FLEET_JOB_HELD, {
+            "job": d.job_id, "action": d.action, "reason": d.reason,
+            "blocking": list(d.blocking)}))
+        if d.action == QUOTA_DENIED and prev_action != QUOTA_DENIED:
+            # The legacy quota event dedups on ACTION: a reason-wording
+            # refinement (the blocking list filling in) is a new ring/
+            # journal entry but not a second QUOTA_DENIED episode.
             self.metrics.counter(
                 "tony_fleet_quota_denials_total",
                 help="grants deferred by tenant quota").inc()
             self.events.emit(Event(EventType.FLEET_QUOTA_DENIED, {
-                "job": job_id, "reason": reason}))
+                "job": d.job_id, "reason": d.reason}))
 
     def _grant_overrides(self, job: _FleetJob) -> Dict[str, str]:
         """The fleet's injections on a granted job's conf: granted gang
@@ -650,6 +839,14 @@ class FleetDaemon:
             ov.setdefault(K.JAX_COMPILE_CACHE_DIR,
                           os.path.join(self.cache_root, job.req.model))
         ov.setdefault(K.HISTORY_LOCATION, self.history_root)
+        # Cross-layer trace stitching: the grant stamps the fleet trace
+        # id into the job's conf; the client adopts it instead of
+        # minting its own, so the whole pool renders as ONE Perfetto
+        # timeline (`tony-tpu trace --fleet <fleet_dir>`).
+        if self.tracer.enabled:
+            ov[K.INTERNAL_FLEET_TRACE_ID] = self.tracer.trace_id
+            if getattr(job.job_span, "span_id", ""):
+                ov[K.INTERNAL_FLEET_TRACE_PARENT] = job.job_span.span_id
         return ov
 
     def _apply_grant(self, job_id: str,
@@ -682,18 +879,30 @@ class FleetDaemon:
             job.placement = dict(placement)
             job.wait_s = time.monotonic() - job.submitted_mono
             job.denial = ""
+            job.granted_ms = int(time.time() * 1000)
+            job.host_events = [(job.granted_ms, hosts)]
+            self._grant_waits.append(job.wait_s)
+            del self._grant_waits[:-512]
+            # The grant closes the job's hold timeline in the ring.
+            job.decisions.append({
+                "ts_ms": job.granted_ms, "action": "granted",
+                "reason": f"granted {hosts} host(s) on slice(s) "
+                          f"{sorted(placement)} after "
+                          f"{job.wait_s:.2f}s", "blocking": [],
+                "free": 0})
+        job.queue_span.end(wait_s=round(job.wait_s, 3), granted=True)
+        job.queue_span = tracing.NULL_SPAN
+        job.job_span = self.tracer.start_span(
+            "fleet.job", task=job_id,
+            attrs={"tenant": job.req.tenant, "hosts": hosts,
+                   "placement": {str(i): n
+                                 for i, n in sorted(placement.items())}})
         try:
             popen = self.runner.spawn(job.workdir,
                                       self._grant_overrides(job))
         except OSError as e:
             log.error("fleet: spawn of %s failed: %s", job_id, e)
-            self.journal.state(job_id, fjournal.STATE_FAILED,
-                               exit_code=1)
-            with self._lock:
-                job.state = fjournal.STATE_FAILED
-                job.exit_code = 1
-                self.engine.release(job_id)
-            self._finish_event(job_id, fjournal.STATE_FAILED, 1)
+            self._finish_job(job_id, fjournal.STATE_FAILED, 1)
             return True
         self.journal.state(job_id, fjournal.STATE_SPAWNED,
                            pid=popen.pid)
@@ -705,6 +914,7 @@ class FleetDaemon:
                              help="job grants applied").inc()
         self.metrics.histogram(
             "tony_fleet_queue_wait_seconds",
+            buckets=QUEUE_WAIT_BUCKETS_S,
             help="submit-to-grant wait latency").observe(job.wait_s)
         self.events.emit(Event(EventType.FLEET_JOB_GRANTED, {
             "job": job_id, "tenant": job.req.tenant, "hosts": hosts,
@@ -743,8 +953,16 @@ class FleetDaemon:
                                                        to_hosts)
             victim.hosts = to_hosts
             victim.placement = new_placement
+            victim.host_events.append((int(time.time() * 1000),
+                                       to_hosts))
+            self._preempts_per_job[victim_id] = \
+                self._preempts_per_job.get(victim_id, 0) + 1
         self.journal.preempt(victim_id, from_hosts, to_hosts, for_job,
                              new_placement)
+        self.tracer.instant("fleet.preempt", parent=victim.job_span,
+                            task=victim_id,
+                            attrs={"from": from_hosts, "to": to_hosts,
+                                   "for": for_job, "reason": reason})
         self.metrics.counter(
             "tony_fleet_preemptions_total",
             help="preempt-to-reclaim shrinks applied").inc()
@@ -773,13 +991,146 @@ class FleetDaemon:
                 placement = self.engine.grow_applied(job_id, delta)
                 job.hosts = new_hosts
                 job.placement = placement
+                job.host_events.append((int(time.time() * 1000),
+                                        new_hosts))
             self.journal.state(job_id, fjournal.STATE_RESTORED,
                                hosts=new_hosts, placement=placement)
+            self.tracer.instant("fleet.restore", parent=job.job_span,
+                                task=job_id,
+                                attrs={"hosts": new_hosts})
             log.info("fleet restore: %s grown back to %d host(s)",
                      job_id, new_hosts)
 
+    # -- goodput ledger (tony_tpu/fleet/ledger.py) ------------------------
+    def _ledger_fold_input(self, job: _FleetJob) -> fjournal.JobFold:
+        return fjournal.JobFold(
+            job_id=job.req.job_id, tenant=job.req.tenant,
+            priority=job.req.priority, hosts_requested=job.req.hosts,
+            min_hosts=job.req.min_hosts, model=job.req.model,
+            seq=job.req.seq, state=job.state, hosts=job.hosts,
+            app_id=job.app_id, submitted_ms=job.submitted_ms,
+            granted_ms=job.granted_ms, finished_ms=job.finished_ms,
+            host_events=list(job.host_events))
+
+    def _fold_ledger_job(self, job: _FleetJob,
+                         dirs: Optional[Dict[str, str]] = None) -> None:
+        """Fold ONE job's ledger (terminal jobs fold exactly once, at
+        finish). Fault site ``fleet.ledger``: any failure degrades the
+        fleet to counters-only — goodput gauges and the per-tenant
+        table go absent, the scheduler tick never blocks."""
+        if self._ledger_degraded:
+            return
+        try:
+            faults.check("fleet.ledger")
+            if dirs is None:
+                dirs = fledger.job_history_dirs(self.fleet_dir)
+            self._ledgers[job.req.job_id] = fledger.compute_job_ledger(
+                self._ledger_fold_input(job),
+                job_dir=dirs.get(job.app_id),
+                now_ms=int(time.time() * 1000))
+            self._ledger_rollup = None      # dirty: rebuilt on export
+        except Exception as e:  # noqa: BLE001 — observability, not duty
+            self._ledger_degraded = True
+            log.warning(
+                "fleet: goodput-ledger fold failed (%s) — degrading to "
+                "counters-only (no goodput gauges / per-tenant table) "
+                "for the rest of this daemon life", e)
+
+    def _refresh_ledger(self) -> None:
+        """Throttled refresh for RUNNING jobs (their queued/startup/
+        train phases are provisional and keep growing); terminal jobs
+        folded at finish are left alone."""
+        if self._ledger_degraded:
+            return
+        now = time.monotonic()
+        if now < self._ledger_next_mono:
+            return
+        self._ledger_next_mono = now + self.ledger_interval_s
+        with self._lock:
+            live = [j for j in self.jobs.values()
+                    if j.state not in fjournal.TERMINAL_STATES]
+            missing = [j for j in self.jobs.values()
+                       if j.state in fjournal.TERMINAL_STATES
+                       and j.req.job_id not in self._ledgers]
+        dirs = fledger.job_history_dirs(self.fleet_dir)
+        for job in live + missing:
+            self._fold_ledger_job(job, dirs=dirs)
+            if self._ledger_degraded:
+                return
+
+    def _ledger_snapshot(self) -> Optional[Dict[str, Any]]:
+        if self._ledger_degraded:
+            return None
+        if self._ledger_rollup is None:
+            # list() first: status() runs on RPC threads while the tick
+            # thread folds — never iterate the live dict.
+            self._ledger_rollup = fledger.rollup(
+                list(self._ledgers.values()))
+        return self._ledger_rollup
+
+    # -- the decision explainer's query surface ---------------------------
+    def explain(self, job_id: str) -> dict:
+        """`tony-tpu fleet explain <job>`: the job's causal hold
+        timeline — every recorded reason transition with the blocking
+        jobs/tenants named, plus the grant/preempt/finish milestones."""
+        with self._lock:
+            job = self.jobs.get(job_id)
+            if job is None:
+                return {"ok": False,
+                        "message": f"unknown job {job_id!r}"}
+            decisions = list(job.decisions)
+            milestones: List[Dict[str, Any]] = [
+                {"ts_ms": job.submitted_ms,
+                 "what": f"submitted by tenant {job.req.tenant!r} "
+                         f"(priority {job.req.priority}, "
+                         f"{job.req.hosts} host(s))"}]
+            if job.granted_ms:
+                milestones.append({"ts_ms": job.granted_ms,
+                                   "what": f"granted {job.hosts or '?'}"
+                                           f" host(s)"})
+            for ts, hosts in job.host_events[1:]:
+                milestones.append({"ts_ms": ts,
+                                   "what": f"resized to {hosts} "
+                                           f"host(s)"})
+            if job.finished_ms:
+                milestones.append({"ts_ms": job.finished_ms,
+                                   "what": f"finished {job.state}"})
+            return {"ok": True, "job": job_id, "state": job.state,
+                    "tenant": job.req.tenant, "app_id": job.app_id,
+                    "decisions": decisions, "milestones": milestones}
+
+    def _diagnosis_bundle(self) -> Dict[str, Any]:
+        """The in-memory twin of diagnose.bundle_from_dir — same keys,
+        no file reads, cheap enough for every export."""
+        with self._lock:
+            now = time.monotonic()
+            queue = [{
+                "job": j.req.job_id, "tenant": j.req.tenant,
+                "priority": j.req.priority, "hosts": j.req.hosts,
+                "wait_s": now - j.submitted_mono,
+                "last_decision": j.decisions[-1] if j.decisions else {}}
+                for j in self.jobs.values() if j.state == QUEUED]
+            used = self.engine.tenant_used()
+            waits = sorted(self._grant_waits)
+            per_job = dict(self._preempts_per_job)
+        return {
+            "fleet_dir": self.fleet_dir,
+            "quotas": dict(self.quotas), "tenants_used": used,
+            "queue": queue,
+            "median_grant_wait_s": waits[len(waits) // 2]
+            if waits else 0.0,
+            "grants_total": int(self.metrics.counter(
+                "tony_fleet_grants_total").value),
+            "preemptions_total": int(self.metrics.counter(
+                "tony_fleet_preemptions_total").value),
+            "preempts_per_job": per_job,
+            "ledger": self._ledger_snapshot() or {},
+            "pool_dir": self.pool_dir,
+        }
+
     # -- surfaces ---------------------------------------------------------
     def _export(self) -> None:
+        self._refresh_ledger()
         snap = self.status()
         pool = snap["pool"]
         for state in ("total", "used", "free"):
@@ -803,6 +1154,40 @@ class FleetDaemon:
                                {"tenant": tenant},
                                help="granted hosts per tenant").set(
                 row["used"])
+        ledger = snap.get("ledger")
+        if ledger:
+            # The goodput families (tony_tpu/fleet/ledger.py): absent
+            # entirely while the ledger is degraded — counters-only, the
+            # fleet.ledger fault-site contract.
+            fleet_row = ledger.get("fleet") or {}
+            if fleet_row.get("goodput_fraction") is not None:
+                self.metrics.gauge(
+                    "tony_fleet_goodput_fraction",
+                    help="chip-seconds doing useful train steps / "
+                         "chip-seconds held, per tenant and "
+                         "fleet-wide").set(
+                    fleet_row["goodput_fraction"])
+            for tenant, trow in (ledger.get("tenants") or {}).items():
+                if trow.get("goodput_fraction") is not None:
+                    self.metrics.gauge(
+                        "tony_fleet_goodput_fraction",
+                        {"tenant": tenant}).set(
+                        trow["goodput_fraction"])
+                for phase, secs in (trow.get("phase_chip_s")
+                                    or {}).items():
+                    self.metrics.gauge(
+                        "tony_fleet_phase_seconds",
+                        {"phase": phase, "tenant": tenant},
+                        help="cumulative ledger chip-seconds per "
+                             "goodput phase and tenant").set(secs)
+        try:
+            from tony_tpu.fleet import diagnose as fdiagnose
+
+            fdiagnose.save_incident(
+                self.fleet_dir,
+                fdiagnose.build_incident(self._diagnosis_bundle()))
+        except Exception:  # noqa: BLE001 — diagnosis must degrade
+            log.exception("fleet incident export failed")
         atomic_write(
             os.path.join(self.fleet_dir, constants.FLEET_PROM_FILE),
             self.metrics.render().encode("utf-8"))

@@ -1,0 +1,488 @@
+"""Fleet-level automatic diagnosis: one evidence-backed verdict over the
+whole pool.
+
+The per-job engine (``tony_tpu/diagnosis/``) answers "why did my job
+die"; this is its fleet twin answering "why is the POOL unhealthy" —
+fed by the goodput ledger (``fleet/ledger.py``) and the scheduler
+decision records (``REC_FLEET_DECISION``), in the same rule-engine
+style: every rule emits a Finding with the numbers that fired it, the
+verdict is picked by category precedence, and an unexplained verdict is
+treated as worse than none.
+
+Verdicts (precedence order)::
+
+    STARVATION       a non-quota-held job has waited far beyond the
+                     median grant wait — priority/quota tuning needed
+    QUOTA_SATURATED  a tenant sits at its quota with work queued behind
+                     it — raise the quota or drain the tenant
+    FRAGMENTATION    free hosts EXIST but do not pack into the waiting
+                     gang (sub-slice locality) — min_hosts / defrag
+    PREEMPT_STORM    preemptions dominate grants or one victim is
+                     shrunk over and over — priority bands too close
+    POOL_COLD        a warm pool is configured but starts keep going
+                     cold — the pool is under-sized or mis-mounted
+    FLEET_HEALTHY    none of the above; goodput evidence attached
+
+The daemon recomputes this from its in-memory state every export and
+atomically replaces ``fleet.incident.json`` (fault-gated: a rule-engine
+failure degrades to no-verdict, never a blocked tick); ``tony-tpu fleet
+diagnose`` rebuilds the same bundle OFFLINE from the fleet dir, so the
+verdict survives the daemon. The verdict→knob table lives in
+docs/operations.md ("Fleet triage").
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import logging
+import os
+import time
+from typing import Any, Callable, Dict, List, Optional
+
+from tony_tpu import constants
+
+log = logging.getLogger(__name__)
+
+STARVATION = "STARVATION"
+QUOTA_SATURATED = "QUOTA_SATURATED"
+FRAGMENTATION = "FRAGMENTATION"
+PREEMPT_STORM = "PREEMPT_STORM"
+POOL_COLD = "POOL_COLD"
+FLEET_HEALTHY = "FLEET_HEALTHY"
+
+#: every category the engine can return (golden-matrix test anchor) in
+#: precedence order, most urgent first.
+CATEGORY_PRECEDENCE = (STARVATION, QUOTA_SATURATED, FRAGMENTATION,
+                       PREEMPT_STORM, POOL_COLD, FLEET_HEALTHY)
+
+#: schema version stamped into fleet.incident.json.
+INCIDENT_SCHEMA = 1
+
+# --- thresholds (module constants, tunable in one place) -------------------
+STARVATION_MIN_WAIT_S = 30.0     # absolute floor before anyone starves
+STARVATION_FACTOR = 5.0          # × median grant wait
+PREEMPT_STORM_MIN = 3            # absolute preemption floor
+PREEMPT_STORM_RATIO = 0.5        # preemptions / grants
+PREEMPT_STORM_PER_JOB = 3        # one victim shrunk this often
+POOL_COLD_MIN_STARTS = 4         # starts before cold-fraction is signal
+POOL_COLD_WARM_FRACTION = 0.5    # below this with a pool = cold
+
+#: verdict → the knob to spend it on (rendered by the CLI/portal; the
+#: full table with context is the Fleet triage runbook).
+_ADVICE = {
+    STARVATION: "a job is starving behind the queue — raise its "
+                "priority, lower the blocker's, or widen the "
+                "blocking tenant's quota headroom",
+    QUOTA_SATURATED: "the tenant is quota-bound, not capacity-bound — "
+                     "raise tony.fleet.quotas for the tenant or drain "
+                     "its running jobs",
+    FRAGMENTATION: "free hosts exist but do not pack — submit with "
+                   "min_hosts so the scheduler can shrink-to-fit, or "
+                   "prefer slice-sized gangs (the defragmentation move "
+                   "is ROADMAP item 3's live migration)",
+    PREEMPT_STORM: "preemption is churning the pool — widen the "
+                   "priority bands or raise victims' min_hosts floors "
+                   "so each shrink reclaims more",
+    POOL_COLD: "starts keep going cold despite a warm pool — raise "
+               "tony.pool.size (and check tony.fleet.pool-dir reaches "
+               "every grant)",
+    FLEET_HEALTHY: "the pool keeps up — no scheduler knob indicated",
+}
+
+
+@dataclasses.dataclass
+class Finding:
+    category: str
+    rule: str
+    summary: str
+    confidence: float = 0.5
+    evidence: List[str] = dataclasses.field(default_factory=list)
+    details: Dict[str, Any] = dataclasses.field(default_factory=dict)
+
+    def to_dict(self) -> Dict[str, Any]:
+        d = dataclasses.asdict(self)
+        d["advice"] = _ADVICE[self.category]
+        return d
+
+
+_RULES: List[Callable[[Dict[str, Any]], Optional[Finding]]] = []
+
+
+def _rule(fn: Callable[[Dict[str, Any]], Optional[Finding]]):
+    _RULES.append(fn)
+    return fn
+
+
+def _queued(bundle: Dict[str, Any]) -> List[Dict[str, Any]]:
+    return [r for r in bundle.get("queue", []) if isinstance(r, dict)]
+
+
+@_rule
+def _starvation(b: Dict[str, Any]) -> Optional[Finding]:
+    median = float(b.get("median_grant_wait_s", 0.0) or 0.0)
+    floor = max(STARVATION_MIN_WAIT_S, STARVATION_FACTOR * median)
+    worst = None
+    for row in _queued(b):
+        if (row.get("last_decision") or {}).get("action") == "quota":
+            continue             # quota-held is its own verdict
+        wait = float(row.get("wait_s", 0.0) or 0.0)
+        if wait >= floor and (worst is None
+                              or wait > worst["wait_s"]):
+            worst = {"job": row.get("job"), "wait_s": wait,
+                     "decision": row.get("last_decision") or {}}
+    if worst is None:
+        return None
+    dec = worst["decision"]
+    ev = [f"queue: {worst['job']} has waited {worst['wait_s']:.0f}s "
+          f"(threshold max({STARVATION_MIN_WAIT_S:.0f}s, "
+          f"{STARVATION_FACTOR:.0f}x median grant wait "
+          f"{median:.1f}s))"]
+    if dec:
+        ev.append(f"last hold: [{dec.get('action')}] "
+                  f"{dec.get('reason', '?')}")
+        if dec.get("blocking"):
+            ev.append(f"blocking: {dec['blocking']}")
+    return Finding(STARVATION, "starvation",
+                   f"job {worst['job']} is starving in the queue",
+                   confidence=0.85, evidence=ev,
+                   details={"job": worst["job"],
+                            "wait_s": round(worst["wait_s"], 1)})
+
+
+@_rule
+def _quota_saturated(b: Dict[str, Any]) -> Optional[Finding]:
+    quotas = b.get("quotas") or {}
+    used = b.get("tenants_used") or {}
+    hits = []
+    for row in _queued(b):
+        dec = row.get("last_decision") or {}
+        if dec.get("action") != "quota":
+            continue
+        tenant = str(row.get("tenant", "") or "")
+        quota = int(quotas.get(tenant, 0) or 0)
+        if quota > 0:
+            hits.append((tenant, quota, row, dec))
+    if not hits:
+        return None
+    tenant, quota, row, dec = hits[0]
+    queued_jobs = sorted({str(r.get("job")) for t, _, r, _ in
+                          [(h[0], h[1], h[2], h[3]) for h in hits]
+                          if t == tenant})
+    ev = [f"tenant {tenant!r} uses {used.get(tenant, 0)}/{quota} "
+          f"quota hosts with {len(queued_jobs)} job(s) quota-held: "
+          f"{queued_jobs}",
+          f"last hold ({row.get('job')}): {dec.get('reason', '?')}"]
+    if dec.get("blocking"):
+        ev.append(f"blocking (the tenant's own running jobs): "
+                  f"{dec['blocking']}")
+    return Finding(QUOTA_SATURATED, "quota-saturated",
+                   f"tenant {tenant!r} is saturated at its "
+                   f"{quota}-host quota with work queued behind it",
+                   confidence=0.9, evidence=ev,
+                   details={"tenant": tenant, "quota": quota,
+                            "queued": queued_jobs})
+
+
+@_rule
+def _fragmentation(b: Dict[str, Any]) -> Optional[Finding]:
+    for row in _queued(b):
+        dec = row.get("last_decision") or {}
+        if dec.get("action") != "capacity":
+            continue
+        free = int(dec.get("free", 0) or 0)
+        hosts = int(row.get("hosts", 0) or 0)
+        if hosts and free >= hosts:
+            ev = [f"queue: {row.get('job')} wants {hosts} host(s); "
+                  f"{free} are FREE but do not pack (sub-slice gangs "
+                  f"need one slice)",
+                  f"hold: {dec.get('reason', '?')}"]
+            if dec.get("blocking"):
+                ev.append(f"largest holders: {dec['blocking']}")
+            return Finding(
+                FRAGMENTATION, "fragmentation",
+                f"the pool has {free} free host(s) that cannot pack "
+                f"a waiting {hosts}-host gang",
+                confidence=0.85, evidence=ev,
+                details={"job": row.get("job"), "free": free,
+                         "hosts": hosts})
+    return None
+
+
+@_rule
+def _preempt_storm(b: Dict[str, Any]) -> Optional[Finding]:
+    preempts = int(b.get("preemptions_total", 0) or 0)
+    grants = int(b.get("grants_total", 0) or 0)
+    per_job = b.get("preempts_per_job") or {}
+    worst = max(per_job.items(), key=lambda kv: kv[1]) \
+        if per_job else ("", 0)
+    ratio = preempts / grants if grants else 0.0
+    storm = (preempts >= PREEMPT_STORM_MIN
+             and ratio >= PREEMPT_STORM_RATIO) \
+        or worst[1] >= PREEMPT_STORM_PER_JOB
+    if not storm:
+        return None
+    ev = [f"counters: {preempts} preemption(s) against {grants} "
+          f"grant(s) (ratio {ratio:.2f}, threshold "
+          f"{PREEMPT_STORM_RATIO})"]
+    if worst[1]:
+        ev.append(f"worst victim: {worst[0]} shrunk {worst[1]} time(s) "
+                  f"(threshold {PREEMPT_STORM_PER_JOB})")
+    return Finding(PREEMPT_STORM, "preempt-storm",
+                   "preempt-to-reclaim is churning the pool",
+                   confidence=0.8, evidence=ev,
+                   details={"preemptions": preempts, "grants": grants,
+                            "worst_victim": worst[0]})
+
+
+@_rule
+def _pool_cold(b: Dict[str, Any]) -> Optional[Finding]:
+    if not b.get("pool_dir"):
+        return None
+    fleet = (b.get("ledger") or {}).get("fleet") or {}
+    starts = int(fleet.get("warm_starts", 0) or 0) \
+        + int(fleet.get("cold_starts", 0) or 0)
+    frac = fleet.get("warm_start_fraction")
+    if starts < POOL_COLD_MIN_STARTS or frac is None \
+            or float(frac) >= POOL_COLD_WARM_FRACTION:
+        return None
+    return Finding(
+        POOL_COLD, "pool-cold",
+        f"only {float(frac):.0%} of {starts} start(s) adopted a warm "
+        f"executor despite a configured pool",
+        confidence=0.75,
+        evidence=[f"ledger: warm_start_fraction = {float(frac):.2f} "
+                  f"over {starts} start(s) (threshold "
+                  f"{POOL_COLD_WARM_FRACTION})",
+                  f"pool: {b.get('pool_dir')}"],
+        details={"warm_start_fraction": frac, "starts": starts})
+
+
+@_rule
+def _healthy(b: Dict[str, Any]) -> Optional[Finding]:
+    fleet = (b.get("ledger") or {}).get("fleet") or {}
+    gp = fleet.get("goodput_fraction")
+    ev = [f"queue depth {len(_queued(b))}, "
+          f"{int(b.get('grants_total', 0) or 0)} grant(s), "
+          f"{int(b.get('preemptions_total', 0) or 0)} preemption(s)"]
+    if gp is not None:
+        ev.append(f"ledger: fleet goodput_fraction = {float(gp):.2f} "
+                  f"over {fleet.get('held_chip_s', 0)} chip-seconds "
+                  f"held")
+    return Finding(FLEET_HEALTHY, "healthy",
+                   "no fleet-level pathology above threshold",
+                   confidence=0.5, evidence=ev)
+
+
+def run_rules(bundle: Dict[str, Any]) -> List[Finding]:
+    """All findings, verdict candidate first (precedence, then
+    confidence). A broken rule downgrades to absent — diagnosis must
+    degrade, never die (the daemon calls this on its tick path)."""
+    findings: List[Finding] = []
+    for fn in _RULES:
+        try:
+            f = fn(bundle)
+        except Exception:  # noqa: BLE001 — degrade, never die
+            log.exception("fleet diagnosis rule %s failed",
+                          getattr(fn, "__name__", "?"))
+            continue
+        if f is not None:
+            findings.append(f)
+    prec = {c: i for i, c in enumerate(CATEGORY_PRECEDENCE)}
+    findings.sort(key=lambda f: (prec.get(f.category, len(prec)),
+                                 -f.confidence))
+    return findings
+
+
+def build_incident(bundle: Dict[str, Any]) -> Dict[str, Any]:
+    findings = run_rules(bundle)
+    verdict = findings[0] if findings else Finding(
+        FLEET_HEALTHY, "none", "no findings", confidence=0.0)
+    fleet = (bundle.get("ledger") or {}).get("fleet") or {}
+    return {
+        "schema": INCIDENT_SCHEMA,
+        "generated_ms": int(time.time() * 1000),
+        "fleet_dir": bundle.get("fleet_dir", ""),
+        "verdict": verdict.to_dict(),
+        "findings": [f.to_dict() for f in findings],
+        "goodput_fraction": fleet.get("goodput_fraction"),
+        "queue_depth": len(_queued(bundle)),
+        "grants_total": int(bundle.get("grants_total", 0) or 0),
+        "preemptions_total": int(bundle.get("preemptions_total", 0)
+                                 or 0),
+    }
+
+
+def save_incident(fleet_dir: str, doc: Dict[str, Any]) -> None:
+    """Atomic replace — readers see a whole document or the previous
+    one, the incident.json discipline."""
+    from tony_tpu.utils.durable import atomic_write
+
+    atomic_write(os.path.join(fleet_dir, constants.FLEET_INCIDENT_FILE),
+                 json.dumps(doc, indent=1, sort_keys=True
+                            ).encode("utf-8"))
+
+
+def load_incident(fleet_dir: str) -> Optional[Dict[str, Any]]:
+    try:
+        with open(os.path.join(fleet_dir,
+                               constants.FLEET_INCIDENT_FILE),
+                  encoding="utf-8") as f:
+            doc = json.load(f)
+    except (OSError, ValueError):
+        return None
+    return doc if isinstance(doc, dict) else None
+
+
+def bundle_from_dir(fleet_dir: str,
+                    now_ms: Optional[int] = None) -> Dict[str, Any]:
+    """Rebuild the diagnosis bundle OFFLINE from a fleet dir — journal
+    replay + ledger fold + the replayed decision history; works on a
+    dir copied off a dead host, no daemon needed."""
+    from tony_tpu.fleet import journal as fjournal
+    from tony_tpu.fleet import ledger as fledger
+
+    path = os.path.join(fleet_dir, constants.FLEET_JOURNAL_FILE)
+    st = fjournal.replay(path)
+    now = int(now_ms or time.time() * 1000)
+    led = fledger.fold_fleet_dir(fleet_dir, now_ms=now)
+    queue: List[Dict[str, Any]] = []
+    grant_waits: List[float] = []
+    preempts_per_job: Dict[str, int] = {}
+    grants = preempts = 0
+    used: Dict[str, int] = {}
+    for fold in st.jobs.values():
+        if fold.granted_ms:
+            grants += 1
+            grant_waits.append(
+                max(0.0, (fold.granted_ms - fold.submitted_ms) / 1000.0))
+        if fold.state == "QUEUED":
+            queue.append({
+                "job": fold.job_id, "tenant": fold.tenant,
+                "priority": fold.priority,
+                "hosts": fold.hosts_requested,
+                "wait_s": max(0.0, (now - fold.submitted_ms) / 1000.0)
+                if fold.submitted_ms else 0.0,
+                "last_decision": fold.decisions[-1]
+                if fold.decisions else {}})
+        elif fold.state not in fjournal.TERMINAL_STATES \
+                and fold.hosts:
+            used[fold.tenant] = used.get(fold.tenant, 0) + fold.hosts
+    # preemption counts come from the raw records (the fold keeps only
+    # the final placement)
+    records, _ = _raw_records(path)
+    for rec in records:
+        if rec.get("t") == fjournal.REC_FLEET_PREEMPT:
+            job = str(rec.get("job", "") or "")
+            preempts += 1
+            preempts_per_job[job] = preempts_per_job.get(job, 0) + 1
+    grant_waits.sort()
+    median = grant_waits[len(grant_waits) // 2] if grant_waits else 0.0
+    pool_dir = ""
+    for fold in st.jobs.values():
+        pool_dir = pool_dir or fold.conf.get("tony.pool.dir", "")
+    return {
+        "fleet_dir": fleet_dir,
+        "quotas": dict(st.quotas), "tenants_used": used, "queue": queue,
+        "median_grant_wait_s": round(median, 3),
+        "grants_total": grants, "preemptions_total": preempts,
+        "preempts_per_job": preempts_per_job,
+        "ledger": {"tenants": led.get("tenants", {}),
+                   "fleet": led.get("fleet", {})},
+        "pool_dir": pool_dir,
+    }
+
+
+def _raw_records(path: str):
+    from tony_tpu.devtools.invariants import _iter_journal_records
+
+    recs, torn = _iter_journal_records(path)
+    return [r for _, r in recs], torn
+
+
+def offline_explain(fleet_dir: str, job_id: str) -> Dict[str, Any]:
+    """`fleet explain` without a daemon: rebuild the job's hold
+    timeline from the replayed REC_FLEET_DECISION records — the same
+    response shape as the fleet.explain RPC."""
+    from tony_tpu.fleet import journal as fjournal
+
+    st = fjournal.replay(os.path.join(fleet_dir,
+                                      constants.FLEET_JOURNAL_FILE))
+    fold = st.jobs.get(job_id)
+    if fold is None:
+        return {"ok": False,
+                "message": f"unknown job {job_id!r} in the journal "
+                           f"under {fleet_dir}"}
+    milestones: List[Dict[str, Any]] = [
+        {"ts_ms": fold.submitted_ms,
+         "what": f"submitted by tenant {fold.tenant!r} (priority "
+                 f"{fold.priority}, {fold.hosts_requested} host(s))"}]
+    if fold.granted_ms:
+        milestones.append({"ts_ms": fold.granted_ms,
+                           "what": f"granted {fold.hosts or '?'} "
+                                   f"host(s)"})
+    for ts, hosts in fold.host_events[1:]:
+        milestones.append({"ts_ms": ts,
+                           "what": f"resized to {hosts} host(s)"})
+    if fold.finished_ms:
+        milestones.append({"ts_ms": fold.finished_ms,
+                           "what": f"finished {fold.state}"})
+    return {"ok": True, "job": job_id, "state": fold.state,
+            "tenant": fold.tenant, "app_id": fold.app_id,
+            "decisions": list(fold.decisions),
+            "milestones": milestones, "offline": True}
+
+
+def render_explain(doc: Dict[str, Any]) -> str:
+    """The causal hold timeline, human-readable: decisions and
+    milestones merged in time order, blockers named per hold."""
+    import datetime
+
+    def hhmmss(ts_ms: int) -> str:
+        if not ts_ms:
+            return "--:--:--.---"
+        dt = datetime.datetime.fromtimestamp(ts_ms / 1000.0)
+        return dt.strftime("%H:%M:%S.") + f"{ts_ms % 1000:03d}"
+
+    rows: List[Dict[str, Any]] = []
+    for m in doc.get("milestones", []):
+        rows.append({"ts_ms": int(m.get("ts_ms", 0) or 0),
+                     "line": m.get("what", "?"), "blocking": []})
+    for d in doc.get("decisions", []):
+        rows.append({"ts_ms": int(d.get("ts_ms", 0) or 0),
+                     "line": f"[{d.get('action', '?')}] "
+                             f"{d.get('reason', '?')}",
+                     "blocking": d.get("blocking") or []})
+    rows.sort(key=lambda r: r["ts_ms"])
+    out = [f"{doc.get('job', '?')} (tenant {doc.get('tenant', '?')}) "
+           f"— {doc.get('state', '?')}"
+           + (f"  app={doc['app_id']}" if doc.get("app_id") else "")
+           + ("  [offline: journal replay]" if doc.get("offline")
+              else "")]
+    if not rows:
+        out.append("  (no recorded decisions — the job was never held)")
+    for r in rows:
+        out.append(f"  {hhmmss(r['ts_ms'])}  {r['line']}")
+        if r["blocking"]:
+            out.append(f"  {'':14}blocking: "
+                       f"{', '.join(str(b) for b in r['blocking'])}")
+    return "\n".join(out)
+
+
+def render_text(doc: Dict[str, Any]) -> str:
+    v = doc.get("verdict") or {}
+    lines = [f"fleet verdict: {v.get('category', '?')}  "
+             f"(confidence {v.get('confidence', 0)})",
+             f"  {v.get('summary', '')}",
+             f"  advice: {v.get('advice', '')}"]
+    for e in v.get("evidence", []):
+        lines.append(f"  evidence: {e}")
+    others = [f for f in doc.get("findings", [])
+              if f.get("rule") != v.get("rule")]
+    for f in others:
+        lines.append(f"  also: [{f.get('category')}] "
+                     f"{f.get('summary')}")
+    gp = doc.get("goodput_fraction")
+    if gp is not None:
+        lines.append(f"  fleet goodput: {float(gp):.1%}")
+    return "\n".join(lines)

@@ -26,7 +26,7 @@ import json
 import logging
 import os
 import time
-from typing import Any, Dict, List, Optional
+from typing import Any, Dict, List, Optional, Tuple
 
 from tony_tpu.utils.durable import AppendLog
 
@@ -39,6 +39,18 @@ REC_FLEET_SUBMIT = "fsubmit"    # a submission entered the queue
 REC_FLEET_GRANT = "fgrant"      # capacity granted (write-ahead of spawn)
 REC_FLEET_PREEMPT = "fpreempt"  # victim shrunk to reclaim hosts
 REC_FLEET_STATE = "fstate"      # job state transition (spawned/running/...)
+# Scheduler decision explainer (tony-tpu fleet explain): a queued job's
+# not-placed reason TRANSITIONED — quota / capacity / fragmentation /
+# priority-held / preempt-wait, with the blocking jobs/tenants named.
+# Written per transition (never per tick — the dedup is part of the
+# contract, checked by the fleet-decision invariant) so the journal
+# holds the job's full causal hold timeline without per-tick bloat.
+REC_FLEET_DECISION = "fdecision"
+
+#: in-fold cap on per-job decision history (the journal keeps all of it
+#: on disk; the replayed fold only needs enough to seed the explain
+#: ring and the dedup fence).
+DECISION_FOLD_CAP = 64
 
 #: job states the fstate record carries (QUEUED/GRANTED are implied by
 #: fsubmit/fgrant; these are the post-grant lifecycle).
@@ -73,6 +85,20 @@ class JobFold:
     app_id: str = ""
     pid: int = 0
     exit_code: Optional[int] = None
+    # --- goodput-ledger anchors (tony_tpu/fleet/ledger.py) -------------
+    submitted_ms: int = 0          # fsubmit ts
+    granted_ms: int = 0            # latest fgrant ts (re-grants supersede)
+    finished_ms: int = 0           # terminal fstate ts
+    #: piecewise host count over the granted life: (ts_ms, hosts) at the
+    #: grant, each preempt shrink, and each grow-back restore — the
+    #: chip-second integrand.
+    host_events: List[Tuple[int, int]] = dataclasses.field(
+        default_factory=list)
+    #: replayed decision history (capped at DECISION_FOLD_CAP): dicts of
+    #: {ts_ms, action, reason, blocking, free} — seeds the recovered
+    #: daemon's explain ring and the offline explain fallback.
+    decisions: List[Dict[str, Any]] = dataclasses.field(
+        default_factory=list)
 
 
 @dataclasses.dataclass
@@ -82,6 +108,7 @@ class FleetReplayState:
     generation: int = 0
     slices: int = 0
     hosts_per_slice: int = 0
+    quotas: Dict[str, int] = dataclasses.field(default_factory=dict)
     seq: int = 0                   # highest submission sequence seen
     jobs: Dict[str, JobFold] = dataclasses.field(default_factory=dict)
     records: int = 0
@@ -111,10 +138,13 @@ class FleetJournal:
 
     # -- typed appenders --------------------------------------------------
     def generation(self, generation: int, slices: int,
-                   hosts_per_slice: int) -> None:
+                   hosts_per_slice: int,
+                   quotas: Optional[Dict[str, int]] = None) -> None:
         self.append({"t": REC_FLEET_GEN, "generation": int(generation),
                      "slices": int(slices),
-                     "hosts_per_slice": int(hosts_per_slice)})
+                     "hosts_per_slice": int(hosts_per_slice),
+                     "quotas": {str(t): int(q)
+                                for t, q in (quotas or {}).items()}})
 
     def submit(self, job_id: str, tenant: str, priority: int, hosts: int,
                min_hosts: int, model: str, seq: int,
@@ -141,6 +171,18 @@ class FleetJournal:
                      "for": for_job,
                      "placement": {str(i): int(n)
                                    for i, n in placement.items()}})
+
+    def decision(self, job_id: str, action: str, reason: str,
+                 blocking: Optional[List[str]] = None,
+                 free: int = 0) -> None:
+        """One hold-reason transition for a queued job (the explainer's
+        write-ahead stream). Callers dedup on reason — two consecutive
+        identical records for one job violate the fleet-decision
+        invariant."""
+        self.append({"t": REC_FLEET_DECISION, "job": job_id,
+                     "action": str(action), "reason": str(reason),
+                     "blocking": [str(b) for b in (blocking or [])],
+                     "free": int(free)})
 
     def state(self, job_id: str, state: str, app_id: str = "",
               pid: int = 0, exit_code: Optional[int] = None,
@@ -206,12 +248,18 @@ def replay(path: str) -> FleetReplayState:
             break
         state.records += 1
         t = rec.get("t")
+        ts_ms = int(rec.get("ts", 0) or 0)
         if t == REC_FLEET_GEN:
             state.generation = max(state.generation,
                                    int(rec.get("generation", 0) or 0))
             state.slices = int(rec.get("slices", 0) or 0)
             state.hosts_per_slice = int(
                 rec.get("hosts_per_slice", 0) or 0)
+            for t, q in (rec.get("quotas") or {}).items():
+                try:
+                    state.quotas[str(t)] = int(q)
+                except (TypeError, ValueError):
+                    continue
         elif t == REC_FLEET_SUBMIT:
             job = str(rec.get("job", "") or "")
             seq = int(rec.get("seq", 0) or 0)
@@ -223,7 +271,8 @@ def replay(path: str) -> FleetReplayState:
                 min_hosts=int(rec.get("min_hosts", 0) or 0),
                 model=str(rec.get("model", "") or ""), seq=seq,
                 conf={str(k): str(v)
-                      for k, v in (rec.get("conf") or {}).items()})
+                      for k, v in (rec.get("conf") or {}).items()},
+                submitted_ms=ts_ms)
         elif t == REC_FLEET_GRANT:
             fold = state.jobs.get(str(rec.get("job", "") or ""))
             if fold is None:
@@ -231,12 +280,27 @@ def replay(path: str) -> FleetReplayState:
             fold.state = "GRANTED"
             fold.hosts = int(rec.get("hosts", 0) or 0)
             fold.placement = _placement(rec)
+            fold.granted_ms = ts_ms
+            fold.host_events = [(ts_ms, fold.hosts)]
         elif t == REC_FLEET_PREEMPT:
             fold = state.jobs.get(str(rec.get("job", "") or ""))
             if fold is None:
                 continue
             fold.hosts = int(rec.get("to", fold.hosts) or 0)
             fold.placement = _placement(rec)
+            fold.host_events.append((ts_ms, fold.hosts))
+        elif t == REC_FLEET_DECISION:
+            fold = state.jobs.get(str(rec.get("job", "") or ""))
+            if fold is None:
+                continue           # unknown job: invariants flag it
+            fold.decisions.append({
+                "ts_ms": ts_ms,
+                "action": str(rec.get("action", "") or ""),
+                "reason": str(rec.get("reason", "") or ""),
+                "blocking": [str(b)
+                             for b in (rec.get("blocking") or [])],
+                "free": int(rec.get("free", 0) or 0)})
+            del fold.decisions[:-DECISION_FOLD_CAP]
         elif t == REC_FLEET_STATE:
             fold = state.jobs.get(str(rec.get("job", "") or ""))
             if fold is None:
@@ -249,11 +313,14 @@ def replay(path: str) -> FleetReplayState:
                 fold.pid = int(rec["pid"])
             if "exit" in rec:
                 fold.exit_code = int(rec["exit"])
+            if st in TERMINAL_STATES:
+                fold.finished_ms = ts_ms
             if st == STATE_RESTORED:
                 fold.hosts = int(rec.get("hosts", fold.hosts) or 0)
                 if rec.get("placement") is not None:
                     fold.placement = _placement(rec)
                 fold.state = STATE_RUNNING
+                fold.host_events.append((ts_ms, fold.hosts))
         else:
             log.warning("fleet journal %s: unknown record type %r "
                         "skipped", path, t)

@@ -46,6 +46,17 @@ GRANT = "grant"
 SHRINK = "shrink"          # preempt-to-reclaim: victim shrinks via resize
 QUOTA_DENIED = "quota"     # tenant at quota: stays queued, never holds
 CAPACITY_DENIED = "capacity"  # pool full and nothing preemptible: holds
+# Explainer-only decisions (tony-tpu fleet explain): the policy engine
+# states why every OTHER queued job did not place this pass, not just
+# the head of the line. The daemon records them (decision ring +
+# REC_FLEET_DECISION journal) and applies nothing.
+PREEMPT_WAIT = "preempt-wait"  # head job: shrinks planned, reclaim landing
+PRIORITY_HELD = "held"         # queued behind the head-of-line hold
+
+#: decisions that hold a job in the queue (vs. act on the pool) — the
+#: set the daemon's decision recorder consumes.
+HOLD_ACTIONS = (QUOTA_DENIED, CAPACITY_DENIED, PREEMPT_WAIT,
+                PRIORITY_HELD)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -73,6 +84,13 @@ class Decision:
     placement: Dict[int, int] = dataclasses.field(default_factory=dict)
     reason: str = ""
     for_job: str = ""                    # SHRINK: the demanding job
+    #: the jobs/tenants holding the capacity this decision waits on —
+    #: the explainer's "who is blocking me" answer (hold decisions only)
+    blocking: List[str] = dataclasses.field(default_factory=list)
+    #: free hosts in the pool when a capacity hold was computed: free >=
+    #: requested means the hosts EXIST but do not pack — fragmentation,
+    #: not capacity (the fleet-diagnose FRAGMENTATION rule keys off it)
+    free: int = 0
 
 
 @dataclasses.dataclass
@@ -268,13 +286,12 @@ class PolicyEngine:
         plan: List[Decision] = []
         tentative = self.pool.clone()
         used = self.tenant_used()
-        for req in self.queued_order():
-            quota = self.quotas.get(req.tenant, 0)
-            if quota > 0 and used.get(req.tenant, 0) + req.hosts > quota:
-                plan.append(Decision(
-                    QUOTA_DENIED, req.job_id, hosts=req.hosts,
-                    reason=f"tenant {req.tenant!r} at quota "
-                           f"({used.get(req.tenant, 0)}/{quota} hosts)"))
+        queue = self.queued_order()
+        head_id = ""
+        for pos, req in enumerate(queue):
+            quota_hold = self._quota_hold(req, used)
+            if quota_hold is not None:
+                plan.append(quota_hold)
                 continue            # quota never blocks other tenants
             placement = tentative.place(req.hosts)
             if placement is not None:
@@ -283,20 +300,71 @@ class PolicyEngine:
                 plan.append(Decision(GRANT, req.job_id, hosts=req.hosts,
                                      placement=placement))
                 continue
+            free = tentative.free_total
             shrinks = self._plan_preemption(req, tentative)
             if shrinks:
                 plan.extend(shrinks)
+                victims = [d.job_id for d in shrinks]
+                plan.append(Decision(
+                    PREEMPT_WAIT, req.job_id, hosts=req.hosts, free=free,
+                    blocking=victims,
+                    reason=f"reclaiming {max(0, req.hosts - free)} "
+                           f"host(s) via elastic shrink of {victims} "
+                           f"(priority {req.priority}); the grant lands "
+                           f"once the drain completes"))
             else:
+                holders = self._largest_holders()
+                if free >= req.hosts:
+                    why = (f"fragmentation: {free} free host(s) exist "
+                           f"but do not pack into a {req.hosts}-host "
+                           f"gang (sub-slice gangs need ONE slice)")
+                else:
+                    why = (f"{req.hosts} hosts do not fit ({free} free) "
+                           f"and no lower-priority elastic capacity "
+                           f"exists")
                 plan.append(Decision(
                     CAPACITY_DENIED, req.job_id, hosts=req.hosts,
-                    reason=f"{req.hosts} hosts do not fit "
-                           f"({tentative.free_total} free) and no "
-                           f"lower-priority elastic capacity exists"))
+                    free=free, blocking=holders, reason=why))
             # Head-of-line hold: the reclaimed (or awaited) hosts belong
             # to THIS job; granting anything behind it would re-consume
-            # them and starve the large/high-priority job forever.
+            # them and starve the large/high-priority job forever. The
+            # rest of the queue still gets an EXPLAINER decision each —
+            # quota-denied where at quota, priority-held otherwise.
+            head_id = req.job_id
+            for later in queue[pos + 1:]:
+                hold = self._quota_hold(later, used)
+                if hold is None:
+                    hold = Decision(
+                        PRIORITY_HELD, later.job_id, hosts=later.hosts,
+                        free=free, blocking=[head_id],
+                        reason=f"held behind {head_id!r} (priority "
+                               f"{req.priority}, seq {req.seq}) — "
+                               f"head-of-line hold, no backfill")
+                plan.append(hold)
             break
         return plan
+
+    def _quota_hold(self, req: JobRequest,
+                    used: Dict[str, int]) -> Optional[Decision]:
+        quota = self.quotas.get(req.tenant, 0)
+        if quota <= 0 or used.get(req.tenant, 0) + req.hosts <= quota:
+            return None
+        blocking = sorted(
+            r.req.job_id for r in self._running.values()
+            if r.req.tenant == req.tenant)
+        return Decision(
+            QUOTA_DENIED, req.job_id, hosts=req.hosts,
+            blocking=blocking or [req.tenant],
+            reason=f"tenant {req.tenant!r} at quota "
+                   f"({used.get(req.tenant, 0)}/{quota} hosts; running: "
+                   f"{blocking or 'none'})")
+
+    def _largest_holders(self, limit: int = 5) -> List[str]:
+        """Running jobs holding the most hosts — the 'who is blocking
+        me' answer on a capacity hold."""
+        holders = sorted(self._running.values(),
+                         key=lambda r: (-r.hosts, r.req.seq))
+        return [r.req.job_id for r in holders[:limit]]
 
     def _plan_preemption(self, req: JobRequest,
                          tentative: SlicePool) -> List[Decision]:
@@ -405,8 +473,11 @@ def _self_check() -> None:
     eng._running["c"].req = dataclasses.replace(
         eng._running["c"].req, min_hosts=1)
     plan = eng.schedule()
-    assert [d.action for d in plan] == [SHRINK], plan
+    # ...and the explainer records WHY the demander still waits this
+    # pass (the reclaim is in flight), with the victim named.
+    assert [d.action for d in plan] == [SHRINK, PREEMPT_WAIT], plan
     assert plan[0].job_id == "c" and plan[0].hosts == 1
+    assert plan[1].job_id == "hi" and plan[1].blocking == ["c"]
     eng.shrink_applied("c", plan[0].hosts)
     plan = eng.schedule()
     assert [(d.action, d.job_id) for d in plan] == [(GRANT, "hi")], plan

@@ -80,6 +80,12 @@ SERIES: Dict[str, str] = {
     "tony_fleet_preemptions_total": "preempt-to-reclaim shrinks applied",
     "tony_fleet_quota_denials_total": "grants deferred by tenant quota",
     "tony_fleet_queue_wait_seconds": "submit-to-grant wait latency",
+    # -- fleet goodput ledger (tony_tpu/fleet/ledger.py) ------------------
+    "tony_fleet_goodput_fraction": "chip-seconds doing useful train "
+                                   "steps / chip-seconds held, per "
+                                   "tenant and fleet-wide",
+    "tony_fleet_phase_seconds": "cumulative ledger chip-seconds per "
+                                "goodput phase and tenant",
     # -- control-plane self-observation (coordinator/coordphases.py) -----
     "tony_coord_phase_seconds": "coordinator tick wall per phase",
     "tony_coord_tick_seconds": "mean active coordinator tick duration",

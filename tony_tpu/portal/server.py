@@ -274,20 +274,64 @@ class PortalServer:
                 f"({pool.get('free', '?')} free), queue depth "
                 f"{snap.get('queue_depth', '?')}, wait p50 "
                 f"{qw.get('p50_s', 0)}s / p99 {qw.get('p99_s', 0)}s</p>"]
+        # Fleet incident verdict (fleet/diagnose.py): the daemon
+        # refreshes fleet.incident.json every export; torn/absent
+        # degrades to no banner (same posture as incident.json).
+        incident = None
+        try:
+            with open(os.path.join(self.fleet_dir,
+                                   constants.FLEET_INCIDENT_FILE),
+                      encoding="utf-8") as f:
+                incident = json.load(f)
+        except (OSError, ValueError):
+            pass
+        if isinstance(incident, dict) and incident.get("verdict"):
+            v = incident["verdict"]
+            body.append(
+                f"<p><b>verdict: "
+                f"{html.escape(str(v.get('category', '?')))}</b> — "
+                f"{html.escape(str(v.get('summary', '')))}<br>"
+                f"advice: {html.escape(str(v.get('advice', '')))}</p>")
+        # Per-tenant goodput ledger table (fleet/ledger.py rollup).
+        ledger = snap.get("ledger") or {}
         tenants = snap.get("tenants") or {}
-        if tenants:
-            body.append("<p>tenants: " + "  ".join(
-                f"{html.escape(t)}={row.get('used', 0)}/"
-                f"{row.get('quota') or '∞'}"
-                for t, row in sorted(tenants.items())) + "</p>")
-        body.append("<table border=1 cellpadding=4><tr><th>job</th>"
+        tenant_led = ledger.get("tenants") or {}
+        if tenants or tenant_led:
+            body.append("<h2>tenants</h2>"
+                        "<table border=1 cellpadding=4><tr>"
+                        "<th>tenant</th><th>hosts used/quota</th>"
+                        "<th>goodput</th><th>train chip-s</th>"
+                        "<th>held chip-s</th><th>queued chip-s lost"
+                        "</th><th>warm starts</th></tr>")
+            for t in sorted(set(tenants) | set(tenant_led)):
+                row = tenants.get(t) or {}
+                led = tenant_led.get(t) or {}
+                gp = led.get("goodput_fraction")
+                warm = led.get("warm_start_fraction")
+                phase_chip = led.get("phase_chip_s") or {}
+                body.append(
+                    f"<tr><td>{html.escape(t)}</td>"
+                    f"<td>{row.get('used', 0)}/"
+                    f"{row.get('quota') or '∞'}</td>"
+                    f"<td>{(f'{float(gp):.1%}' if gp is not None else '—')}"
+                    f"</td>"
+                    f"<td>{phase_chip.get('train', 0)}</td>"
+                    f"<td>{led.get('held_chip_s', 0)}</td>"
+                    f"<td>{led.get('lost_preempted_chip_s', 0)}</td>"
+                    f"<td>{(f'{float(warm):.0%}' if warm is not None else '—')}"
+                    f"</td></tr>")
+            body.append("</table>")
+        body.append("<h2>jobs</h2>"
+                    "<table border=1 cellpadding=4><tr><th>job</th>"
                     "<th>tenant</th><th>pri</th><th>state</th>"
-                    "<th>hosts</th><th>wait</th><th>app</th></tr>")
+                    "<th>hosts</th><th>wait</th><th>app / held</th>"
+                    "</tr>")
         for row in snap.get("jobs", []):
             app = str(row.get("app_id") or "")
             app_cell = (f"<a href='/jobs/{html.escape(app)}'>"
                         f"{html.escape(app)}</a>") if app else \
-                html.escape(str(row.get("denial") or ""))
+                html.escape(str(row.get("held") or row.get("denial")
+                                or ""))
             wait = row.get("wait_s")
             body.append(
                 f"<tr><td>{html.escape(str(row.get('job')))}</td>"

@@ -36,14 +36,21 @@ _HIGHER = ("tokens_per_sec", "samples_per_sec", "mfu_vs_peak_bf16",
            "pct_of_synthetic", "steps_per_sec", "value",
            # BENCH_SCALE family (control-plane width, bench --suite
            # scale): sustained control throughput at width.
-           "beats_per_sec", "records_per_sec")
+           "beats_per_sec", "records_per_sec",
+           # BENCH_FLEET family (bench --suite fleet): chip-seconds
+           # doing useful steps / chip-seconds held, and the warm-pool
+           # adoption rate across tenants.
+           "goodput_fraction", "warm_start_fraction")
 #: metric-name suffixes where smaller is better
 _LOWER = ("submit_to_first_step_s", "probe_self_reported_s",
           "phase_total_s", "seconds_per_step", "mean_step_s",
           "comms_fraction",
           # BENCH_SCALE family: control-plane latency/stall metrics.
           "rendezvous_s", "tick_duration_s", "fsync_p99_s",
-          "fsync_stall_fraction", "resize_latency_s")
+          "fsync_stall_fraction", "resize_latency_s",
+          # BENCH_FLEET family: scheduler latency/churn metrics.
+          "queue_wait_p50_s", "queue_wait_p99_s",
+          "preemptions_per_job", "drain_s")
 #: path components under which every plain numeric leaf is seconds of a
 #: phase breakdown → lower is better
 _LOWER_CONTAINERS = ("phases", "step_phases_s", "phase_span_durations")
