@@ -72,20 +72,50 @@ if os.environ.get("TONY_LOCK_SANITIZER", "") != "0":
 else:
     _sanitizer = None
 
+# ---------------------------------------------------------------------------
+# Data-race detector (tony_tpu/devtools/race.py — tonyrace): the WHOLE
+# tier-1 suite runs with the @guarded control-plane classes' GUARDED_BY
+# fields watched for lockset-empty/no-happens-before access pairs;
+# pytest_sessionfinish fails the run on any race from any process.
+# Armed BEFORE tony_tpu's class definitions import (decoration is the
+# instrumentation point). Opt out with TONY_RACE_DETECTOR=0. The
+# detector needs the sanitizer's lock bookkeeping, so it implies
+# TONY_LOCK_SANITIZER=1.
+# ---------------------------------------------------------------------------
+if os.environ.get("TONY_RACE_DETECTOR", "") != "0" \
+        and _sanitizer is not None:
+    os.environ["TONY_RACE_DETECTOR"] = "1"
+    os.environ.setdefault(
+        "TONY_RACE_DETECTOR_DIR",
+        tempfile.mkdtemp(prefix="tony-race-"))
+    from tony_tpu.devtools import race as _race
+
+    _race.maybe_enable_from_env()
+else:
+    _race = None
+
 
 def pytest_sessionfinish(session, exitstatus):
     """Tier-1 acceptance gate: zero lock-order cycles, zero
-    hold-while-blocking hazards across the whole suite — this process
-    AND every sanitized subprocess the e2e drills spawned."""
-    if _sanitizer is None or not _sanitizer.enabled():
-        return
-    reports = _sanitizer.collect_reports()
-    bad = [r for r in reports if r.get("cycles") or r.get("hazards")]
-    if bad:
-        print("\n=== LOCK SANITIZER FINDINGS "
-              "(tony_tpu/devtools/sanitizer.py) ===")
-        print(_sanitizer.format_report(bad))
-        session.exitstatus = 1
+    hold-while-blocking hazards AND zero data races across the whole
+    suite — this process AND every armed subprocess the e2e drills
+    spawned."""
+    if _sanitizer is not None and _sanitizer.enabled():
+        reports = _sanitizer.collect_reports()
+        bad = [r for r in reports if r.get("cycles") or r.get("hazards")]
+        if bad:
+            print("\n=== LOCK SANITIZER FINDINGS "
+                  "(tony_tpu/devtools/sanitizer.py) ===")
+            print(_sanitizer.format_report(bad))
+            session.exitstatus = 1
+    if _race is not None and _race.enabled():
+        reports = _race.collect_reports()
+        bad = [r for r in reports if r.get("races")]
+        if bad:
+            print("\n=== DATA-RACE DETECTOR FINDINGS "
+                  "(tony_tpu/devtools/race.py) ===")
+            print(_race.format_report(bad))
+            session.exitstatus = 1
 
 
 # ---------------------------------------------------------------------------

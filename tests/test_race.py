@@ -1,0 +1,792 @@
+"""tonyrace suite (tony_tpu/devtools/race.py).
+
+Four layers, mirroring test_lint.py's structure for the lint half:
+
+1. **Dynamic golden fixtures** — one racy and one clean fixture per
+   detection class (empty lockset, inconsistent locks, write-read,
+   lock-edge rescue, start/join rescue, queue-edge rescue, Event and
+   Condition handoffs), each on an ISOLATED RaceState + sanitizer State
+   so racy fixtures never pollute the suite-wide gate.
+2. **Guarded-by lint fixtures** — bad+clean per direction (declared
+   field outside its lock; undeclared store on a registered class),
+   plus the `_locked`-suffix and `__init__` exemptions and the trailing
+   comment grammar.
+3. **The repo gate** — the real repository has zero guarded-by findings
+   (the tier-1 invariant, like test_lint's repo gate), and the armed
+   suite's global detector stays race-free (pytest_sessionfinish).
+4. **Regression units for the bring-up fixes** — the fleet daemon's
+   ledger fold vs fleet.status and the coordinator's beacon fold vs
+   metrics.live are replayed as deterministic interleavings (raw
+   threading.Event barriers from test code are invisible to the HB
+   graph — they force the schedule without rescuing it); the fixed code
+   must record ZERO races, and racy twins of the ORIGINAL shapes prove
+   the detector would have caught them.
+"""
+
+from __future__ import annotations
+
+import os
+import queue
+import subprocess
+import sys
+import textwrap
+import threading
+import time
+
+import pytest
+
+from tony_tpu.devtools import race, sanitizer
+from tony_tpu.devtools.race import RaceState, instrument_class
+from tony_tpu.devtools.tonylint import Linter
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# ---------------------------------------------------------------------------
+# harness
+# ---------------------------------------------------------------------------
+def _pair():
+    """Isolated (sanitizer State, RaceState) pair wired together: lock
+    edges and locksets flow, nothing touches the global detector."""
+    san = sanitizer.State()
+    st = RaceState(san)
+    san.race = st
+    return san, st
+
+
+def _slock(san, site="test:lock"):
+    return sanitizer.sanitize_lock(sanitizer.raw_lock(), site, san)
+
+
+def _fixture(st, san, n_locks=1):
+    """A guarded fixture class instrumented against the isolated state;
+    returns (instance, [locks]). ``shared`` (a dict — container reads
+    count as writes) and ``scalar`` are both declared."""
+
+    class Obj:
+        GUARDED_BY = {"shared": "_mu", "scalar": "_mu"}
+
+        def __init__(self, lock):
+            self._mu = lock
+            with self._mu:
+                self.shared = {}
+                self.scalar = 0
+
+    instrument_class(Obj, state=st)
+    locks = [_slock(san, f"test:lock{i}") for i in range(n_locks)]
+    return Obj(locks[0]), locks
+
+
+def _in_thread(*fns):
+    """Run each fn in its own thread, strictly sequentially (started and
+    joined one at a time). Real concurrency is not needed: the detector
+    reasons about locksets and HB edges, and test-code threads/events
+    are invisible to the isolated state's HB graph."""
+    for fn in fns:
+        t = threading.Thread(target=fn)
+        t.start()
+        t.join()
+
+
+def _races(st, field=None):
+    rep = st.report()
+    return [r for r in rep["races"]
+            if field is None or r["field"] == field]
+
+
+# ---------------------------------------------------------------------------
+# 1. dynamic golden fixtures
+# ---------------------------------------------------------------------------
+@pytest.mark.faults
+def test_empty_lockset_write_write_detected():
+    san, st = _pair()
+    obj, _ = _fixture(st, san)
+
+    _in_thread(lambda: obj.shared.update(k=1))
+    obj.shared["k"] = 2
+
+    hits = _races(st, "shared")
+    assert hits and hits[0]["kind"] == "write-write"
+    assert hits[0]["guard"] == "_mu"
+    assert hits[0]["a"]["site"] and hits[0]["b"]["site"]
+    assert hits[0]["a"]["thread"] != hits[0]["b"]["thread"]
+
+
+@pytest.mark.faults
+def test_consistent_lockset_is_clean():
+    san, st = _pair()
+    obj, (mu,) = _fixture(st, san)
+
+    def locked():
+        with mu:
+            obj.shared["k"] = 1
+
+    _in_thread(locked)
+    with mu:
+        obj.shared["k"] = 2
+    assert _races(st) == []
+
+
+@pytest.mark.faults
+def test_inconsistent_locks_detected():
+    """Each side holds A lock — just not the same one: the lockset
+    intersection is empty, exactly Eraser's candidate-set-goes-empty."""
+    san, st = _pair()
+    obj, locks = _fixture(st, san, n_locks=2)
+    other = locks[1]
+
+    def wrong_lock():
+        with other:
+            obj.shared["k"] = 1
+
+    with locks[0]:
+        obj.shared["k"] = 0
+    _in_thread(wrong_lock)
+    hits = _races(st, "shared")
+    assert hits
+    # the report names both locksets so the fix is obvious
+    assert hits[0]["a"]["locks"] and hits[0]["b"]["locks"]
+    assert set(hits[0]["a"]["locks"]).isdisjoint(hits[0]["b"]["locks"])
+
+
+@pytest.mark.faults
+def test_scalar_read_read_never_conflicts():
+    """Two threads reading the same scalar concurrently (each ordered
+    after __init__ via its start edge, but NOT against each other) is
+    not a race — reads don't conflict."""
+    san, st = _pair()
+    obj, (mu,) = _fixture(st, san)
+    threads = [threading.Thread(target=lambda: obj.scalar)
+               for _ in range(2)]
+    for t in threads:
+        st.note_start(t)        # init-write -> reader edge only
+        t.start()
+    for t in threads:
+        t.join()                # no note_join: readers stay unordered
+    assert _races(st) == []
+
+
+@pytest.mark.faults
+def test_unlocked_scalar_write_vs_read_detected():
+    san, st = _pair()
+    obj, (mu,) = _fixture(st, san)
+
+    def write():
+        obj.scalar = 7
+
+    _in_thread(write)
+    assert obj.scalar == 7
+    hits = _races(st, "scalar")
+    assert hits and hits[0]["kind"] in ("write-read", "read-write",
+                                        "write-write")
+
+
+@pytest.mark.faults
+def test_lock_release_acquire_edge_rescues():
+    """Publication through a mutex: A writes under the lock, B acquires
+    (and releases) the same lock before reading WITHOUT it — the
+    release→acquire HB edge orders the pair even though the reader's
+    lockset is empty."""
+    san, st = _pair()
+    obj, (mu,) = _fixture(st, san)
+
+    def writer():
+        with mu:
+            obj.shared["k"] = 1
+
+    _in_thread(writer)
+    with mu:
+        pass                    # acquire = recv of the writer's clock
+    assert obj.shared["k"] == 1     # unlocked read, HB-rescued
+    assert _races(st) == []
+
+
+@pytest.mark.faults
+def test_start_join_edges_rescue_handoff():
+    """The single-flight worker shape (the coordinator's prom-export
+    thread): creator state is visible to the child via the start edge,
+    child state visible to the joiner via the join edge."""
+    san, st = _pair()
+    obj, _ = _fixture(st, san)
+
+    def worker():
+        obj.shared["k"] = obj.shared.get("k", 0) + 1
+
+    t = threading.Thread(target=worker)
+    st.note_start(t)            # what the global Thread.start patch does
+    t.start()
+    t.join()
+    st.note_join(t)
+    obj.shared["k"] = 9         # after join: ordered, not racing
+    assert _races(st) == []
+
+
+@pytest.mark.faults
+def test_queue_channel_edge_rescues():
+    """put→get is a handoff edge (the event-writer queue shape): the
+    producer's writes before put are visible to the consumer after
+    get."""
+    san, st = _pair()
+    obj, _ = _fixture(st, san)
+    q = queue.Queue()
+
+    def producer():
+        obj.shared["payload"] = 1
+        st.send(q)              # what the global queue.Queue.put patch does
+        q.put(obj)
+
+    t = threading.Thread(target=producer)
+    st.note_start(t)            # orders __init__ -> producer only
+    t.start()
+    got = q.get(timeout=5)
+    st.recv(q)                  # what the global queue.Queue.get patch does
+    t.join()                    # no note_join: only the queue edge
+    assert got.shared["payload"] == 1   # ordered by put->get alone
+    assert _races(st) == []
+
+
+@pytest.mark.faults
+def test_queue_patch_feeds_global_state():
+    """The global patches (enable()) route real queue.Queue traffic into
+    the global state's HB graph — proven against the armed detector with
+    a rescue shape (no findings added)."""
+    if not race.enabled():
+        pytest.skip("detector not armed (TONY_RACE_DETECTOR=0)")
+
+    class Obj:
+        GUARDED_BY = {"shared": "_mu"}
+
+        def __init__(self):
+            self.shared = {}
+
+    instrument_class(Obj)       # global state
+    before = len(race.state().report()["races"])
+    obj = Obj()
+    q = queue.Queue()
+    ready = threading.Event()   # raw: test code is outside tony_tpu
+
+    def producer():
+        obj.shared["k"] = 1     # after our start, before the put
+        q.put(obj)
+        ready.wait(5)
+
+    t = threading.Thread(target=producer)
+    t.start()
+    got = q.get(timeout=5)      # queue edge orders producer's write
+    assert got.shared["k"] == 1
+    ready.set()
+    t.join()
+    assert len(race.state().report()["races"]) == before
+
+
+@pytest.mark.faults
+def test_event_handoff_edge(tmp_path):
+    """SanitizedEvent set→wait is an HB edge (satellite: Condition/Event
+    allocation sites feed the HB graph)."""
+    san, st = _pair()
+    obj, _ = _fixture(st, san)
+    ev = sanitizer.SanitizedEvent(threading.Event(), "test:ev", san)
+
+    def writer():
+        obj.shared["k"] = 1
+        ev.set()
+
+    t = threading.Thread(target=writer)
+    st.note_start(t)            # orders __init__ -> writer only
+    t.start()
+    assert ev.wait(5.0)
+    t.join()                    # no note_join: only the set->wait edge
+    assert obj.shared["k"] == 1     # rescued by the set->wait edge
+    assert _races(st) == []
+
+
+@pytest.mark.faults
+def test_condition_wrapper_feeds_lockset_hb_and_blocking():
+    """SanitizedCondition (satellite): (a) acquire/release participate
+    in the lockset so cv-guarded fields are clean; (b) wait() drops the
+    cv from the lockset — holding ONLY the cv across its own wait is not
+    a hazard; (c) wait() while holding ANOTHER sanitized lock IS a
+    hold-while-blocking hazard; (d) notify→wait is an HB edge."""
+    san, st = _pair()
+    # threading.Condition() from test code stays raw under the patched
+    # factory (non-tony allocation site) — exactly the inner we want.
+    cv = sanitizer.SanitizedCondition(threading.Condition(),
+                                      "test:cv", san)
+
+    class Obj:
+        GUARDED_BY = {"shared": "_cv"}
+
+        def __init__(self):
+            self._cv = cv
+            with self._cv:
+                self.shared = {}
+
+    instrument_class(Obj, state=st)
+    obj = Obj()
+
+    def consumer():
+        with cv:
+            while "k" not in obj.shared:
+                cv.wait(0.5)
+
+    t = threading.Thread(target=consumer)
+    t.start()
+    time.sleep(0.05)
+    with cv:
+        obj.shared["k"] = 1
+        cv.notify_all()
+    t.join(5)
+    assert not t.is_alive()
+    assert _races(st) == []
+    # (b): only-the-cv waits above produced no hazards
+    assert san.hazards == []
+    # (c): wait while holding another sanitized lock -> hazard
+    other = _slock(san, "test:otherlock")
+    with other:
+        with cv:
+            cv.wait(0.01)
+    assert any(h["blocking"] == "threading.Condition.wait"
+               and "test:otherlock" in h["held"] for h in san.hazards)
+
+
+@pytest.mark.faults
+def test_factories_wrap_tony_sites_only():
+    """threading.Event()/Condition() allocated from tony_tpu code come
+    back wrapped; allocations from anywhere else stay raw (this test
+    file is 'anywhere else'). Needs the patched factories."""
+    if not sanitizer.enabled():
+        pytest.skip("sanitizer not armed")
+    raw_ev = threading.Event()
+    raw_cv = threading.Condition()
+    assert type(raw_ev).__name__ != "SanitizedEvent"
+    assert type(raw_cv).__name__ != "SanitizedCondition"
+    # Simulate a tony allocation site: the factories key on the calling
+    # frame's filename, so a code object compiled under a tony_tpu path
+    # gets the wrappers.
+    code = compile("cv = threading.Condition()\nev = threading.Event()",
+                   os.path.join("tony_tpu", "_racetest_frame.py"),
+                   "exec")
+    ns = {"threading": threading}
+    exec(code, ns)  # noqa: S102 — deterministic frame-scoping probe
+    assert type(ns["cv"]).__name__ == "SanitizedCondition"
+    assert type(ns["ev"]).__name__ == "SanitizedEvent"
+
+
+# ---------------------------------------------------------------------------
+# detector-off: zero overhead
+# ---------------------------------------------------------------------------
+@pytest.mark.faults
+def test_detector_off_leaves_classes_untouched():
+    """Without TONY_RACE_DETECTOR, @guarded returns the class object
+    unchanged: default C-level attribute access, no patches."""
+    env = dict(os.environ)
+    for k in ("TONY_RACE_DETECTOR", "TONY_LOCK_SANITIZER"):
+        env.pop(k, None)
+    env["PYTHONPATH"] = REPO_ROOT + os.pathsep + env.get("PYTHONPATH", "")
+    code = textwrap.dedent("""
+        import threading, queue
+        real_start = threading.Thread.start
+        real_put = queue.Queue.put
+        from tony_tpu.coordinator.session import Session
+        from tony_tpu.fleet.daemon import FleetDaemon
+        from tony_tpu.metrics import MetricsRegistry
+        from tony_tpu.devtools import race
+        assert not race.enabled()
+        for cls in (Session, FleetDaemon, MetricsRegistry):
+            assert cls.__getattribute__ is object.__getattribute__, cls
+            assert cls.__setattr__ is object.__setattr__, cls
+        assert threading.Thread.start is real_start
+        assert queue.Queue.put is real_put
+        print("off-ok")
+    """)
+    out = subprocess.run([sys.executable, "-c", code], env=env,
+                         capture_output=True, text=True, timeout=120)
+    assert out.returncode == 0, out.stdout + out.stderr
+    assert "off-ok" in out.stdout
+
+
+@pytest.mark.faults
+def test_selfcheck_cli():
+    """python -m tony_tpu.devtools.race — the no-deps CI smoke."""
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO_ROOT + os.pathsep + env.get("PYTHONPATH", "")
+    out = subprocess.run(
+        [sys.executable, "-m", "tony_tpu.devtools.race"], env=env,
+        capture_output=True, text=True, timeout=120)
+    assert out.returncode == 0, out.stdout + out.stderr
+    assert "racy fixture -> 1 finding(s)" in out.stdout
+
+
+# ---------------------------------------------------------------------------
+# 2. guarded-by lint fixtures (synthetic repo, like test_lint.py)
+# ---------------------------------------------------------------------------
+def _lint_snippet(tmp_path, code, rules,
+                  rel="tony_tpu/coordinator/snippet.py"):
+    path = tmp_path / rel
+    path.parent.mkdir(parents=True, exist_ok=True)
+    path.write_text(textwrap.dedent(code))
+    linter = Linter(str(tmp_path))
+    linter.run(rules=rules)
+    rel_norm = os.path.normpath(rel)
+    return ([f for f in linter.findings
+             if os.path.normpath(f.file) == rel_norm], linter)
+
+
+_GUARD_RULES = ["guarded-by", "guarded-decl"]
+
+
+@pytest.mark.faults
+def test_guarded_by_bad_and_clean(tmp_path):
+    bad, _ = _lint_snippet(tmp_path, '''
+        class C:
+            GUARDED_BY = {"jobs": "_lock"}
+
+            def __init__(self):
+                self.jobs = {}        # __init__ is exempt
+
+            def touch(self):
+                self.jobs["x"] = 1    # outside the lock: finding
+    ''', _GUARD_RULES)
+    assert [(f.rule, f.line) for f in bad] == [("guarded-by", 9)]
+    assert "jobs" in bad[0].message and "_lock" in bad[0].message
+
+    clean, _ = _lint_snippet(tmp_path, '''
+        class C:
+            GUARDED_BY = {"jobs": "_lock"}
+
+            def __init__(self):
+                self.jobs = {}
+
+            def touch(self):
+                with self._lock:
+                    self.jobs["x"] = 1
+
+            def _drain_locked(self):
+                return list(self.jobs)   # *_locked: caller holds it
+    ''', _GUARD_RULES)
+    assert clean == []
+
+
+@pytest.mark.faults
+def test_guarded_decl_undeclared_store_bad_and_clean(tmp_path):
+    bad, _ = _lint_snippet(tmp_path, '''
+        class C:
+            GUARDED_BY = {"jobs": "_lock"}
+
+            def sneak(self):
+                self.rogue = 1        # undeclared store: finding
+    ''', _GUARD_RULES)
+    assert [(f.rule, f.line) for f in bad] == [("guarded-decl", 6)]
+    assert "rogue" in bad[0].message
+
+    clean, _ = _lint_snippet(tmp_path, '''
+        class C:
+            GUARDED_BY = {"jobs": "_lock", "flag": None}
+
+            def sneak(self):
+                self.flag = 1         # declared atomic-by-design: fine
+
+        class NoRegistry:
+            def free(self):
+                self.anything = 1     # uninstrumented class: no rule
+    ''', _GUARD_RULES)
+    assert clean == []
+
+
+@pytest.mark.faults
+def test_guarded_by_comment_grammar_declares(tmp_path):
+    bad, _ = _lint_snippet(tmp_path, '''
+        class C:
+            def __init__(self):
+                self.jobs = {}   # guarded-by: _lock
+
+            def touch(self):
+                return self.jobs.get("x")
+    ''', _GUARD_RULES)
+    assert [(f.rule, f.line) for f in bad] == [("guarded-by", 7)]
+
+
+@pytest.mark.faults
+def test_guarded_rules_scoped_to_control_plane_dirs(tmp_path):
+    findings, _ = _lint_snippet(tmp_path, '''
+        class C:
+            GUARDED_BY = {"jobs": "_lock"}
+
+            def touch(self):
+                self.jobs["x"] = 1
+    ''', _GUARD_RULES, rel="tony_tpu/elsewhere.py")
+    assert findings == []
+
+
+@pytest.mark.faults
+def test_guarded_by_suppression_counts(tmp_path):
+    _, linter = _lint_snippet(tmp_path, '''
+        class C:
+            GUARDED_BY = {"jobs": "_lock"}
+
+            def touch(self):
+                self.jobs["x"] = 1   # tony: lint-ignore[guarded-by]
+    ''', _GUARD_RULES)
+    assert linter.findings == []
+    assert [s.rule for s in linter.suppressed] == ["guarded-by"]
+
+
+# ---------------------------------------------------------------------------
+# 3. the repo gates
+# ---------------------------------------------------------------------------
+@pytest.mark.faults
+def test_repo_is_guarded_by_clean():
+    """The real repository lints clean under the guarded-by family with
+    ZERO suppressions — deleting a lock from a registered class (or
+    touching a registered field outside it) fails tier-1 here."""
+    linter = Linter(REPO_ROOT)
+    linter.run(rules=_GUARD_RULES)
+    assert linter.findings == [], "\n".join(str(f) for f in linter.findings)
+    assert linter.suppressed == []
+
+
+@pytest.mark.faults
+def test_declared_registries_resolve():
+    """Every GUARDED_BY guard names a real lock attribute created in
+    __init__ — a typo'd guard would silently disable enforcement."""
+    from tony_tpu.conf.config import TonyTpuConfig
+    from tony_tpu.coordinator.elastic import ElasticManager
+    from tony_tpu.coordinator.session import Session
+    from tony_tpu.metrics import MetricsRegistry
+
+    conf = TonyTpuConfig()
+    conf.set("tony.worker.instances", 1)
+    conf.set("tony.worker.command", "true")
+    conf.set("tony.elastic.enabled", "true")
+    for obj in (Session(conf), ElasticManager(conf), MetricsRegistry()):
+        for field, guard in race.declared_guards(type(obj)).items():
+            if guard:
+                lk = getattr(obj, guard)
+                assert hasattr(lk, "acquire") and hasattr(lk, "release")
+
+
+# ---------------------------------------------------------------------------
+# 4. regression units: the bring-up races, replayed deterministically
+# ---------------------------------------------------------------------------
+def _racy_ledger_twin():
+    """The ORIGINAL (pre-fix) fleet-daemon shape: the tick thread folds
+    into the ledger cache while fleet.status reads it — no lock on
+    either side."""
+
+    class Twin:
+        GUARDED_BY = {"_ledgers": "_lock", "_ledger_rollup": "_lock"}
+
+        def __init__(self, lock):
+            self._lock = lock
+            with self._lock:
+                self._ledgers = {}
+                self._ledger_rollup = None
+
+        def fold(self, job, row):                 # tick thread (pre-fix)
+            self._ledgers[job] = row
+            self._ledger_rollup = None
+
+        def snapshot(self):                       # RPC thread (pre-fix)
+            if self._ledger_rollup is None:
+                self._ledger_rollup = {"n": len(self._ledgers)}
+            return self._ledger_rollup
+
+        def fold_fixed(self, job, row):
+            with self._lock:
+                self._ledgers[job] = row
+                self._ledger_rollup = None
+
+        def snapshot_fixed(self):
+            with self._lock:
+                if self._ledger_rollup is None:
+                    self._ledger_rollup = {"n": len(self._ledgers)}
+                return self._ledger_rollup
+
+    return Twin
+
+
+@pytest.mark.faults
+def test_regression_fleet_ledger_fold_vs_status():
+    """Replays the tick-fold vs fleet.status interleaving that the
+    bring-up flagged, via a raw-Event barrier (invisible to the HB
+    graph): the pre-fix shape is DETECTED, the fixed shape is clean."""
+    Twin = _racy_ledger_twin()
+    for fixed in (False, True):
+        san, st = _pair()
+        instrument_class(Twin, state=st)
+        twin = Twin(_slock(san, f"twin:lock:{fixed}"))
+        folded = threading.Event()          # raw: no HB edge
+
+        def tick():
+            (twin.fold_fixed if fixed else twin.fold)("fj-0001", {"s": 1})
+            folded.set()
+
+        def status():
+            assert folded.wait(5)           # forces fold -> read order
+            (twin.snapshot_fixed if fixed else twin.snapshot)()
+
+        t1 = threading.Thread(target=tick)
+        t2 = threading.Thread(target=status)
+        t1.start()
+        t2.start()
+        t1.join()
+        t2.join()
+        hits = _races(st, "_ledgers") + _races(st, "_ledger_rollup")
+        if fixed:
+            assert hits == [], hits
+        else:
+            assert hits, "pre-fix ledger shape must be detected"
+        # fresh class for the next round (instrumentation is cumulative)
+        Twin = _racy_ledger_twin()
+
+
+def _racy_beacon_twin():
+    """The ORIGINAL coordinator shape: _observe_beacon stores the phase
+    beacon unlocked on one RPC thread while metrics.live snapshots it on
+    another."""
+
+    class Twin:
+        GUARDED_BY = {"_phase_latest": "_hb_lock"}
+
+        def __init__(self, lock):
+            self._hb_lock = lock
+            with self._hb_lock:
+                self._phase_latest = {}
+
+        def observe(self, task, ph):              # beat thread (pre-fix)
+            self._phase_latest[task] = dict(ph)
+
+        def live(self):                           # top thread (pre-fix)
+            return dict(self._phase_latest)
+
+        def observe_fixed(self, task, ph):
+            with self._hb_lock:
+                self._phase_latest[task] = dict(ph)
+
+        def live_fixed(self):
+            with self._hb_lock:
+                return dict(self._phase_latest)
+
+    return Twin
+
+
+@pytest.mark.faults
+def test_regression_coordinator_beacon_fold_vs_metrics_live():
+    Twin = _racy_beacon_twin()
+    for fixed in (False, True):
+        san, st = _pair()
+        instrument_class(Twin, state=st)
+        twin = Twin(_slock(san, f"beacon:lock:{fixed}"))
+        beat_done = threading.Event()       # raw barrier
+
+        def beat():
+            (twin.observe_fixed if fixed else twin.observe)(
+                "worker:0", {"cum": {"compute": 1.0}})
+            beat_done.set()
+
+        def top():
+            assert beat_done.wait(5)
+            (twin.live_fixed if fixed else twin.live)()
+
+        t1 = threading.Thread(target=beat)
+        t2 = threading.Thread(target=top)
+        t1.start()
+        t2.start()
+        t1.join()
+        t2.join()
+        hits = _races(st, "_phase_latest")
+        if fixed:
+            assert hits == [], hits
+        else:
+            assert hits, "pre-fix beacon shape must be detected"
+        Twin = _racy_beacon_twin()
+
+
+@pytest.mark.faults
+def test_real_fleet_daemon_tick_vs_status_is_race_free(tmp_path):
+    """The REAL FleetDaemon under the armed detector: a submit + tick +
+    concurrent status()/explain() storm adds no findings (the global
+    gate would also fail the session — this pins the regression to its
+    test)."""
+    if not race.enabled():
+        pytest.skip("detector not armed (TONY_RACE_DETECTOR=0)")
+    from tests.test_fleet import FakeRunner
+    from tony_tpu.fleet.daemon import FleetDaemon
+
+    before = len(race.state().report()["races"])
+    d = FleetDaemon(str(tmp_path / "fleet"), slices=2, hosts_per_slice=4,
+                    runner=FakeRunner(), ledger_interval_s=0.0)
+    try:
+        res = d.submit("tenantA", 2,
+                       conf={"tony.worker.command": "true"})
+        job = res["job"]
+        stop = threading.Event()            # raw barrier
+
+        def rpc_storm():
+            while not stop.is_set():
+                d.status()
+                d.explain(job)
+
+        t = threading.Thread(target=rpc_storm)
+        t.start()
+        for _ in range(10):
+            d.tick()
+        d.runner.handle_for(job).exit = 0
+        d.tick()
+        stop.set()
+        t.join(10)
+        assert not t.is_alive()
+    finally:
+        d._shutdown()
+    after = race.state().report()["races"]
+    assert len(after) == before, race.format_report(
+        [{"pid": os.getpid(), "races": after[before:]}])
+
+
+@pytest.mark.faults
+def test_real_coordinator_beacon_vs_live_is_race_free(tmp_path):
+    """The REAL Coordinator under the armed detector: heartbeat beacon
+    folds racing metrics_live()/report builds add no findings."""
+    if not race.enabled():
+        pytest.skip("detector not armed (TONY_RACE_DETECTOR=0)")
+    from tony_tpu.cluster.local import LocalProcessBackend
+    from tony_tpu.conf.config import TonyTpuConfig
+    from tony_tpu.coordinator.coordinator import Coordinator
+
+    before = len(race.state().report()["races"])
+    conf = TonyTpuConfig()
+    conf.set("tony.worker.instances", 1)
+    conf.set("tony.worker.command", "true")
+    backend = LocalProcessBackend(str(tmp_path / "work"))
+    coord = Coordinator(conf, "app_race", backend,
+                        str(tmp_path / "history"), user="t")
+    try:
+        coord.session.register_worker("worker:0", "127.0.0.1", 1234)
+        with coord._hb_lock:
+            coord._last_hb["worker:0"] = time.monotonic()
+        beacon = {"steps": 1, "metrics": {"steps_per_sec": 2.0},
+                  "phases": {"cum": {"step_compute": 1.0}, "wall_s": 1.0,
+                             "steps": 1}}
+        stop = threading.Event()            # raw barrier
+
+        def live_storm():
+            while not stop.is_set():
+                coord.metrics_live()
+                coord.metrics_get("worker:0")
+
+        t = threading.Thread(target=live_storm)
+        t.start()
+        for i in range(25):
+            coord._observe_beacon("worker:0",
+                                  {**beacon, "steps": i})
+            coord.metrics_push("worker:0", {"rss": i})
+        stop.set()
+        t.join(10)
+        assert not t.is_alive()
+        coord._write_perf_report()
+    finally:
+        coord.journal.close()
+        coord.rpc._server.server_close()
+    after = race.state().report()["races"]
+    assert len(after) == before, race.format_report(
+        [{"pid": os.getpid(), "races": after[before:]}])

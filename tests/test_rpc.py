@@ -114,6 +114,13 @@ def test_metrics_push_then_get_roundtrip():
     class FakeCoord:
         metrics_store = {}
 
+        def metrics_push(self, task_id, metrics):
+            self.metrics_store[task_id] = metrics
+            return True
+
+        def metrics_get(self, task_id):
+            return self.metrics_store.get(task_id)
+
     svc = _RpcService(FakeCoord())
     srv = RpcServer(svc, port=0, token="tok")
     srv.start()

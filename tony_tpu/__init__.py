@@ -35,6 +35,15 @@ from tony_tpu.devtools import sanitizer as _sanitizer  # noqa: E402
 
 _sanitizer.maybe_enable_from_env()
 
+# Same contract for the data-race detector (TONY_RACE_DETECTOR=1,
+# devtools/race.py): it must arm BEFORE the @guarded control-plane
+# classes are defined (decoration is the instrumentation point) and
+# before any thread starts, so subprocesses of an armed run join the
+# suite-wide race verdict; no-op — one env read — everywhere else.
+from tony_tpu.devtools import race as _race  # noqa: E402
+
+_race.maybe_enable_from_env()
+
 from tony_tpu import constants  # noqa: F401
 from tony_tpu.conf.config import TonyTpuConfig  # noqa: F401
 

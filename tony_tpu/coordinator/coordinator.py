@@ -43,6 +43,7 @@ from tony_tpu.coordinator.liveness import ProgressTracker
 from tony_tpu.coordinator.scheduler import GangScheduler
 from tony_tpu.coordinator.session import (FailureDomain, Session,
                                           SessionStatus, Task, TaskStatus)
+from tony_tpu.devtools.race import guarded
 from tony_tpu.diagnosis.exitcodes import describe_exit
 from tony_tpu.events.events import Event, EventHandler, EventType
 from tony_tpu.events import history
@@ -117,11 +118,10 @@ class _RpcService:
         return True
 
     def metrics__push(self, task_id: str, metrics: dict) -> bool:
-        self._c.metrics_store[task_id] = metrics
-        return True
+        return self._c.metrics_push(task_id, metrics)
 
     def metrics__get(self, task_id: str) -> Optional[dict]:
-        return self._c.metrics_store.get(task_id)
+        return self._c.metrics_get(task_id)
 
     def metrics__live(self) -> dict:
         """Live per-task utilization snapshot (the `tony-tpu top` feed)."""
@@ -142,7 +142,46 @@ class _RpcService:
         return self._c.ingest_trace_records(records)
 
 
+@guarded
 class Coordinator:
+    #: tonyrace registry (devtools/race.py + the guarded-by lint): the
+    #: beat-path maps are written by RPC handler threads (heartbeat
+    #: beacon fold, metrics.push, execution-result diagnostics) and read
+    #: by other RPC threads (metrics.live) and the monitor tick
+    #: (heartbeat expiry, report building, teardown) — every touch
+    #: holds ``_hb_lock``; the profile directive map keeps its own lock.
+    #: The None entries are audited single-writer/atomic rebinds: spans
+    #: and scheduler state owned by the monitor thread, throttles, and
+    #: status scalars whose readers tolerate old-or-new.
+    GUARDED_BY = {
+        "_last_hb": "_hb_lock",
+        "metrics_store": "_hb_lock",
+        "_task_diag": "_hb_lock",
+        "_phase_latest": "_hb_lock",
+        "_recovered_steps": "_hb_lock",
+        "_progress_journal_t": "_hb_lock",
+        "_profile_reqs": "_profile_lock",
+        "_profile_seq": "_profile_lock",
+        # -- audited, not lock-enforced (atomic/single-writer) ---------
+        "tb_url": None,
+        "final_status": None,
+        "scheduler": None,
+        "_stop_reason": None,
+        "_reregistration_grace": None,
+        "_infra_retries_used": None,
+        "_preempt_retries_used": None,
+        "_attempt": None,
+        "_schedule_start": None,
+        "_worker_termination_done": None,
+        "_final_conf_path": None,
+        "_prom_last_write": None,
+        "_prom_thread": None,
+        "_run_span": None,
+        "_epoch_span": None,
+        "_rendezvous_span": None,
+        "session": None,
+    }
+
     def __init__(self, conf: TonyTpuConfig, app_id: str, backend: Backend,
                  history_root: str, user: str = "",
                  rpc_token: Optional[str] = None,
@@ -479,7 +518,12 @@ class Coordinator:
                     ).set(float(secs))
                 except (TypeError, ValueError):
                     continue
-            self._phase_latest[task_id] = dict(ph)
+            # Replaced whole under the beat lock; readers (metrics.live
+            # on other RPC threads, the perf.json writer on the monitor)
+            # snapshot under the same lock — the tonyrace bring-up
+            # flagged this fold-vs-read pair as its coordinator hot spot.
+            with self._hb_lock:
+                self._phase_latest[task_id] = dict(ph)
         prof = progress.get("profile")
         if isinstance(prof, dict):
             self._observe_profile_beacon(task_id, prof)
@@ -621,7 +665,12 @@ class Coordinator:
         series, bounded by tony.metrics.ring-points)."""
         now = time.monotonic()
         with self._hb_lock:
+            # One snapshot for the whole build: heartbeat ages AND the
+            # latest phase beacons — beats keep folding on RPC threads
+            # while this runs (the beacon-fold-vs-metrics.live race the
+            # tonyrace bring-up flagged).
             hb = dict(self._last_hb)
+            phase_snapshot = dict(self._phase_latest)
         tasks = []
         for t in self.session.all_tasks():
             labels = {"app": self.app_id, "task": t.task_id}
@@ -643,7 +692,7 @@ class Coordinator:
                 "tony_task_steps_per_sec", labels)
             if history_v:
                 row["steps_per_sec_history"] = history_v[-32:]
-            ph = self._phase_latest.get(t.task_id)
+            ph = phase_snapshot.get(t.task_id)
             if ph:
                 # Recent-window attribution preferred (the live view
                 # should show what the step is doing NOW, not the job
@@ -669,7 +718,6 @@ class Coordinator:
                 "gang_size": {name: job.instances
                               for name, job in self.session.jobs.items()},
                 "tasks": tasks}
-        phase_snapshot = dict(self._phase_latest)
         if phase_snapshot:
             # Live bottleneck verdict over the wall-weighted aggregate —
             # the `top` header line every item-4 perf PR is aimed by.
@@ -689,6 +737,24 @@ class Coordinator:
             # incident, not only in post-hoc metrics.
             snap["coord"] = coord
         return snap
+
+    def metrics_push(self, task_id: str, metrics: dict) -> bool:
+        """metrics.push intake (reference ``rpc/MetricsRpc.java``):
+        replaced whole under the beat lock — readers (TASK_FINISHED
+        payloads, the report builder) snapshot under the same lock."""
+        with self._hb_lock:
+            self.metrics_store[task_id] = metrics
+        return True
+
+    def metrics_get(self, task_id: str) -> Optional[dict]:
+        with self._hb_lock:
+            return self.metrics_store.get(task_id)
+
+    def _task_metrics(self, task_id: str) -> dict:
+        """The task's last pushed metrics blob (TASK_FINISHED payloads,
+        report rows) — one locked read."""
+        with self._hb_lock:
+            return self.metrics_store.get(task_id, {})
 
     def _coord_live_row(self) -> Optional[dict]:
         """The control-plane self row for metrics.live/top: tick
@@ -836,7 +902,8 @@ class Coordinator:
         verdict over the job's steady-state step-time attribution. Only
         written when at least one task beaconed phases (a non-telemetry
         job has nothing to attribute). Best-effort by contract."""
-        snapshot = dict(self._phase_latest)
+        with self._hb_lock:
+            snapshot = dict(self._phase_latest)
         if not snapshot:
             return
         try:
@@ -1080,12 +1147,13 @@ class Coordinator:
                                   self.session.session_id)
             with self._hb_lock:
                 self._last_hb[task_id] = time.monotonic()
+                steps_hint = self._recovered_steps.pop(task_id, None)
             # Progress tracking starts at registration; a post-recovery
             # re-registration seeds the journalled step counter so the
             # task comes back ARMED with a fresh deadline.
             self.progress.track(
                 task_id, task_id.partition(":")[0],
-                steps_hint=self._recovered_steps.pop(task_id, None))
+                steps_hint=steps_hint)
             self._maybe_test_worker_termination(task_id)
         el = self.elastic
         if el is not None and el.resizing and el.op is not None \
@@ -1147,9 +1215,9 @@ class Coordinator:
         captured at the source, where the log is ALWAYS local, instead
         of hoping the coordinator can reach the file."""
         self._check_epoch(task_id, session_id)
-        if isinstance(diagnostics, dict) and diagnostics:
-            self._task_diag[task_id] = diagnostics
         with self._hb_lock:
+            if isinstance(diagnostics, dict) and diagnostics:
+                self._task_diag[task_id] = diagnostics
             self._last_hb.pop(task_id, None)
         self.progress.forget(task_id)
         self._process_completion(task_id, exit_code)
@@ -1199,10 +1267,11 @@ class Coordinator:
         recovery seed must not turn the fsync'd journal into a per-step
         hot path."""
         now = time.monotonic()
-        last = self._progress_journal_t.get(task_id, 0.0)
-        if now - last < liveness.PROGRESS_JOURNAL_MIN_INTERVAL_S:
-            return
-        self._progress_journal_t[task_id] = now
+        with self._hb_lock:
+            last = self._progress_journal_t.get(task_id, 0.0)
+            if now - last < liveness.PROGRESS_JOURNAL_MIN_INTERVAL_S:
+                return
+            self._progress_journal_t[task_id] = now
         snap = self.progress.snapshot(task_id) or {}
         steps = snap.get("steps")
         if steps is not None:
@@ -1339,10 +1408,11 @@ class Coordinator:
             "exit_detail": describe_exit(exit_code),
             "failure_domain": (t.failure_domain.value
                                if t.failure_domain else ""),
-            "metrics": self.metrics_store.get(task_id, {}),
+            "metrics": self._task_metrics(task_id),
             "logs": list(logs) if logs else [],
             "session_id": self.session.session_id}
-        diag = self._task_diag.get(task_id) if exit_code != 0 else None
+        with self._hb_lock:
+            diag = self._task_diag.get(task_id) if exit_code != 0 else None
         if diag:
             # Executor-extracted postmortem: the user traceback rides the
             # event stream so diagnosis works even after task dirs purge.
@@ -1426,7 +1496,7 @@ class Coordinator:
             "failure_domain": domain.value,
             "reason": reason,
             "resize": True,
-            "metrics": self.metrics_store.get(task_id, {}),
+            "metrics": self._task_metrics(task_id),
             "logs": list(logs) if logs else [],
             "session_id": self.session.session_id}
         if hb_age_s is not None:
@@ -1639,7 +1709,7 @@ class Coordinator:
                           f"heartbeats for {self._hb_expiry_s:.1f}s)",
                 "last_heartbeat_age_s": round(hb_age_s, 3),
                 "progress": progress_snap or {},
-                "metrics": self.metrics_store.get(task_id, {}),
+                "metrics": self._task_metrics(task_id),
                 "logs": list(logs) if logs else [],
                 "session_id": self.session.session_id}))
 
@@ -1762,7 +1832,7 @@ class Coordinator:
             "failure_domain": FailureDomain.INFRA_TRANSIENT.value,
             "reason": reason,
             "progress": progress_snap or dict(info),
-            "metrics": self.metrics_store.get(task_id, {}),
+            "metrics": self._task_metrics(task_id),
             "logs": list(logs) if logs else [],
             "session_id": self.session.session_id}
         if hb_age_s is not None:
@@ -1830,7 +1900,7 @@ class Coordinator:
         retry_domain: Optional[FailureDomain] = None
         try:
             local_cmd = str(self.conf.get(K.COORDINATOR_COMMAND, "") or "")
-            single_node = not self.session.tasks
+            single_node = not self.session.all_tasks()
             if local_cmd and not recovered and (
                     single_node or self.conf.get_bool(
                         K.APPLICATION_ENABLE_PREPROCESS)):
@@ -1970,16 +2040,17 @@ class Coordinator:
             self.session = Session(self.conf, session_id=attempt)
             with self._hb_lock:
                 self._last_hb.clear()
+                # The old gang's per-task residue dies with the epoch:
+                # journal throttles, postmortem extracts (a stale
+                # traceback must not attach to the new gang's exits) and
+                # phase attribution (fresh processes restart their
+                # telemetry counters at 0).
+                self._progress_journal_t.clear()
+                self._task_diag.clear()
+                self._phase_latest.clear()
             # Progress state belongs to the old gang; the new epoch's
             # tasks re-arm from scratch (fresh warmup, fresh deadlines).
             self.progress.reset()
-            self._progress_journal_t.clear()
-            # Postmortem extracts belong to the old epoch's processes —
-            # a stale traceback must not attach to the new gang's exits.
-            self._task_diag.clear()
-            # Phase attribution belongs to the old gang's user processes
-            # (fresh processes restart their telemetry counters at 0).
-            self._phase_latest.clear()
             self._worker_termination_done = False
             if self.elastic is not None:
                 # The retry epoch relaunches at the CONFIGURED size; the
@@ -2021,9 +2092,9 @@ class Coordinator:
         timeout; jobtypes whose launch never hit the journal go through
         schedule_ready as usual."""
         st = self._recover_state
+        scheduled = set(self.session.scheduled_job_names())
         live = [t for t in self.session.all_tasks()
-                if not t.status.terminal and t.job_name
-                in self.session.scheduled_jobs]
+                if not t.status.terminal and t.job_name in scheduled]
         log.warning(
             "recovery: generation %d resumes session epoch %d — %d task(s) "
             "awaiting re-registration (%ds grace), budgets used: "

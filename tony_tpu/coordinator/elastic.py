@@ -54,6 +54,7 @@ if TYPE_CHECKING:
     from tony_tpu.coordinator.session import Session, Task
 
 from tony_tpu.conf import keys as K
+from tony_tpu.devtools.race import guarded
 
 #: op phases
 DRAIN = "drain"        # directives out; waiting for survivors to park
@@ -84,8 +85,19 @@ class _Op:
         self.size_before = 0
 
 
+@guarded
 class ElasticManager:
     """Membership policy + resize-op state for ONE elastic jobtype."""
+
+    #: tonyrace registry (devtools/race.py): the op advances on the
+    #: monitor loop while directives/acks arrive on RPC threads — every
+    #: ``_op`` touch holds the lock. ``mgen``/``established`` are atomic
+    #: scalar rebinds (written under the lock, readable without).
+    GUARDED_BY = {
+        "_op": "_lock",
+        "mgen": None,
+        "established": None,
+    }
 
     def __init__(self, conf: "TonyTpuConfig",
                  now_fn: Callable[[], float] = time.monotonic) -> None:
@@ -109,11 +121,13 @@ class ElasticManager:
     # -- queries ----------------------------------------------------------
     @property
     def resizing(self) -> bool:
-        return self._op is not None
+        with self._lock:
+            return self._op is not None
 
     @property
     def op(self) -> Optional[_Op]:
-        return self._op
+        with self._lock:
+            return self._op
 
     def snapshot(self) -> Dict[str, object]:
         """Status-surface view (application report / metrics.live)."""

@@ -21,6 +21,7 @@ from typing import Dict, List, Optional
 from tony_tpu import constants
 from tony_tpu.conf.config import JobType, TonyTpuConfig
 from tony_tpu.conf import keys as K
+from tony_tpu.devtools.race import guarded
 
 
 class TaskStatus(str, enum.Enum):
@@ -136,8 +137,28 @@ class Task:
         }
 
 
+@guarded
 class Session:
-    """Task matrix + rendezvous barrier + failure policy."""
+    """Task matrix + rendezvous barrier + failure policy.
+
+    Thread-safety: RPC handler threads mutate the matrix (register,
+    completion, resize) while the monitor tick reads/reduces it — every
+    touch of the ``GUARDED_BY`` fields holds ``_lock`` (an RLock, so
+    locked methods compose). The scalar fields are atomic rebinds whose
+    writes all happen under the same lock; they are audited in the
+    registry but not lock-enforced on read (a reader sees the old or the
+    new value, both valid snapshots).
+    """
+
+    #: tonyrace registry (devtools/race.py + the guarded-by lint rules)
+    GUARDED_BY = {
+        "tasks": "_lock",
+        "scheduled_jobs": "_lock",
+        "status": None,
+        "failure_reason": None,
+        "failure_domain": None,
+        "_scheduling_narrowed": None,
+    }
 
     def __init__(self, conf: TonyTpuConfig, session_id: int = 0):
         self.conf = conf
@@ -171,13 +192,20 @@ class Session:
 
     # -- queries ----------------------------------------------------------
     def get_task(self, task_id: str) -> Optional[Task]:
-        return self.tasks.get(task_id)
+        with self._lock:
+            return self.tasks.get(task_id)
 
     def all_tasks(self) -> List[Task]:
-        return list(self.tasks.values())
+        with self._lock:
+            return list(self.tasks.values())
 
     def tracked_tasks(self) -> List[Task]:
-        return [t for t in self.tasks.values() if t.tracked]
+        with self._lock:
+            return [t for t in self.tasks.values() if t.tracked]
+
+    def scheduled_job_names(self) -> List[str]:
+        with self._lock:
+            return sorted(self.scheduled_jobs)
 
     def members(self, job_name: str) -> List[int]:
         """Sorted member indices of a jobtype's gang. Dense
@@ -235,14 +263,14 @@ class Session:
                 self._scheduling_narrowed = True
             self.scheduled_jobs.add(job_name)
 
-    def _expected_tasks(self) -> List[Task]:
+    def _expected_tasks_locked(self) -> List[Task]:
         return [t for t in self.tasks.values()
                 if t.job_name in self.scheduled_jobs]
 
     @property
     def num_expected(self) -> int:
         with self._lock:
-            return len(self._expected_tasks())
+            return len(self._expected_tasks_locked())
 
     @property
     def num_registered(self) -> int:
@@ -251,7 +279,7 @@ class Session:
 
     def all_registered(self) -> bool:
         with self._lock:
-            expected = self._expected_tasks()
+            expected = self._expected_tasks_locked()
             return bool(expected) and all(t.registered for t in expected)
 
     def get_cluster_spec(self) -> Optional[Dict[str, List[str]]]:
@@ -311,16 +339,16 @@ class Session:
             if not t.tracked:
                 # Untracked (ps-style) crash is still a job failure when it
                 # dies on its own (reference ApplicationMaster.java:1212-1215).
-                self._fail(f"untracked task {task_id} crashed "
+                self._fail_locked(f"untracked task {task_id} crashed "
                            f"({tag})", domain)
                 return
             if self.is_chief(t.job_name, t.index):
-                self._fail(f"chief task {task_id} failed ({tag})", domain)
+                self._fail_locked(f"chief task {task_id} failed ({tag})", domain)
             elif t.job_name in self.stop_on_failure:
-                self._fail(f"stop-on-failure jobtype {t.job_name}: task "
+                self._fail_locked(f"stop-on-failure jobtype {t.job_name}: task "
                            f"{task_id} failed ({tag})", domain)
             elif self.fail_on_worker_failure:
-                self._fail(f"task {task_id} failed ({tag}) and "
+                self._fail_locked(f"task {task_id} failed ({tag}) and "
                            f"fail-on-worker-failure is enabled", domain)
 
     def restore_task(self, task_id: str, status: TaskStatus,
@@ -359,8 +387,8 @@ class Session:
                 t.status = TaskStatus.KILLED
                 t.exit_code = constants.EXIT_KILLED
 
-    def _fail(self, reason: str,
-              domain: Optional[FailureDomain] = None) -> None:
+    def _fail_locked(self, reason: str,
+                     domain: Optional[FailureDomain] = None) -> None:
         if self.status == SessionStatus.RUNNING:
             self.status = SessionStatus.FAILED
             self.failure_reason = reason
@@ -372,7 +400,7 @@ class Session:
     def fail(self, reason: str,
              domain: Optional[FailureDomain] = None) -> None:
         with self._lock:
-            self._fail(reason, domain)
+            self._fail_locked(reason, domain)
 
     # -- reduction --------------------------------------------------------
     def update_status(self) -> SessionStatus:
@@ -389,7 +417,7 @@ class Session:
                     domain = None
                     for t in failed:
                         domain = worst_domain(domain, t.failure_domain)
-                    self._fail(
+                    self._fail_locked(
                         f"{len(failed)} tracked task(s) failed: "
                         + ", ".join(t.task_id for t in failed[:5]),
                         domain)

@@ -91,6 +91,12 @@ class State:
         self.hazards: List[Dict[str, Any]] = []
         self._hazard_keys: Set[Tuple[str, str, Tuple[str, ...]]] = set()
         self.lock_sites: Set[str] = set()
+        #: race-detector hookup (devtools/race.py RaceState, duck-typed
+        #: to avoid the circular import): final lock release publishes a
+        #: happens-before edge (``send``), first acquire receives one
+        #: (``recv``), and the race detector reads locksets off
+        #: :meth:`held_locks`.
+        self.race: Optional[Any] = None
 
     # -- held-lock bookkeeping (thread-local) ----------------------------
     def _held(self) -> List[List[Any]]:
@@ -98,6 +104,14 @@ class State:
         if held is None:
             held = self._tls.held = []
         return held
+
+    def held_locks(self) -> Tuple[Any, ...]:
+        """The calling thread's current lockset (wrapper objects, outer-
+        most first) — the race detector's per-access lockset source."""
+        held = getattr(self._tls, "held", None)
+        if not held:
+            return ()
+        return tuple(entry[0] for entry in held)
 
     def note_acquired(self, lock: "SanitizedLock") -> None:
         held = self._held()
@@ -117,6 +131,8 @@ class State:
                     self.edges.setdefault(edge, {
                         "thread": threading.current_thread().name,
                         "at": _site_of_frame(3) or "?"})
+        if self.race is not None:
+            self.race.recv(lock)        # release→acquire HB edge (in)
 
     def note_released(self, lock: "SanitizedLock") -> None:
         held = self._held()
@@ -124,6 +140,10 @@ class State:
             if held[i][0] is lock:
                 held[i][2] -= 1
                 if held[i][2] <= 0:
+                    if self.race is not None:
+                        # Final release: publish everything this thread
+                        # did while holding (release→acquire HB edge).
+                        self.race.send(lock)
                     del held[i]
                 return
 
@@ -264,11 +284,130 @@ def io_lock() -> Any:
     return _REAL_LOCK()
 
 
+def raw_lock() -> Any:
+    """An always-raw Lock for the checker tooling's OWN internals (the
+    race detector's bookkeeping mutex): never wrapped, never tracked,
+    regardless of when the factories were patched."""
+    return _REAL_LOCK()
+
+
+class SanitizedEvent:
+    """Event wrapper for tony allocation sites: ``set`` → successful
+    ``wait`` is a happens-before handoff edge for the race detector
+    (devtools/race.py). The blocking wait itself still feeds
+    hold-while-blocking through the class-level patch on the real
+    Event — this wrapper only adds the HB half that was invisible."""
+
+    def __init__(self, inner: Any, site: str, state: State) -> None:
+        self._inner = inner
+        self.site = site
+        self._state = state
+
+    def set(self) -> None:
+        if self._state.race is not None:
+            self._state.race.send(self)
+        self._inner.set()
+
+    def clear(self) -> None:
+        self._inner.clear()
+
+    def is_set(self) -> bool:
+        return bool(self._inner.is_set())
+
+    def wait(self, timeout: Optional[float] = None) -> bool:
+        got = bool(self._inner.wait(timeout))
+        if got and self._state.race is not None:
+            self._state.race.recv(self)
+        return got
+
+    def __repr__(self) -> str:
+        return f"<SanitizedEvent {self.site} of {self._inner!r}>"
+
+
+class SanitizedCondition:
+    """Condition wrapper for tony allocation sites (bare
+    ``threading.Condition()`` — today these are invisible to the
+    sanitizer). It is lock-shaped: acquire/release feed the lock-order
+    graph and the thread's lockset exactly like a SanitizedLock, and
+    ``wait`` (1) DROPS the condition from the lockset for its duration —
+    the underlying primitive releases its lock, so holding it across the
+    wait is the design, not a hazard — (2) records hold-while-blocking
+    against any OTHER sanitized locks still held, and (3) receives the
+    notify side's happens-before edge."""
+
+    def __init__(self, inner: Any, site: str, state: State) -> None:
+        self._inner = inner
+        self.site = site
+        self._state = state
+        state.register_lock(site)
+
+    def acquire(self, blocking: bool = True, timeout: float = -1) -> bool:
+        got = self._inner.acquire(blocking, timeout)
+        if got:
+            self._state.note_acquired(self)  # type: ignore[arg-type]
+        return bool(got)
+
+    def release(self) -> None:
+        self._state.note_released(self)      # type: ignore[arg-type]
+        self._inner.release()
+
+    def __enter__(self) -> bool:
+        return self.acquire()
+
+    def __exit__(self, *exc: Any) -> None:
+        self.release()
+
+    def wait(self, timeout: Optional[float] = None) -> bool:
+        self._state.note_released(self)      # type: ignore[arg-type]
+        self._state.note_blocking("threading.Condition.wait")
+        try:
+            got = bool(self._inner.wait(timeout))
+        finally:
+            self._state.note_acquired(self)  # type: ignore[arg-type]
+        if got and self._state.race is not None:
+            self._state.race.recv(self)
+        return got
+
+    def wait_for(self, predicate: Any,
+                 timeout: Optional[float] = None) -> Any:
+        """Stdlib-shaped wait_for, routed through :meth:`wait` so every
+        underlying wait keeps the lockset/HB bookkeeping."""
+        endtime: Optional[float] = None
+        waittime = timeout
+        result = predicate()
+        while not result:
+            if waittime is not None:
+                if endtime is None:
+                    endtime = time.monotonic() + waittime
+                else:
+                    waittime = endtime - time.monotonic()
+                    if waittime <= 0:
+                        break
+            self.wait(waittime)
+            result = predicate()
+        return result
+
+    def notify(self, n: int = 1) -> None:
+        if self._state.race is not None:
+            self._state.race.send(self)
+        self._inner.notify(n)
+
+    def notify_all(self) -> None:
+        if self._state.race is not None:
+            self._state.race.send(self)
+        self._inner.notify_all()
+
+    def __repr__(self) -> str:
+        return f"<SanitizedCondition {self.site} of {self._inner!r}>"
+
+
 # ---------------------------------------------------------------------------
 # Global enablement: patch the factories + blocking primitives
 # ---------------------------------------------------------------------------
 _REAL_LOCK = threading.Lock
 _REAL_RLOCK = threading.RLock
+_REAL_EVENT = threading.Event
+_REAL_CONDITION = threading.Condition
 _state = State()
 _enabled = False
 _real: Dict[str, Any] = {}
@@ -280,6 +419,13 @@ def state() -> State:
 
 def enabled() -> bool:
     return _enabled
+
+
+def set_race_listener(race: Optional[Any]) -> None:
+    """Attach (or detach) the race detector to the GLOBAL sanitizer
+    state: lock acquire/release then feed its happens-before graph, and
+    it reads locksets via State.held_locks()."""
+    _state.race = race
 
 
 def _lock_factory() -> Any:
@@ -298,6 +444,28 @@ def _rlock_factory() -> Any:
     return SanitizedLock(inner, site, _state)
 
 
+def _event_factory() -> Any:
+    site = _site_of_frame(2)
+    inner = _REAL_EVENT()
+    if site is None:
+        return inner
+    return SanitizedEvent(inner, site, _state)
+
+
+def _condition_factory(lock: Optional[Any] = None) -> Any:
+    # Explicit-lock conditions keep the raw primitive: the lock they
+    # wrap is already sanitized if it came from a tony factory, and the
+    # real Condition drives it by duck-typing. (No such allocation site
+    # exists in the package today — bare Condition() is the shape.)
+    if lock is not None:
+        return _REAL_CONDITION(lock)
+    site = _site_of_frame(2)
+    inner = _REAL_CONDITION()
+    if site is None:
+        return inner
+    return SanitizedCondition(inner, site, _state)
+
+
 def enable() -> None:
     """Patch lock factories + blocking primitives (idempotent)."""
     global _enabled
@@ -309,6 +477,13 @@ def enable() -> None:
 
     threading.Lock = _lock_factory          # type: ignore[assignment]
     threading.RLock = _rlock_factory        # type: ignore[assignment]
+    # Event/Condition allocation sites are wrapped the same way — their
+    # set→wait / notify→wait handoffs feed the race detector's HB graph,
+    # and Condition.wait (previously invisible) now feeds
+    # hold-while-blocking. Stdlib-internal allocations (queue.Queue's
+    # conditions!) see a non-tony frame and stay raw.
+    threading.Event = _event_factory        # type: ignore[misc,assignment]
+    threading.Condition = _condition_factory  # type: ignore[misc,assignment]
 
     _real["sleep"] = time.sleep
 
@@ -335,13 +510,13 @@ def enable() -> None:
 
     subprocess.Popen.wait = _popen_wait     # type: ignore[method-assign]
 
-    _real["event_wait"] = threading.Event.wait
+    _real["event_wait"] = _REAL_EVENT.wait
 
     def _event_wait(self: Any, timeout: Optional[float] = None) -> bool:
         _state.note_blocking("threading.Event.wait")
         return _real["event_wait"](self, timeout)
 
-    threading.Event.wait = _event_wait      # type: ignore[method-assign]
+    _REAL_EVENT.wait = _event_wait          # type: ignore[method-assign]
 
     _real["create_connection"] = socket.create_connection
 
@@ -365,10 +540,12 @@ def disable() -> None:
 
     threading.Lock = _REAL_LOCK             # type: ignore[assignment]
     threading.RLock = _REAL_RLOCK           # type: ignore[assignment]
+    threading.Event = _REAL_EVENT           # type: ignore[misc]
+    threading.Condition = _REAL_CONDITION   # type: ignore[misc]
     time.sleep = _real["sleep"]
     os.fsync = _real["fsync"]
     subprocess.Popen.wait = _real["popen_wait"]
-    threading.Event.wait = _real["event_wait"]
+    _REAL_EVENT.wait = _real["event_wait"]  # type: ignore[method-assign]
     socket.create_connection = _real["create_connection"]
 
 

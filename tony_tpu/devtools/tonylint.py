@@ -75,6 +75,7 @@ import sys
 from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
 
 from tony_tpu.devtools.protocol import RULES_V2, run_protocol_rules
+from tony_tpu.devtools.race import RULES_RACE, run_race_rules
 
 #: rule id → one-line description (the ``--list`` surface and the doc table)
 RULES: Dict[str, str] = {
@@ -87,13 +88,18 @@ RULES: Dict[str, str] = {
     "clock": "time.time() never feeds deadline/duration arithmetic",
     "span-leak": "started spans are context-managed or .end()ed",
     "thread-leak": "threads are daemonized or joined",
-    "lock-blocking": "no blocking calls while holding coordinator locks",
+    "lock-blocking": "no blocking calls while holding coordinator/fleet "
+                     "locks",
     "bare-except": "no bare except:",
     "defaults-md": "conf/defaults.md matches the key registry",
 }
 # v2 protocol rules (devtools/protocol.py): the coordinator↔executor
 # directive/journal/fence/beacon/terminal/metrics contracts, both sides.
 RULES.update(RULES_V2)
+# guarded-by rules (devtools/race.py): the static half of the race
+# detector — GUARDED_BY-declared fields only touched under their lock,
+# and no undeclared shared-field stores on instrumented classes.
+RULES.update(RULES_RACE)
 
 _SUPPRESS_RE = re.compile(r"tony:\s*lint-ignore\[([a-z\-]+)\]")
 _KEY_TOKEN_RE = re.compile(
@@ -271,6 +277,7 @@ class Linter:
         if "defaults-md" in active:
             self._check_defaults_md()
         run_protocol_rules(self, pkg_srcs, active)
+        run_race_rules(self, pkg_srcs, active)
         self.findings.sort(key=lambda f: (f.file, f.line, f.rule))
         return self.findings
 
@@ -606,7 +613,12 @@ class Linter:
 
     # -- lock-blocking ---------------------------------------------------
     def _check_lock_blocking(self, src: _Src) -> None:
-        if (os.sep + "coordinator" + os.sep) not in src.rel:
+        # Control-plane scope: the coordinator AND the fleet daemon both
+        # hold locks that RPC handlers and monitor/scheduler ticks
+        # contend for (thread-leak needs no such extension — it already
+        # sweeps the whole package).
+        if not any((os.sep + d + os.sep) in src.rel
+                   for d in ("coordinator", "fleet")):
             return
         lock_attrs: Set[str] = set()
         for node in ast.walk(src.tree):

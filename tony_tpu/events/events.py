@@ -170,9 +170,12 @@ class EventHandler:
                     continue
                 if ev is None:
                     break
-                if isinstance(ev, threading.Event):
-                    # Flush barrier: everything queued before it is now
-                    # written; push it to disk and wake the waiter.
+                if not isinstance(ev, Event):
+                    # Flush barrier (a threading.Event — possibly the
+                    # sanitizer's wrapper, so match "not an event
+                    # record" rather than the concrete class):
+                    # everything queued before it is now written; push
+                    # it to disk and wake the waiter.
                     fsync_file(f)
                     dirty = False
                     ev.set()

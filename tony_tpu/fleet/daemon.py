@@ -46,6 +46,7 @@ from typing import Any, Deque, Dict, List, Optional
 
 from tony_tpu import constants, faults, tracing
 from tony_tpu.conf import keys as K
+from tony_tpu.devtools.race import guarded
 from tony_tpu.events.events import Event, EventHandler, EventType
 from tony_tpu.fleet import journal as fjournal
 from tony_tpu.fleet import ledger as fledger
@@ -274,7 +275,27 @@ class _FleetService:
         return True
 
 
+@guarded
 class FleetDaemon:
+    #: tonyrace registry (devtools/race.py + the guarded-by lint): the
+    #: job map, the policy-engine feed (_seq) and the goodput-ledger
+    #: caches are shared between the scheduler tick and the
+    #: fleet.submit/cancel/status/explain RPC threads — every touch
+    #: holds the daemon lock. The scalars are single-writer throttle/
+    #: degrade flags (atomic rebinds; a stale read costs one tick).
+    GUARDED_BY = {
+        "jobs": "_lock",
+        "_seq": "_lock",
+        "_ledgers": "_lock",
+        "_ledger_rollup": "_lock",
+        "_grant_waits": "_lock",
+        "_preempts_per_job": "_lock",
+        "_ledger_degraded": None,
+        "_ledger_next_mono": None,
+        "_explain_warned": None,
+        "_started": None,
+    }
+
     def __init__(self, fleet_dir: str, slices: int = 1,
                  hosts_per_slice: int = 8, quotas: str = "",
                  pool_dir: str = "", cache_root: str = "",
@@ -393,8 +414,11 @@ class FleetDaemon:
         jobs re-enqueue in submission order; running jobs are re-adopted
         by their recorded client pid; granted-but-never-started jobs
         re-spawn against their journaled grant; finished jobs keep their
-        verdicts for the status surface."""
-        self._seq = st.seq
+        verdicts for the status surface. Runs before the RPC plane is up,
+        but the map/engine mutations take the lock anyway — the
+        guarded-by discipline has no single-threaded carve-outs."""
+        with self._lock:
+            self._seq = st.seq
         for fold in sorted(st.jobs.values(), key=lambda f: f.seq):
             req = JobRequest(fold.job_id, fold.tenant,
                              priority=fold.priority,
@@ -419,12 +443,14 @@ class FleetDaemon:
                 # Restore the dedup fence: the recovered life must not
                 # re-journal the hold reason it already recorded.
                 job.denial = str(fold.decisions[-1].get("reason", ""))
-            self.jobs[fold.job_id] = job
+            with self._lock:
+                self.jobs[fold.job_id] = job
             if fold.state in fjournal.TERMINAL_STATES:
                 job.state = fold.state
                 continue
             if fold.state == "QUEUED":
-                self.engine.submit(req)
+                with self._lock:
+                    self.engine.submit(req)
                 job.queue_span = self.tracer.start_span(
                     "fleet.queue", task=fold.job_id,
                     attrs={"tenant": fold.tenant, "recovered": True,
@@ -435,7 +461,9 @@ class FleetDaemon:
             # between adopt, respawn, and post-mortem.
             app_id = fold.app_id or _discover_app(job.workdir)
             if fold.pid and _pid_alive(fold.pid):
-                self.engine.force_grant(req, fold.hosts, fold.placement)
+                with self._lock:
+                    self.engine.force_grant(req, fold.hosts,
+                                            fold.placement)
                 job.state = RUNNING
                 job.hosts = fold.hosts
                 job.placement = dict(fold.placement)
@@ -471,7 +499,8 @@ class FleetDaemon:
                 # produced an app: carry the grant out now — this is
                 # the zero-LOST-grants half of the recovery contract
                 # (the fgen record above licenses the re-grant).
-                self.engine.submit(req)
+                with self._lock:
+                    self.engine.submit(req)
                 job.state = QUEUED
                 job.queue_span = self.tracer.start_span(
                     "fleet.queue", task=fold.job_id,
@@ -695,11 +724,14 @@ class FleetDaemon:
     def _poll_jobs(self) -> None:
         done: List[_FleetJob] = []
         with self._lock:
-            candidates = [j for j in self.jobs.values()
+            # Snapshot (job, handle) pairs: a cancel RPC can terminalize
+            # a job (handle → None) between this scan and the poll —
+            # re-reading job.handle outside the lock would poll None.
+            candidates = [(j, j.handle) for j in self.jobs.values()
                           if j.handle is not None
                           and j.state in (GRANTED, RUNNING)]
-        for job in candidates:
-            rc = self.runner.poll(job.handle)
+        for job, handle in candidates:
+            rc = self.runner.poll(handle)
             if rc is None:
                 continue
             if job.cancelled:
@@ -1024,11 +1056,16 @@ class FleetDaemon:
             faults.check("fleet.ledger")
             if dirs is None:
                 dirs = fledger.job_history_dirs(self.fleet_dir)
-            self._ledgers[job.req.job_id] = fledger.compute_job_ledger(
+            # Compute OUTSIDE the lock (the fold reads job-dir files);
+            # only the cache install is a critical section — status()
+            # RPC threads snapshot the same maps under the same lock.
+            row = fledger.compute_job_ledger(
                 self._ledger_fold_input(job),
                 job_dir=dirs.get(job.app_id),
                 now_ms=int(time.time() * 1000))
-            self._ledger_rollup = None      # dirty: rebuilt on export
+            with self._lock:
+                self._ledgers[job.req.job_id] = row
+                self._ledger_rollup = None  # dirty: rebuilt on export
         except Exception as e:  # noqa: BLE001 — observability, not duty
             self._ledger_degraded = True
             log.warning(
@@ -1061,12 +1098,15 @@ class FleetDaemon:
     def _ledger_snapshot(self) -> Optional[Dict[str, Any]]:
         if self._ledger_degraded:
             return None
-        if self._ledger_rollup is None:
-            # list() first: status() runs on RPC threads while the tick
-            # thread folds — never iterate the live dict.
-            self._ledger_rollup = fledger.rollup(
-                list(self._ledgers.values()))
-        return self._ledger_rollup
+        # status() runs on RPC threads while the tick thread folds: the
+        # rollup cache check-then-build must be one critical section
+        # (the tonyrace bring-up flagged the unlocked read/write pair
+        # here — tick fold vs fleet.status).
+        with self._lock:
+            if self._ledger_rollup is None:
+                self._ledger_rollup = fledger.rollup(
+                    list(self._ledgers.values()))
+            return self._ledger_rollup
 
     # -- the decision explainer's query surface ---------------------------
     def explain(self, job_id: str) -> dict:

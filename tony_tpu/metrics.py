@@ -39,6 +39,8 @@ import threading
 import time
 from typing import Any, Deque, Dict, List, Optional, Tuple
 
+from tony_tpu.devtools.race import guarded
+
 #: Latency buckets (seconds) shared by RPC server/client histograms:
 #: sub-ms localhost dispatch up to the 10 s call-timeout ceiling.
 DEFAULT_LATENCY_BUCKETS_S = (0.001, 0.0025, 0.005, 0.01, 0.025, 0.05,
@@ -210,10 +212,26 @@ def render_histogram_lines(name: str, key: _LabelsKey,
     return lines
 
 
+@guarded
 class MetricsRegistry:
     """The coordinator's in-memory metrics store: gauges (ring-buffer
     series), counters (recover-persistent), histograms (local and
-    beacon-shipped snapshots), rendered as one Prometheus exposition."""
+    beacon-shipped snapshots), rendered as one Prometheus exposition.
+
+    Thread-safety: instruments are registered from beat/RPC threads
+    while the export worker renders — every registry-map touch holds
+    ``_lock`` (the ``GUARDED_BY`` declaration below is enforced at
+    runtime by the tonyrace detector, devtools/race.py)."""
+
+    #: tonyrace registry: every family map is guarded by the one lock.
+    GUARDED_BY = {
+        "_gauges": "_lock",
+        "_counters": "_lock",
+        "_hists": "_lock",
+        "_hist_snaps": "_lock",
+        "_help": "_lock",
+        "_saved_counters": "_lock",
+    }
 
     def __init__(self, ring_points: int = 512):
         self._ring_points = ring_points

@@ -60,6 +60,7 @@ import uuid
 from typing import Dict, List, Optional
 
 from tony_tpu import constants
+from tony_tpu.devtools.race import guarded
 
 log = logging.getLogger(__name__)
 
@@ -240,7 +241,17 @@ class _PoolService:
         return True
 
 
+@guarded
 class PoolDaemon:
+    #: tonyrace registry (devtools/race.py): the worker map and the
+    #: per-app generation fence are shared between the replenish loop
+    #: and pool.lease/discard/status RPC threads — every touch holds
+    #: the daemon lock.
+    GUARDED_BY = {
+        "_workers": "_lock",
+        "_gen_by_app": "_lock",
+    }
+
     def __init__(self, pool_dir: str, size: int = 2, preload: str = "jax",
                  max_lease_age_s: float = 600.0,
                  python: str = sys.executable,
