@@ -671,17 +671,22 @@ def run_fleet_suite(n_jobs=50, tick_s=0.2, timeout_s=420):
     arrival — drained through ONE in-process fleet daemon spawning
     real `tony-tpu submit` clients on LocalSim virtual executors.
     Headline = fleet goodput_fraction from the ledger; queue-wait
-    p50/p99, preemptions/job, warm-start fraction ride along. CPU-only,
-    no jax in this process (the virtual executors beat, they don't
-    compute)."""
+    p50/p99, preemptions/job, warm-start fraction ride along. A live
+    warm executor pool (tony_tpu/pool.py) backs the mix — a handful of
+    the jobs run REAL 1-host executors that adopt from it, so the
+    ledger's warm_start_fraction measures the adoption path instead of
+    pinning 0.0. CPU-only, no jax in this process (the virtual
+    executors beat, they don't compute; the pool preloads nothing)."""
     import shutil
     import tempfile
     import threading
 
     from tony_tpu.fleet.daemon import FleetDaemon
+    from tony_tpu.pool import PoolDaemon
 
     tmp = tempfile.mkdtemp(prefix="tony-bench-fleet-")
     fleet_dir = os.path.join(tmp, "fleet")
+    pool_dir = os.path.join(tmp, "pool")
     virtual = {
         "tony.worker.command": "virtual",
         "tony.scale.virtual-executors": "true",
@@ -689,20 +694,37 @@ def run_fleet_suite(n_jobs=50, tick_s=0.2, timeout_s=420):
         "tony.coordinator.monitor-interval-ms": "100",
         "tony.diagnosis.enabled": "false",
     }
+    # The warm-adoption jobs: real executors (the pool's adoption path
+    # lives in LocalProcessBackend), a no-op user command, 1 host each.
+    real = {
+        "tony.worker.command": "true",
+        "tony.task.heartbeat-interval-ms": "300",
+        "tony.coordinator.monitor-interval-ms": "100",
+        "tony.diagnosis.enabled": "false",
+    }
+    warm_jobs = 4
 
     def conf(run_s):
         c = dict(virtual)
         c["tony.scale.virtual-run-s"] = str(run_s)
         return c
 
+    pool = PoolDaemon(pool_dir, size=2, preload="", max_lease_age_s=600)
+    pool_runner = threading.Thread(target=pool.run, daemon=True,
+                                   name="bench-fleet-pool")
     daemon = FleetDaemon(fleet_dir, slices=2, hosts_per_slice=4,
                          quotas="capped=2", tick_s=tick_s,
-                         ledger_interval_s=2.0)
+                         ledger_interval_s=2.0, pool_dir=pool_dir)
     runner = threading.Thread(target=daemon.run, daemon=True,
                               name="bench-fleet-daemon")
-    point = {"jobs": n_jobs, "pool_hosts": 8}
+    point = {"jobs": n_jobs, "pool_hosts": 8, "warm_jobs": warm_jobs}
     try:
         t0 = time.monotonic()
+        pool_runner.start()
+        pool_deadline = t0 + 60
+        while pool.status()["ready"] < 1 \
+                and time.monotonic() < pool_deadline:
+            time.sleep(0.2)
         runner.start()
         # One whole-pool elastic victim; once it RUNS, a priority-10
         # demander arrives into the full pool — the preempt-to-reclaim
@@ -720,10 +742,14 @@ def run_fleet_suite(n_jobs=50, tick_s=0.2, timeout_s=420):
         daemon.submit("prod", 4, priority=10, conf=conf(1.0))
         sizes = (1, 2, 3, 4)
         submitted = 2
-        for i in range(n_jobs - 10):
+        for i in range(n_jobs - 10 - warm_jobs):
             tenant = "alpha" if i % 2 == 0 else "bravo"
             daemon.submit(tenant, sizes[i % 4], priority=i % 3,
                           conf=conf(0.5))
+            submitted += 1
+        for i in range(warm_jobs):
+            daemon.submit("alpha" if i % 2 == 0 else "bravo", 1,
+                          priority=1, conf=dict(real))
             submitted += 1
         for i in range(n_jobs - submitted):
             daemon.submit("capped", 1 + i % 2, conf=conf(0.5))
@@ -773,6 +799,8 @@ def run_fleet_suite(n_jobs=50, tick_s=0.2, timeout_s=420):
     finally:
         daemon.request_stop()
         runner.join(timeout=60)
+        pool.request_stop()
+        pool_runner.join(timeout=30)
         shutil.rmtree(tmp, ignore_errors=True)
     return {
         "metric": "fleet_goodput_fraction",
@@ -780,6 +808,171 @@ def run_fleet_suite(n_jobs=50, tick_s=0.2, timeout_s=420):
         "unit": "chip-seconds useful / chip-seconds held",
         "vs_baseline": None,
         "detail": {"suite": "fleet", "mix": point},
+    }
+
+
+def measure_migrate_point(width=16, target="slice-1", hb_interval_ms=300,
+                          monitor_interval_ms=100):
+    """One BENCH_MIGRATE move point: a gang of ``width`` beat-only
+    virtual executors against ONE coordinator; ``migrate_application``
+    drives the real drain→park→relaunch→barrier path to ``target`` and
+    the point records the wall from the operator request to the op
+    completing (every member re-registered on the destination). What a
+    live migration costs the control plane — the number the spot-
+    survival story hangs off (an evacuation must beat the preemption
+    notice's deadline)."""
+    import shutil
+    import threading
+
+    from tony_tpu.conf import keys as K
+    from tony_tpu.conf.config import TonyTpuConfig
+    from tony_tpu.cluster.local import VirtualExecutorBackend
+    from tony_tpu.coordinator.coordinator import Coordinator
+
+    tmp = tempfile.mkdtemp(prefix=f"tony-bench-migrate-{width}-")
+    conf = TonyTpuConfig()
+    conf.set("tony.worker.instances", width)
+    conf.set("tony.worker.command", "virtual")
+    conf.set(K.SCALE_VIRTUAL_EXECUTORS, True)
+    conf.set(K.TASK_HEARTBEAT_INTERVAL_MS, hb_interval_ms)
+    conf.set(K.COORDINATOR_MONITOR_INTERVAL_MS, monitor_interval_ms)
+    conf.set(K.ELASTIC_ENABLED, True)
+    conf.set(K.ELASTIC_BARRIER_TIMEOUT_S, 60)
+    conf.set(K.APPLICATION_NUM_CLIENTS_TO_WAIT, False)
+    conf.set(K.DIAGNOSIS_ENABLED, False)
+    backend = VirtualExecutorBackend.from_conf(
+        conf, os.path.join(tmp, "work"))
+    coord = Coordinator(conf, f"bench_migrate_{width}", backend,
+                        os.path.join(tmp, "history"), user="bench")
+    runner = threading.Thread(target=coord.run, daemon=True,
+                              name=f"migrate-coord-{width}")
+    point = {"tasks": width, "target": target}
+    try:
+        t0 = time.monotonic()
+        runner.start()
+        deadline = t0 + 120
+        while not coord.session.all_registered() \
+                and time.monotonic() < deadline:
+            time.sleep(0.02)
+        if not coord.session.all_registered():
+            raise RuntimeError(
+                f"rendezvous of {width} virtual tasks did not complete "
+                f"within 120s ({coord.session.num_registered} "
+                f"registered)")
+        point["rendezvous_s"] = round(time.monotonic() - t0, 3)
+        # The elastic manager marks the gang established one monitor
+        # tick after the barrier opens; a migrate before that is
+        # (correctly) refused.
+        while (coord.elastic is None or not coord.elastic.established) \
+                and time.monotonic() < deadline:
+            time.sleep(0.02)
+        t1 = time.monotonic()
+        res = coord.migrate_application(target)
+        if not res.get("ok"):
+            raise RuntimeError(
+                f"migration refused: {res.get('message', '?')}")
+        while coord.elastic is not None and coord.elastic.resizing \
+                and time.monotonic() - t1 < 90:
+            time.sleep(0.02)
+        if coord.elastic is not None and coord.elastic.resizing:
+            raise RuntimeError("migration did not complete in 90s")
+        point["migration_wall_s"] = round(time.monotonic() - t1, 3)
+        pool = coord.session.jobs.get("worker")
+        point["destination_pinned"] = bool(
+            pool is not None and pool.node_pool == target)
+    finally:
+        coord.request_stop("migrate bench point complete")
+        runner.join(timeout=60)
+        shutil.rmtree(tmp, ignore_errors=True)
+    return point
+
+
+def measure_migrate_ckpt_point(saves=10, payload_mb=4.0, step_s=0.05):
+    """The async-snapshot layer under the move: overlapped saves
+    (checkpoint/manager.py) of a ``payload_mb`` state against the same
+    loop run synchronously. ``ckpt_stall_fraction`` is save() blocking
+    time over the loop wall in overlapped mode — the number a
+    regression back to synchronous saves would spike — and the headline
+    ``ckpt_overlap_fraction`` is the share of the synchronous save cost
+    the background writer hides. Local disk, CPU-only jax (the host-
+    snapshot copy), CI-sized."""
+    import shutil
+
+    import numpy as np
+
+    from tony_tpu.checkpoint.manager import CheckpointManager
+
+    tmp = tempfile.mkdtemp(prefix="tony-bench-migrate-ckpt-")
+    state = {"params": np.zeros(int(payload_mb * 1024 * 1024 / 4),
+                                dtype=np.float32)}
+    point = {"saves": saves, "payload_mb": payload_mb}
+
+    def loop(async_save, sub):
+        mgr = CheckpointManager(os.path.join(tmp, sub), max_to_keep=2,
+                                async_save=async_save)
+        block = 0.0
+        t0 = time.monotonic()
+        for step in range(saves):
+            t = time.monotonic()
+            mgr.save(step, state, force=True)
+            block += time.monotonic() - t
+            time.sleep(step_s)     # the training step the save overlaps
+        wall = time.monotonic() - t0
+        t = time.monotonic()
+        mgr.wait()
+        drain = time.monotonic() - t
+        mgr.close()
+        if mgr.async_errors:
+            raise RuntimeError(
+                f"async save errors: {mgr.async_errors[:3]}")
+        return block, wall, drain
+
+    try:
+        sync_block, _, _ = loop(False, "sync")
+        block, wall, drain = loop(True, "overlap")
+        point["ckpt_stall_fraction"] = round(block / wall, 4)
+        point["ckpt_overlap_fraction"] = round(
+            max(0.0, 1.0 - block / sync_block), 4) if sync_block > 0 \
+            else None
+        point["sync_save_block_s"] = round(sync_block, 3)
+        point["ckpt_drain_s"] = round(drain, 3)
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+    return point
+
+
+def run_migrate_suite(width=16):
+    """The BENCH_MIGRATE family (persisted as BENCH_MIGRATE_r*.json,
+    gated by `tony-tpu bench diff` like every other family): what a
+    live migration costs, at its two layers — the control-plane move
+    (drain→park→relaunch→barrier wall at width, on virtual executors)
+    and the async snapshot under it (save-stall fraction vs the
+    synchronous baseline). Headline = ckpt_overlap_fraction (1.0 =
+    snapshots cost the training loop nothing). The e2e drills
+    (tests/test_e2e_migrate.py) pin the OTHER family numbers —
+    steps_lost == 0 and retry budget untouched — so the suite measures
+    cost, not correctness. CPU-only, CI-sized."""
+    detail = {"suite": "migrate"}
+    try:
+        detail["move"] = _retry(
+            "migrate-move", lambda: measure_migrate_point(width),
+            attempts=2, backoff_s=2.0)
+    except Exception as e:  # noqa: BLE001 — keep the ckpt point
+        print(f"# migrate move point failed: {e}", file=sys.stderr)
+        detail["move"] = {"error": str(e)[:300]}
+    try:
+        detail["ckpt"] = _retry(
+            "migrate-ckpt", measure_migrate_ckpt_point,
+            attempts=2, backoff_s=2.0)
+    except Exception as e:  # noqa: BLE001
+        print(f"# migrate ckpt point failed: {e}", file=sys.stderr)
+        detail["ckpt"] = {"error": str(e)[:300]}
+    return {
+        "metric": "ckpt_overlap_fraction",
+        "value": (detail.get("ckpt") or {}).get("ckpt_overlap_fraction"),
+        "unit": "fraction of sync save cost hidden by overlap",
+        "vs_baseline": None,
+        "detail": detail,
     }
 
 
@@ -794,7 +987,8 @@ def main(argv=None):
                          "regression")
     ap.add_argument("--tolerance", type=float, default=0.10,
                     help="relative regression tolerance for --against")
-    ap.add_argument("--suite", choices=("default", "scale", "fleet"),
+    ap.add_argument("--suite",
+                    choices=("default", "scale", "fleet", "migrate"),
                     default="default",
                     help="'scale' runs the control-plane width family "
                          "(BENCH_SCALE: rendezvous/beats/tick/journal/"
@@ -803,15 +997,19 @@ def main(argv=None):
                          "50-job synthetic tenant mix through one "
                          "fleet daemon (BENCH_FLEET: goodput fraction, "
                          "queue-wait p50/p99, preemptions/job, warm-"
-                         "start fraction) instead of the training "
-                         "bench")
+                         "start fraction); 'migrate' measures a live "
+                         "migration's two layers (BENCH_MIGRATE: "
+                         "drain→relaunch wall at width, async-snapshot "
+                         "stall vs the sync baseline) instead of the "
+                         "training bench")
     ap.add_argument("--out", default="",
                     help="also write the bench json to this path")
     args = ap.parse_args(argv)
 
-    if args.suite in ("scale", "fleet"):
-        doc = run_scale_suite() if args.suite == "scale" \
-            else run_fleet_suite()
+    if args.suite in ("scale", "fleet", "migrate"):
+        doc = {"scale": run_scale_suite,
+               "fleet": run_fleet_suite,
+               "migrate": run_migrate_suite}[args.suite]()
         print(json.dumps(doc))
         if args.out:
             with open(args.out, "w", encoding="utf-8") as f:

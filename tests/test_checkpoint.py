@@ -341,6 +341,105 @@ def test_reshard_in_memory_helper():
         assert out[key].sharding == sh[key]
 
 
+# ---------------------------------------------------------------------------
+# Overlapped writer (async_save=True): a save never stalls a step, queued
+# saves coalesce newest-wins, and a crashed background write leaves the
+# last committed manifest as the restore point (manifest-last commit)
+# ---------------------------------------------------------------------------
+def test_async_save_never_blocks_the_training_thread(tmp_path):
+    """save() in overlapped mode pays only the device→host snapshot:
+    with the inner orbax save artificially slowed, the save call returns
+    long before the write finishes — wait() is the durability barrier
+    where the wall time actually goes."""
+    import time as _time
+
+    mgr = CheckpointManager(str(tmp_path / "c"), async_save=True)
+    real_save = mgr._mgr.save
+
+    def slow_save(step, *a, **kw):
+        _time.sleep(0.5)
+        return real_save(step, *a, **kw)
+
+    mgr._mgr.save = slow_save
+    try:
+        t0 = _time.monotonic()
+        assert mgr.save(1, {"w": jnp.arange(64.0)}, force=True)
+        enqueue_wall = _time.monotonic() - t0
+        assert enqueue_wall < 0.4, \
+            f"overlapped save stalled the step for {enqueue_wall:.2f}s"
+        mgr.wait()
+        assert mgr.verify_step(1)
+        assert not mgr.async_errors
+    finally:
+        mgr.close()
+
+
+def test_async_double_save_coalesces_newest_wins(tmp_path):
+    """With the writer wedged on step 1, steps 2 and 3 queue back to
+    back: 2 is superseded by 3 before it ever starts (coalesced_saves),
+    so the writer never falls behind a fast save cadence."""
+    import threading as _threading
+    import time as _time
+
+    mgr = CheckpointManager(str(tmp_path / "c"), async_save=True)
+    gate = _threading.Event()
+    real_save = mgr._mgr.save
+
+    def gated_save(step, *a, **kw):
+        if int(step) == 1:
+            gate.wait(timeout=30)
+        return real_save(step, *a, **kw)
+
+    mgr._mgr.save = gated_save
+    try:
+        assert mgr.save(1, {"w": jnp.zeros(4)}, force=True)
+        deadline = _time.monotonic() + 10
+        while mgr._winflight != 1:      # writer picked step 1 up
+            assert _time.monotonic() < deadline
+            _time.sleep(0.01)
+        assert mgr.save(2, {"w": jnp.ones(4)}, force=True)
+        assert mgr.save(3, {"w": jnp.full(4, 3.0)}, force=True)
+        gate.set()
+        mgr.wait()
+        assert mgr.coalesced_saves == 1
+        steps = sorted(int(s) for s in mgr._mgr.all_steps())
+        assert steps == [1, 3]          # 2 was never written
+        assert mgr.verify_step(1) and mgr.verify_step(3)
+        restored = mgr.restore(None, {"w": jnp.zeros(4)})
+        np.testing.assert_array_equal(np.asarray(restored["w"]),
+                                      np.full(4, 3.0))
+    finally:
+        gate.set()
+        mgr.close()
+
+
+def test_crash_mid_async_save_restores_newest_committed_step(tmp_path):
+    """The ckpt.async-write fault kills the background write of step 2
+    after step 1 committed: step 2 gets NO manifest (manifest-last =
+    the commit point), the failure lands in async_errors instead of
+    crashing training, and restore(None) comes back from step 1."""
+    from tony_tpu import faults
+
+    mgr = CheckpointManager(str(tmp_path / "c"), async_save=True)
+    try:
+        assert mgr.save(1, {"w": jnp.arange(4.0)}, force=True)
+        mgr.wait()
+        assert mgr.verify_step(1)
+        faults.install(faults.FaultInjector({"ckpt.async-write":
+                                             "first:1"}))
+        assert mgr.save(2, {"w": jnp.arange(4.0) * 2}, force=True)
+        mgr.wait()
+        assert mgr.async_errors and "step 2" in mgr.async_errors[0]
+        assert not os.path.exists(mgr.manifest_path(2))
+        assert mgr.latest_verified_step() == 1
+        restored = mgr.restore(None, {"w": jnp.zeros(4)})
+        np.testing.assert_array_equal(np.asarray(restored["w"]),
+                                      np.arange(4.0))
+    finally:
+        faults.uninstall()
+        mgr.close()
+
+
 def test_checkpoint_save_fault_site(tmp_path):
     from tony_tpu import faults
 

@@ -313,11 +313,13 @@ class FakeRunner:
     """SubprocessJobRunner stand-in: records spawns/resizes, exits on
     command."""
 
-    def __init__(self, resize_ok=True):
+    def __init__(self, resize_ok=True, migrate_ok=True):
         self.spawned = []          # (workdir, overrides, handle)
         self.resized = []          # (workdir, size)
+        self.migrated = []         # (workdir, target node pool)
         self.killed = []
         self.resize_ok = resize_ok
+        self.migrate_ok = migrate_ok
         self._next_pid = 1000
 
     def spawn(self, workdir, overrides):
@@ -333,6 +335,10 @@ class FakeRunner:
     def resize(self, workdir, size):
         self.resized.append((workdir, size))
         return self.resize_ok
+
+    def migrate(self, workdir, target):
+        self.migrated.append((workdir, target))
+        return self.migrate_ok
 
     def kill(self, workdir):
         self.killed.append(workdir)
@@ -475,6 +481,141 @@ def test_fleet_preempt_fault_defers_victim_untouched(tmp_path):
     d.tick()                                       # retried, lands
     assert runner.resized[-1][1] == 2
     d._shutdown()
+
+
+# ---------------------------------------------------------------------------
+# Live migration: defrag, slice evacuation, the operator RPC
+# ---------------------------------------------------------------------------
+def test_daemon_defrags_by_live_migration_nobody_shrinks(tmp_path):
+    d = _daemon(tmp_path)
+    runner = d.runner
+    j1 = d.submit("t", 2, min_hosts=1, conf={})["job"]
+    d.tick()
+    j2 = d.submit("t", 2, min_hosts=1, conf={})["job"]
+    d.tick()                       # slice 0 full
+    j3 = d.submit("t", 2, min_hosts=1, conf={})["job"]
+    d.tick()                       # j3 lands on slice 1
+    runner.handle_for(j2).exit = 0
+    d.tick()                       # 2+2 free, split across both slices
+    big = d.submit("t2", 4, conf={})["job"]
+    d.tick()                       # fragmentation cure: one live move
+    # the youngest sub-slice job moved; its host count never changed
+    assert runner.migrated == [
+        (os.path.join(d.fleet_dir, "jobs", j3), "slice-0")]
+    assert d.jobs[j3].placement == {0: 2}
+    assert _job_row(d, j3)["hosts"] == 2
+    # nobody shrank, nobody died for the repack
+    assert runner.resized == [] and runner.killed == []
+    d.tick()                       # merged hole grants the demander
+    assert _job_row(d, big)["state"] == RUNNING
+    assert _job_row(d, j1)["state"] == RUNNING
+    d._shutdown()
+    evs = [e for e in read_events(
+        os.path.join(d.fleet_dir, constants.FLEET_EVENTS_FILE))
+        if e.type == EventType.FLEET_JOB_MIGRATED]
+    assert len(evs) == 1 and evs[0].payload["job"] == j3
+    assert "defragmentation" in evs[0].payload["reason"]
+
+
+def test_slice_preempt_notice_evacuates_elastic_jobs(tmp_path):
+    d = _daemon(tmp_path)
+    runner = d.runner
+    mover = d.submit("t", 2, min_hosts=1, conf={})["job"]
+    pinned = d.submit("t", 2, conf={})["job"]      # no shrink floor
+    d.tick()                       # both land on slice 0 (best fit)
+    assert d.jobs[mover].placement == {0: 2}
+    assert d.jobs[pinned].placement == {0: 2}
+    faults.install(faults.FaultInjector({"slice.preempt": "first:1"}))
+    d.tick()                       # notice -> slice 0 dying -> evacuate
+    assert d.status()["pool"]["dying"] == [0]
+    # the elastic job moved off the dying slice BEFORE the reclaim
+    assert runner.migrated == [
+        (os.path.join(d.fleet_dir, "jobs", mover), "slice-1")]
+    assert d.jobs[mover].placement == {1: 2}
+    # the job without the elastic machinery stays: the ordinary
+    # host-loss ladder absorbs it when the slice actually dies
+    assert d.jobs[pinned].placement == {0: 2}
+    d.tick()                       # dying is sticky, move is not redone
+    assert len(runner.migrated) == 1
+    assert d.status()["pool"]["dying"] == [0]
+    d._shutdown()
+    evs = [e for e in read_events(
+        os.path.join(d.fleet_dir, constants.FLEET_EVENTS_FILE))
+        if e.type == EventType.FLEET_JOB_MIGRATED]
+    assert len(evs) == 1 and "preemption notice" in evs[0].payload["reason"]
+
+
+def test_operator_migrate_validations_and_success(tmp_path):
+    d = _daemon(tmp_path)
+    runner = d.runner
+    j1 = d.submit("t", 2, min_hosts=1, conf={})["job"]
+    d.tick()                       # slice 0
+    filler = d.submit("t", 4, conf={})["job"]
+    d.tick()                       # slice 1 full
+    queued = d.submit("t", 8, conf={})["job"]      # never fits now
+    d.tick()
+
+    assert "unknown job" in d.migrate("fj-9999", 1)["message"]
+    assert "not RUNNING" in d.migrate(queued, 1)["message"]
+    assert "outside the pool" in d.migrate(j1, 7)["message"]
+    assert "already runs on slice 0" in d.migrate(j1, 0)["message"]
+    res = d.migrate(j1, 1)         # slice 1 is full
+    assert not res["ok"] and "free host(s)" in res["message"]
+    assert runner.migrated == []   # every refusal is RPC-free
+
+    runner.handle_for(filler).exit = 0
+    d.tick()
+    res = d.migrate(j1, 1)
+    assert res["ok"] and res["source"] == 0 and res["target"] == 1
+    assert res["placement"] == {"1": 2}
+    assert d.jobs[j1].placement == {1: 2}
+    assert runner.migrated[-1][1] == "slice-1"
+    d._shutdown()
+
+
+def test_operator_migrate_refused_by_coordinator_changes_nothing(tmp_path):
+    d = _daemon(tmp_path, runner=FakeRunner(migrate_ok=False))
+    j1 = d.submit("t", 2, min_hosts=1, conf={})["job"]
+    d.tick()
+    res = d.migrate(j1, 1)
+    assert not res["ok"] and "refused the move" in res["message"]
+    assert d.jobs[j1].placement == {0: 2}          # accounting untouched
+    d._shutdown()
+    recs = [json.loads(line) for line in open(
+        os.path.join(d.fleet_dir, constants.FLEET_JOURNAL_FILE))]
+    assert not [r for r in recs if r.get("t") == fj.REC_FLEET_MIGRATE]
+
+
+def test_recover_replays_migrated_placement(tmp_path):
+    fleet_dir = str(tmp_path / "fleet")
+    d = _daemon(tmp_path)
+    j1 = d.submit("t", 2, min_hosts=1, conf={})["job"]
+    d.tick()
+    assert d.migrate(j1, 1)["ok"]
+    # SIGKILL shape: no shutdown; pin the journaled pid to a live one
+    # so recovery adopts the running job instead of post-morteming it
+    d.journal.close()
+    jpath = os.path.join(fleet_dir, constants.FLEET_JOURNAL_FILE)
+    recs = [json.loads(line) for line in open(jpath)]
+    for r in recs:
+        if r.get("t") == fj.REC_FLEET_STATE and r.get("pid"):
+            r["pid"] = os.getpid()
+    with open(jpath, "w") as f:
+        for r in recs:
+            f.write(json.dumps(r) + "\n")
+    d2 = FleetDaemon(fleet_dir, slices=2, hosts_per_slice=4,
+                     runner=FakeRunner(), recover=True)
+    row = _job_row(d2, j1)
+    assert row["state"] == RUNNING and row["hosts"] == 2
+    # the fold replays the MOVED placement — the job is accounted on
+    # its destination slice, host count never drifted
+    assert d2.jobs[j1].placement == {1: 2}
+    assert d2.status()["pool"]["used"] == 2
+    d2._shutdown()
+    from tony_tpu.devtools import invariants
+
+    rep = invariants.check_job_dir(fleet_dir)
+    assert rep.ok, invariants.render_text([rep])
 
 
 def test_daemon_cancel_queued_and_running(tmp_path):

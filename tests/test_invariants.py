@@ -229,6 +229,95 @@ def test_applied_resize_resets_the_terminal_fold(tmp_path):
     assert _violations(job) == []
 
 
+def test_migrate_lifecycle_clean_and_stale_mgen_flagged(tmp_path):
+    """A start/applied migration pair is the clean drill shape; a LOWER
+    mgen migration frame after the fence is a stale-slice record."""
+    job = tmp_path / "job"
+    ok = _base_journal() + [
+        {"t": "migrate", "job": "worker", "mgen": 2, "members": [0],
+         "phase": "start", "target": "slice-1", "session": 0,
+         "reason": "defrag"},
+        {"t": "migrate", "job": "worker", "mgen": 2, "members": [0],
+         "phase": "applied", "target": "slice-1", "session": 0},
+    ]
+    _write_journal(str(job), ok)
+    assert _violations(job) == []
+
+    bad = ok + [
+        {"t": "migrate", "job": "worker", "mgen": 1, "members": [0],
+         "phase": "start", "target": "slice-2", "session": 0,
+         "reason": "stale"},
+    ]
+    _write_journal(str(job), bad)
+    v = _violations(job, "journal-migrate-mgen-monotonic")
+    assert len(v) == 1
+    assert "mgen 1 steps back from 2" in v[0].message
+
+
+def test_dangling_migrate_start_flagged_only_on_succeeded_jobs(tmp_path):
+    recs = _base_journal() + [
+        {"t": "migrate", "job": "worker", "mgen": 2, "members": [0],
+         "phase": "start", "target": "slice-1", "session": 0,
+         "reason": "defrag"},
+    ]
+    # A coordinator killed mid-migration leaves the start open — that
+    # IS the --recover re-entry record: a note, not a violation.
+    job = tmp_path / "unfinished"
+    _write_journal(str(job), recs)
+    rep = invariants.check_job_dir(str(job))
+    assert rep.ok
+    assert any("mid-migration" in n for n in rep.notes)
+    # SUCCEEDED job: a migration left in flight is a protocol breach.
+    job2 = tmp_path / "finished"
+    _write_journal(str(job2), recs)
+    _finalize(str(job2))
+    v = _violations(job2, "journal-migrate-dangling")
+    assert len(v) == 1
+    assert "mgen 2" in v[0].message and "never applied" in v[0].message
+
+
+def test_superseded_migrate_folds_into_the_elastic_ladder(tmp_path):
+    """A host loss mid-migration writes phase=superseded and the
+    ordinary shrink takes over — the start is closed, no dangle."""
+    job = tmp_path / "job"
+    _write_journal(str(job), _base_journal() + [
+        {"t": "migrate", "job": "worker", "mgen": 2, "members": [0, 1],
+         "phase": "start", "target": "slice-1", "session": 0,
+         "reason": "defrag"},
+        {"t": "migrate", "job": "worker", "mgen": 2, "members": [0, 1],
+         "phase": "superseded", "target": "slice-1", "session": 0,
+         "reason": "host lost mid-migration"},
+        {"t": "resize", "job": "worker", "mgen": 3, "members": [0],
+         "phase": "start", "session": 0, "reason": "host loss"},
+        {"t": "resize", "job": "worker", "mgen": 3, "members": [0],
+         "phase": "applied", "session": 0},
+        {"t": "task", "task": "worker:0", "status": "SUCCEEDED",
+         "session": 0, "exit": 0},
+    ])
+    _finalize(str(job))
+    assert _violations(job) == []
+
+
+def test_applied_migrate_resets_the_terminal_fold(tmp_path):
+    """Destination launches reuse the member indices: after an applied
+    migration the fresh SCHEDULED records must NOT read as terminal
+    resurrections (the source gang's fold is superseded, mirroring
+    replay())."""
+    job = tmp_path / "job"
+    _write_journal(str(job), _base_journal() + [
+        {"t": "task", "task": "worker:0", "status": "KILLED",
+         "session": 0, "exit": 137},
+        {"t": "migrate", "job": "worker", "mgen": 2, "members": [0],
+         "phase": "start", "target": "slice-1", "session": 0,
+         "reason": "evacuation"},
+        {"t": "migrate", "job": "worker", "mgen": 2, "members": [0],
+         "phase": "applied", "target": "slice-1", "session": 0},
+        {"t": "task", "task": "worker:0", "status": "SCHEDULED",
+         "session": 0},
+    ])
+    assert _violations(job) == []
+
+
 # ---------------------------------------------------------------------------
 # span-log invariants
 # ---------------------------------------------------------------------------

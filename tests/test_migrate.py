@@ -1,0 +1,541 @@
+"""Live job migration units (coordinator/migrate.py + the migrate arm of
+the elastic op machinery): the plan_migration policy matrix, whole-gang
+drain semantics, REC_MIGRATE journal replay, the full coordinator op
+lifecycle (drain -> apply -> barrier -> completed), fault-site degrades
+(migrate.snapshot / migrate.adopt), supersede-by-host-loss, and the
+--recover re-entry of a mid-migration crash. The slow end-to-end drill
+(real executors, steps_lost == 0) lives in tests/test_e2e_elastic.py."""
+
+import json
+import os
+
+import pytest
+
+from tony_tpu import constants, faults
+from tony_tpu.conf import keys as K
+from tony_tpu.conf.config import TonyTpuConfig
+from tony_tpu.coordinator import journal
+from tony_tpu.coordinator.elastic import BARRIER, DRAIN, ElasticManager
+from tony_tpu.coordinator.migrate import MigrateRefused, plan_migration
+from tony_tpu.coordinator.session import (FailureDomain, Session,
+                                          SessionStatus, TaskStatus)
+from tony_tpu.events.events import EventType
+
+pytestmark = pytest.mark.faults
+
+
+def _conf(workers=4, **overrides):
+    conf = TonyTpuConfig()
+    conf.set("tony.worker.instances", workers)
+    conf.set(K.ELASTIC_ENABLED, True)
+    conf.set(K.ELASTIC_MIN_TASKS, 2)
+    for k, v in overrides.items():
+        conf.set(k, v)
+    return conf
+
+
+def _session(conf, registered=True, node_pool=""):
+    s = Session(conf)
+    if node_pool:
+        s.jobs["worker"].node_pool = node_pool
+    if registered:
+        for t in s.all_tasks():
+            s.register_worker(t.task_id, "h", 1000 + t.index)
+    return s
+
+
+def _manager(conf):
+    clock = {"t": 0.0}
+    el = ElasticManager(conf, now_fn=lambda: clock["t"])
+    el.established = True
+    return el, clock
+
+
+# ---------------------------------------------------------------------------
+# plan_migration: the policy matrix (pure reads, refusals never fail jobs)
+# ---------------------------------------------------------------------------
+def test_plan_migration_happy_path():
+    conf = _conf()
+    el, _ = _manager(conf)
+    s = _session(conf, node_pool="slice-0")
+    plan = plan_migration(el, s, "slice-1", reason="defrag")
+    assert plan.job == "worker"
+    assert plan.members == [0, 1, 2, 3]
+    assert plan.source == "slice-0"
+    assert plan.target == "slice-1"
+    assert plan.reason == "defrag"
+    # default reason names the destination
+    assert "slice-1" in plan_migration(el, s, "slice-1").reason
+
+
+def test_plan_migration_refusal_matrix():
+    conf = _conf()
+    el, _ = _manager(conf)
+    s = _session(conf, node_pool="slice-0")
+
+    # elasticity off (or no manager at all)
+    with pytest.raises(MigrateRefused, match="elastic drain machinery"):
+        plan_migration(None, s, "slice-1")
+    off = ElasticManager(TonyTpuConfig())
+    with pytest.raises(MigrateRefused, match="elastic drain machinery"):
+        plan_migration(off, s, "slice-1")
+
+    # wrong jobtype
+    with pytest.raises(MigrateRefused, match="not the elastic jobtype"):
+        plan_migration(el, s, "slice-1", job="ps")
+
+    # gang not established yet
+    fresh = ElasticManager(conf)
+    with pytest.raises(MigrateRefused, match="initial rendezvous"):
+        plan_migration(fresh, s, "slice-1")
+
+    # no target / already there
+    with pytest.raises(MigrateRefused, match="no target slice"):
+        plan_migration(el, s, "  ")
+    with pytest.raises(MigrateRefused,
+                       match="already runs on slice 'slice-0'"):
+        plan_migration(el, s, "slice-0")
+
+    # no live members left
+    dead = _session(conf, node_pool="slice-0")
+    for t in dead.all_tasks():
+        t.status = TaskStatus.KILLED
+    with pytest.raises(MigrateRefused, match="no live worker tasks"):
+        plan_migration(el, dead, "slice-1")
+
+
+def test_plan_migration_refused_while_op_in_flight():
+    conf = _conf()
+    el, _ = _manager(conf)
+    s = _session(conf)
+    # a plain resize blocks a migrate...
+    el.begin([0, 1, 2], s.all_tasks(), "shrink")
+    with pytest.raises(MigrateRefused,
+                       match="a resize is already in progress"):
+        plan_migration(el, s, "slice-1")
+    el.finish()
+    # ...and so does another migration (the message names which)
+    el.begin([0, 1, 2, 3], s.all_tasks(), "move", target="slice-2",
+             migrate=True)
+    with pytest.raises(MigrateRefused,
+                       match="a migration is already in progress"):
+        plan_migration(el, s, "slice-1")
+
+
+def test_plan_migration_skips_terminal_members():
+    conf = _conf()
+    el, _ = _manager(conf)
+    s = _session(conf)
+    s.tasks["worker:2"].status = TaskStatus.KILLED
+    plan = plan_migration(el, s, "slice-1")
+    assert plan.members == [0, 1, 3]
+    # no node-pool pin (local/virtual backend): source is empty, and a
+    # same-name target cannot be "already there"
+    assert plan.source == ""
+
+
+# ---------------------------------------------------------------------------
+# ElasticManager: the migrate op drains the WHOLE gang, releases nobody
+# ---------------------------------------------------------------------------
+def test_migrate_op_drains_all_members_no_releases():
+    conf = _conf()
+    el, _ = _manager(conf)
+    s = _session(conf)
+    op = el.begin([0, 1, 2, 3], s.all_tasks(), "move to slice-1",
+                  target="slice-1", migrate=True)
+    assert op.migrate and op.target == "slice-1"
+    assert op.mgen == 2
+    assert op.awaiting == {f"worker:{i}" for i in range(4)}
+    assert op.release == set()
+    # every member's directive is a DRAIN (a migrate never releases)
+    for i in range(4):
+        d = el.directive_for(f"worker:{i}")
+        assert d["action"] == "drain" and d["mgen"] == 2
+    snap = el.snapshot()
+    assert snap["resizing"] and snap["migrating_to"] == "slice-1"
+
+
+def test_migrate_op_parks_on_mgen_ack_and_fences_stale_frames():
+    conf = _conf()
+    el, _ = _manager(conf)
+    s = _session(conf)
+    el.begin([0, 1, 2, 3], s.all_tasks(), "move", target="slice-1",
+             migrate=True)
+    # a stale-slice frame carrying the OLD generation never parks
+    assert not el.ack_registration("worker:0", 1)
+    assert not el.drain_complete
+    for i in range(4):
+        assert el.ack_registration(f"worker:{i}", 2)
+    assert el.drain_complete
+    el.mark_remeshed()
+    assert el.op.phase == BARRIER
+    done = el.finish()
+    assert done.migrate and done.target == "slice-1"
+    assert not el.resizing
+    # post-op: stale generations are fenced again (no op to excuse them)
+    assert "stale membership generation" in el.fences_frame(True, 1)
+
+
+def test_plain_begin_supersedes_migrate_into_ordinary_shrink():
+    conf = _conf()
+    el, clock = _manager(conf)
+    s = _session(conf)
+    op = el.begin([0, 1, 2, 3], s.all_tasks(), "move", target="slice-1",
+                  migrate=True)
+    clock["t"] = 5.0
+    shrunk = el.begin([0, 1, 2], s.all_tasks(), "lost worker:3")
+    assert not shrunk.migrate and shrunk.target == ""
+    assert shrunk.mgen == 3
+    # the barrier timeout bounds the WHOLE disturbance: the superseding
+    # op keeps the original start time
+    assert shrunk.started == op.started
+
+
+# ---------------------------------------------------------------------------
+# Journal: REC_MIGRATE write-ahead replay
+# ---------------------------------------------------------------------------
+def _replay_records(tmp_path, recs):
+    path = os.path.join(str(tmp_path), "j.jsonl")
+    with open(path, "w") as f:
+        for r in recs:
+            f.write(json.dumps(r) + "\n")
+    return journal.replay(path)
+
+
+_HEAD = [
+    {"t": "gen", "generation": 1},
+    {"t": "epoch", "session": 0, "infra_used": 0, "preempt_used": 0},
+    {"t": "job_scheduled", "job": "worker", "session": 0},
+]
+
+
+def test_replay_inflight_migrate_survives_crash(tmp_path):
+    st = _replay_records(tmp_path, _HEAD + [
+        {"t": "migrate", "job": "worker", "mgen": 2,
+         "members": [0, 1, 2, 3], "phase": "start", "target": "slice-1",
+         "session": 0, "reason": "defrag"},
+    ])
+    assert st.inflight_migrate_job == "worker"
+    assert st.inflight_migrate_mgen == 2
+    assert st.inflight_migrate_members == [0, 1, 2, 3]
+    assert st.inflight_migrate_target == "slice-1"
+    assert st.inflight_migrate_reason == "defrag"
+
+
+def test_replay_applied_migrate_pins_target_and_clears_task_fold(tmp_path):
+    st = _replay_records(tmp_path, _HEAD + [
+        *[{"t": "register", "task": f"worker:{i}", "host": "h",
+           "port": 1000 + i, "session": 0} for i in range(4)],
+        {"t": "migrate", "job": "worker", "mgen": 2,
+         "members": [0, 1, 2, 3], "phase": "start", "target": "slice-1",
+         "session": 0, "reason": "defrag"},
+        {"t": "migrate", "job": "worker", "mgen": 2,
+         "members": [0, 1, 2, 3], "phase": "applied", "target": "slice-1",
+         "session": 0},
+    ])
+    assert st.migrated_target == {"worker": "slice-1"}
+    assert st.applied_members == {"worker": [0, 1, 2, 3]}
+    assert st.inflight_migrate_job == ""     # applied closes the start
+    # the SOURCE-slice registrations must not resurrect: the old
+    # executors were killed at apply; the destination re-registers fresh
+    assert not [tid for tid in st.tasks if tid.startswith("worker:")]
+
+
+def test_replay_superseded_migrate_clears_inflight_only(tmp_path):
+    st = _replay_records(tmp_path, _HEAD + [
+        {"t": "migrate", "job": "worker", "mgen": 2,
+         "members": [0, 1, 2, 3], "phase": "start", "target": "slice-1",
+         "session": 0, "reason": "evacuation"},
+        {"t": "migrate", "job": "worker", "mgen": 2,
+         "members": [0, 1, 2, 3], "phase": "superseded",
+         "target": "slice-1", "session": 0,
+         "reason": "lost worker:3 mid-migration"},
+        {"t": "resize", "job": "worker", "mgen": 3, "members": [0, 1, 2],
+         "phase": "start", "session": 0, "reason": "lost worker:3"},
+    ])
+    assert st.inflight_migrate_job == ""     # the move is abandoned
+    assert st.migrated_target == {}          # never applied
+    assert st.inflight_job == "worker"       # the shrink owns the gang
+    assert st.inflight_mgen == 3
+
+
+def test_replay_epoch_reset_closes_dangling_migrate(tmp_path):
+    st = _replay_records(tmp_path, _HEAD + [
+        {"t": "migrate", "job": "worker", "mgen": 2,
+         "members": [0, 1], "phase": "applied", "target": "slice-1",
+         "session": 0},
+        {"t": "migrate", "job": "worker", "mgen": 3,
+         "members": [0, 1], "phase": "start", "target": "slice-2",
+         "session": 0},
+        {"t": "epoch", "session": 1, "infra_used": 1, "preempt_used": 0},
+    ])
+    # a retry epoch relaunches wherever conf points: pin + in-flight
+    # move die with the gang they were moving
+    assert st.migrated_target == {}
+    assert st.inflight_migrate_job == ""
+    assert st.elastic_mgen == 3              # fences stay monotonic
+
+
+def test_replay_both_inflight_keeps_higher_mgen_story(tmp_path):
+    # Crash window: the superseded record was the NEXT append when the
+    # coordinator died — both a migrate start (mgen 2) and the resize
+    # start (mgen 3) that superseded it are on the journal. Recovery
+    # resolves by generation: the newer op owns the gang.
+    st = _replay_records(tmp_path, _HEAD + [
+        {"t": "migrate", "job": "worker", "mgen": 2,
+         "members": [0, 1, 2, 3], "phase": "start", "target": "slice-1",
+         "session": 0},
+        {"t": "resize", "job": "worker", "mgen": 3, "members": [0, 1, 2],
+         "phase": "start", "session": 0, "reason": "lost worker:3"},
+    ])
+    assert st.inflight_migrate_mgen == 2
+    assert st.inflight_mgen == 3
+    assert st.inflight_mgen > st.inflight_migrate_mgen
+
+
+# ---------------------------------------------------------------------------
+# Coordinator drills: the full op lifecycle against a real Coordinator
+# ---------------------------------------------------------------------------
+def _coord(tmp_path, sub="a", recover=False, app_id="app_mig"):
+    from tony_tpu.cluster.local import LocalProcessBackend
+    from tony_tpu.coordinator.coordinator import Coordinator
+
+    conf = _conf(workers=4)
+    conf.set("tony.worker.command", "true")
+    backend = LocalProcessBackend(str(tmp_path / f"work-{sub}"))
+    coord = Coordinator(conf, app_id, backend,
+                        str(tmp_path / "history"), user="t",
+                        recover=recover)
+    if not recover:
+        for i in range(4):
+            coord.register_worker_spec(f"worker:{i}", "h", 1000 + i,
+                                       session_id=0)
+        coord.elastic.established = True
+    return coord
+
+
+def _close_coord(coord):
+    coord.journal.close()
+    coord.rpc._server.server_close()
+
+
+def _journal_migrates(coord):
+    recs = []
+    with open(coord.journal_path) as f:
+        for line in f:
+            rec = json.loads(line)
+            if rec.get("t") == "migrate":
+                recs.append(rec)
+    return recs
+
+
+def test_migrate_lifecycle_drain_apply_barrier_completed(tmp_path):
+    coord = _coord(tmp_path)
+    events = []
+    coord.events.emit = events.append
+    try:
+        res = coord.migrate_application("slice-1", reason="defrag")
+        assert res["ok"] and res["mgen"] == 2
+        assert res["members"] == [0, 1, 2, 3]
+        assert res["target"] == "slice-1"
+        # start write-ahead on disk BEFORE any directive can land
+        starts = _journal_migrates(coord)
+        assert [r["phase"] for r in starts] == ["start"]
+        assert starts[0]["target"] == "slice-1"
+        started = [e for e in events if e.type == EventType.GANG_MIGRATED]
+        assert started and started[0].payload["phase"] == "started"
+
+        # whole gang parks by re-registering under the op's mgen
+        for i in range(4):
+            coord.register_worker_spec(f"worker:{i}", "h", 1000 + i,
+                                       session_id=0, mgen=2)
+        assert coord.elastic.drain_complete
+        coord._elastic_tick()                # drain done -> apply
+        # topology moved: node pool re-pinned, applied record journaled,
+        # barrier reopened for the destination gang
+        assert coord.session.jobs["worker"].node_pool == "slice-1"
+        phases = [r["phase"] for r in _journal_migrates(coord)]
+        assert phases == ["start", "applied"]
+        assert coord.elastic.op.phase == BARRIER
+
+        # destination executors register fresh
+        for i in range(4):
+            coord.register_worker_spec(f"worker:{i}", "dest", 2000 + i,
+                                       session_id=0, mgen=2)
+        coord._elastic_tick()                # barrier -> completed
+        assert not coord.elastic.resizing
+        assert coord.session.status == SessionStatus.RUNNING
+        assert coord._infra_retries_used == 0    # zero budget burned
+        mig = [e for e in events if e.type == EventType.GANG_MIGRATED]
+        assert [e.payload["phase"] for e in mig] == ["started",
+                                                     "completed"]
+        assert mig[1].payload["target"] == "slice-1"
+        assert "duration_s" in mig[1].payload
+    finally:
+        _close_coord(coord)
+
+
+def test_migrate_refused_surfaces_to_operator_not_session(tmp_path):
+    coord = _coord(tmp_path, sub="b")
+    try:
+        res = coord.migrate_application("")
+        assert not res["ok"] and "no target slice" in res["message"]
+        assert coord.session.status == SessionStatus.RUNNING
+        assert not coord.elastic.resizing
+        assert _journal_migrates(coord) == []
+    finally:
+        _close_coord(coord)
+
+
+def test_migrate_snapshot_fault_degrades_to_retry_ladder(tmp_path):
+    coord = _coord(tmp_path, sub="c")
+    faults.install(faults.FaultInjector({"migrate.snapshot": "first:1"}))
+    try:
+        assert coord.migrate_application("slice-1")["ok"]
+        for i in range(4):
+            coord.register_worker_spec(f"worker:{i}", "h", 1000 + i,
+                                       session_id=0, mgen=2)
+        coord._elastic_tick()
+        # the op is abandoned and the epoch fails INFRA_TRANSIENT — the
+        # ordinary retry machinery, never a stuck half-move
+        assert not coord.elastic.resizing
+        assert coord.session.status == SessionStatus.FAILED
+        assert "migration snapshot seal failed" \
+            in coord.session.failure_reason
+        assert coord.session.failure_domain == \
+            FailureDomain.INFRA_TRANSIENT
+        # apply never ran: no applied record, pool pin untouched
+        assert [r["phase"] for r in _journal_migrates(coord)] == ["start"]
+        assert coord.session.jobs["worker"].node_pool != "slice-1"
+    finally:
+        faults.uninstall()
+        _close_coord(coord)
+
+
+def test_migrate_adopt_fault_degrades_after_applied_record(tmp_path):
+    coord = _coord(tmp_path, sub="d")
+    faults.install(faults.FaultInjector({"migrate.adopt": "first:1"}))
+    try:
+        assert coord.migrate_application("slice-1")["ok"]
+        for i in range(4):
+            coord.register_worker_spec(f"worker:{i}", "h", 1000 + i,
+                                       session_id=0, mgen=2)
+        coord._elastic_tick()
+        assert not coord.elastic.resizing
+        assert coord.session.status == SessionStatus.FAILED
+        assert "migration destination adoption failed" \
+            in coord.session.failure_reason
+        # the applied record IS on disk: a --recover of this epoch would
+        # relaunch on the destination (the pin moved), and the retry
+        # epoch that follows re-reads conf — either way no torn state
+        assert [r["phase"] for r in _journal_migrates(coord)] \
+            == ["start", "applied"]
+        assert coord.session.jobs["worker"].node_pool == "slice-1"
+    finally:
+        faults.uninstall()
+        _close_coord(coord)
+
+
+def test_host_loss_mid_migration_supersedes_into_shrink(tmp_path):
+    coord = _coord(tmp_path, sub="e")
+    try:
+        assert coord.migrate_application("slice-1")["ok"]
+        t = coord.session.get_task("worker:3")
+        absorbed = coord._absorb_task_loss(
+            t, constants.EXIT_KILLED,
+            FailureDomain.INFRA_TRANSIENT.value,
+            reason="host reclaimed mid-drain")
+        assert absorbed
+        # the move is abandoned; the loss folds into an ordinary shrink
+        op = coord.elastic.op
+        assert op is not None and not op.migrate
+        assert op.members == [0, 1, 2]
+        assert op.mgen == 3
+        recs = _journal_migrates(coord)
+        assert [r["phase"] for r in recs] == ["start", "superseded"]
+        assert "lost worker:3 mid-migration" in recs[1]["reason"]
+        # never worse than a host loss: same epoch, no budget burned
+        assert coord.session.status == SessionStatus.RUNNING
+        assert coord._infra_retries_used == 0
+    finally:
+        _close_coord(coord)
+
+
+def test_migrate_barrier_timeout_fails_with_migration_shape(tmp_path):
+    coord = _coord(tmp_path, sub="f")
+    try:
+        assert coord.migrate_application("slice-1")["ok"]
+        coord.elastic.barrier_timeout_s = -1      # force expiry
+        coord._elastic_tick()
+        assert not coord.elastic.resizing
+        assert coord.session.status == SessionStatus.FAILED
+        assert "live migration to 'slice-1'" \
+            in coord.session.failure_reason
+        assert coord.session.failure_domain == \
+            FailureDomain.INFRA_TRANSIENT
+    finally:
+        _close_coord(coord)
+
+
+def test_recover_reenters_mid_migration_drain(tmp_path):
+    # SIGKILL the coordinator mid-drain: the journaled start record
+    # re-enters the op under --recover instead of abandoning the move.
+    c1 = _coord(tmp_path, sub="g1")
+    c1.journal.epoch(0, 0, 0)
+    c1.session.mark_job_scheduled("worker")
+    c1.journal.job_scheduled("worker", 0)
+    assert c1.migrate_application("slice-1", reason="evacuation")["ok"]
+    _close_coord(c1)                         # crash: no closing record
+
+    c2 = _coord(tmp_path, sub="g2", recover=True)
+    events = []
+    c2.events.emit = events.append
+    try:
+        st = c2._recover_state
+        assert st.inflight_migrate_target == "slice-1"
+        assert st.inflight_migrate_mgen == 2
+        c2._resume_session()
+        op = c2.elastic.op
+        assert op is not None and op.migrate
+        assert op.target == "slice-1" and op.mgen == 2
+        assert op.members == [0, 1, 2, 3]
+        resumed = [e for e in events
+                   if e.type == EventType.GANG_MIGRATED]
+        assert resumed and resumed[0].payload["resumed"] is True
+        assert resumed[0].payload["reason"] == "evacuation"
+        # the journaled re-entry start closes under the checker's rules
+        assert _journal_migrates(c2)[-1]["phase"] == "start"
+        # survivors park under the journaled mgen and the move completes
+        for i in range(4):
+            c2.register_worker_spec(f"worker:{i}", "h", 1000 + i,
+                                    session_id=0, mgen=2)
+        c2._elastic_tick()
+        assert c2.session.jobs["worker"].node_pool == "slice-1"
+        assert [r["phase"] for r in _journal_migrates(c2)][-1] \
+            == "applied"
+    finally:
+        _close_coord(c2)
+
+
+def test_recover_prefers_newer_resize_over_stale_migrate(tmp_path):
+    # Both a migrate start and the resize start that superseded it are
+    # on the journal (the crash ate the superseded record): the newer
+    # membership generation owns the gang on recovery.
+    c1 = _coord(tmp_path, sub="h1", app_id="app_mig2")
+    c1.journal.epoch(0, 0, 0)
+    c1.session.mark_job_scheduled("worker")
+    c1.journal.job_scheduled("worker", 0)
+    c1.journal.migrate("worker", 2, [0, 1, 2, 3], "start", "slice-1", 0,
+                       reason="defrag")
+    c1.journal.resize("worker", 3, [0, 1, 2], "start", 0,
+                      reason="lost worker:3")
+    _close_coord(c1)
+
+    c2 = _coord(tmp_path, sub="h2", recover=True, app_id="app_mig2")
+    try:
+        c2._resume_session()
+        op = c2.elastic.op
+        assert op is not None and not op.migrate
+        assert op.mgen == 3 and op.members == [0, 1, 2]
+    finally:
+        _close_coord(c2)

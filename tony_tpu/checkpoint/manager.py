@@ -30,7 +30,8 @@ import hashlib
 import json
 import logging
 import os
-from typing import Any, Dict, List, Optional
+import threading
+from typing import Any, Dict, List, Optional, Tuple
 
 from tony_tpu import faults, telemetry
 
@@ -74,12 +75,32 @@ class CheckpointManager:
         # (saved_shape, current_shape) of the last restore that crossed
         # mesh shapes; None when the layouts matched (or were unknown).
         self.last_restore_resharded: Optional[tuple] = None
+        # Overlapped-save mode (async_save=True): ``save()`` only pays the
+        # device→host snapshot, then hands serialization+fsync+manifest to
+        # a background writer thread; the inner orbax manager runs
+        # SYNCHRONOUSLY inside that thread so "save returned" == "bytes
+        # durable" and the manifest can be committed last (crash
+        # consistency: a step without a manifest was torn in flight and
+        # the integrity path quarantines it).
+        self._overlap = bool(async_save)
+        self._save_interval = max(1, int(save_interval_steps))
+        self._wcond = threading.Condition()
+        self._wqueue: Optional[Tuple[int, Any, bool]] = None  # newest wins
+        self._winflight: Optional[int] = None
+        self._wstop = False
+        self._wthread: Optional[threading.Thread] = None
+        self._last_queued: Optional[int] = None
+        #: failed background writes ("step N: why") — the step was NOT
+        #: committed; restore falls back to the last committed manifest.
+        self.async_errors: List[str] = []
+        #: queued-but-not-started saves replaced by a newer request
+        self.coalesced_saves = 0
         self._mgr = ocp.CheckpointManager(
             directory,
             options=ocp.CheckpointManagerOptions(
                 max_to_keep=max_to_keep,
                 save_interval_steps=save_interval_steps,
-                enable_async_checkpointing=async_save,
+                enable_async_checkpointing=False,
             ))
 
     @staticmethod
@@ -96,13 +117,34 @@ class CheckpointManager:
     def save(self, step: int, state: Any, force: bool = False,
              mesh: Any = None) -> bool:
         """Queue an (async) save; returns False when skipped by the
-        save_interval_steps policy. Every accepted step is registered for
-        a manifest, written once the save is durable (wait/close/next
-        restore — async writes must never be checksummed mid-flight).
+        save_interval_steps policy. In overlapped mode (async_save=True)
+        the training thread pays ONLY the device→host snapshot — the
+        serialization, fsync and manifest run on a background writer, so
+        a save never stalls a step; ``wait()`` is the durability barrier.
+        Every committed step gets an integrity manifest, written strictly
+        AFTER its bytes are durable (manifest-last = the commit point).
         ``mesh`` (optional) notes the device-mesh shape in the manifest
         so a restore onto a DIFFERENT mesh — the elastic shrink/grow
         path — is detected and logged as a reshard."""
         faults.check("checkpoint.save")
+        step = int(step)
+        if self._overlap:
+            if not force and not self._policy_should_save(step):
+                return False
+            self._busy = True
+            try:
+                # Step-time attribution: the snapshot copy is the ONLY
+                # stall the training thread pays in overlapped mode.
+                with telemetry.phase("ckpt_stall"):
+                    snap = self._host_snapshot(state)
+            finally:
+                self._busy = False
+                self._run_deferred_preemption()
+            shape = self._mesh_shape(mesh)
+            if shape:
+                self._mesh_note[step] = shape
+            self._enqueue(step, snap, force)
+            return True
         self._busy = True
         try:
             # Step-time attribution rides for free: whatever the (async)
@@ -110,17 +152,111 @@ class CheckpointManager:
             # checkpoint stall — telemetry's ckpt_stall phase.
             with telemetry.phase("ckpt_stall"):
                 saved = self._mgr.save(
-                    int(step), args=self._ocp.args.StandardSave(state),
+                    step, args=self._ocp.args.StandardSave(state),
                     force=force)
         finally:
             self._busy = False
             self._run_deferred_preemption()
         if saved:
-            self._pending_manifest.add(int(step))
+            self._pending_manifest.add(step)
             shape = self._mesh_shape(mesh)
             if shape:
-                self._mesh_note[int(step)] = shape
+                self._mesh_note[step] = shape
         return saved
+
+    # -- overlapped background writer -----------------------------------
+    def _policy_should_save(self, step: int) -> bool:
+        """save_interval_steps policy for the overlapped path, applied on
+        the training thread (the writer always force-saves: the decision
+        was already made here). Queued-but-unwritten steps count as saved
+        so back-to-back saves coalesce instead of double-writing."""
+        latest = self._last_queued
+        if latest is None:
+            latest = self._mgr.latest_step()
+        if latest is None:
+            return True
+        if step <= latest:
+            return False
+        return (step - latest) >= self._save_interval \
+            or step % self._save_interval == 0
+
+    @staticmethod
+    def _host_snapshot(state: Any) -> Any:
+        """Copy device arrays to host memory so the background writer
+        serializes a frozen snapshot while training mutates the live
+        state. Non-addressable (multi-host) leaves stay as device arrays
+        — orbax gathers per-host shards itself."""
+        import jax
+        import numpy as np
+
+        def to_host(x):
+            if isinstance(x, jax.Array) and x.is_fully_addressable:
+                return np.asarray(x)
+            return x
+
+        return jax.tree.map(to_host, state)
+
+    def _enqueue(self, step: int, snap: Any, force: bool) -> None:
+        with self._wcond:
+            if self._wthread is None:
+                self._wthread = threading.Thread(
+                    target=self._writer_loop, name="ckpt-async-writer",
+                    daemon=True)
+                self._wthread.start()
+            if self._wqueue is not None:
+                # Newest wins: an unstarted queued save is superseded —
+                # the writer never falls behind a fast save cadence.
+                self.coalesced_saves += 1
+                log.info("coalescing queued checkpoint step %d under "
+                         "newer step %d", self._wqueue[0], step)
+            self._wqueue = (step, snap, force)
+            self._last_queued = step
+            self._wcond.notify_all()
+
+    def _writer_loop(self) -> None:
+        while True:
+            with self._wcond:
+                while self._wqueue is None and not self._wstop:
+                    self._wcond.wait()
+                if self._wqueue is None:
+                    return
+                req = self._wqueue
+                self._wqueue = None
+                self._winflight = req[0]
+            try:
+                self._write_one(*req)
+            finally:
+                with self._wcond:
+                    self._winflight = None
+                    self._wcond.notify_all()
+
+    def _write_one(self, step: int, snap: Any, force: bool) -> None:
+        """One background save: serialize+fsync, then manifest LAST. Any
+        failure leaves the step uncommitted (no manifest) — restore falls
+        back to the previous committed step; an async write failure must
+        never crash training."""
+        try:
+            faults.check("ckpt.async-write")
+            self._mgr.save(step, args=self._ocp.args.StandardSave(snap),
+                           force=True)
+            self._mgr.wait_until_finished()
+            if self._integrity_enabled():
+                self._write_manifest(step)
+        except Exception as e:  # noqa: BLE001 — degrade, never crash
+            log.warning(
+                "async checkpoint write of step %d FAILED (%s); step NOT "
+                "committed — restore falls back to the last committed "
+                "manifest", step, e)
+            self.async_errors.append(f"step {step}: {e}")
+
+    def _drain_writer(self) -> None:
+        """Block until the writer queue is empty and no write is in
+        flight (the durability barrier of overlapped mode)."""
+        if self._wthread is None:
+            return
+        with self._wcond:
+            while self._wqueue is not None or self._winflight is not None:
+                self._wcond.wait()
 
     # -- integrity ------------------------------------------------------
     def _step_dir(self, step: int) -> str:
@@ -278,6 +414,7 @@ class CheckpointManager:
         verify = verify and self._integrity_enabled()
         if step is not None:
             step = int(step)
+            self._drain_writer()   # an in-flight write of THIS step
             if verify and os.path.exists(self.manifest_path(step)) \
                     and not self.verify_step(step):
                 raise IOError(
@@ -403,6 +540,7 @@ class CheckpointManager:
             # A mid-training wait() is exactly the stall async
             # checkpointing exists to avoid — attribute it.
             with telemetry.phase("ckpt_stall"):
+                self._drain_writer()
                 self._mgr.wait_until_finished()
             self._flush_manifests()
         finally:
@@ -410,6 +548,13 @@ class CheckpointManager:
             self._run_deferred_preemption()
 
     def close(self) -> None:
+        self._drain_writer()
+        with self._wcond:
+            self._wstop = True
+            self._wcond.notify_all()
+        if self._wthread is not None:
+            self._wthread.join(timeout=30)
+            self._wthread = None
         self._mgr.close()
         # close() waited for in-flight saves; their manifests are now due.
         self._flush_manifests()

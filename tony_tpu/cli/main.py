@@ -213,6 +213,42 @@ def _cmd_resize(args: argparse.Namespace) -> int:
     return 0
 
 
+def _cmd_migrate(args: argparse.Namespace) -> int:
+    """Live migration of a RUNNING job's gang to another slice
+    (coordinator/migrate.py): fenced DRAIN at a step barrier → final
+    durable saves → relaunch/adopt on the target → restore-with-reshard
+    — a planned move with steps_lost==0, vs. the crash-shaped path a
+    reclaim would force. Requires tony.elastic.enabled on the job."""
+    rpc = _coordinator_rpc(args.app_id, args.workdir)
+    if rpc is None:
+        print(f"no coordinator address for {args.app_id} under "
+              f"{_default_workdir(args.workdir)} (job finished? wrong "
+              f"--workdir?) — migrate needs a live job", file=sys.stderr)
+        return 1
+    try:
+        res = rpc.call("migrate_application", target=args.target,
+                       job=args.job or "")
+    except Exception as e:  # noqa: BLE001
+        print(f"migrate failed (coordinator gone?): {e}",
+              file=sys.stderr)
+        return 1
+    finally:
+        rpc.close()
+    if not isinstance(res, dict) or not res.get("ok"):
+        msg = res.get("message", "refused") if isinstance(res, dict) \
+            else str(res)
+        print(f"migrate refused: {msg}", file=sys.stderr)
+        return 1
+    print(res.get("message", "migration accepted"))
+    print(f"members: {res.get('members')}")
+    print(f"route:   {res.get('source') or '(default pool)'} -> "
+          f"{res.get('target')}")
+    print(f"watch it land with `tony-tpu events {args.app_id}` "
+          f"(GANG_MIGRATED) or `tony-tpu top {args.app_id}` "
+          f"(mgen= column)")
+    return 0
+
+
 def _cmd_status(args: argparse.Namespace) -> int:
     """Live application report from a running job's coordinator
     (reference: the client's status poll surface, ``TonyClient.java:838``;
@@ -1282,6 +1318,17 @@ def _cmd_fleet(args: argparse.Namespace) -> int:
                 return 1
             print(f"{args.job}: {res.get('state', '?')}")
             return 0
+        if args.fleet_cmd == "migrate":
+            res = client.migrate(args.job, args.target)
+            if not res.get("ok"):
+                print(f"migrate refused: {res.get('message', '?')}",
+                      file=sys.stderr)
+                return 1
+            print(f"{args.job}: migrating slice {res.get('source')} -> "
+                  f"{res.get('target')} (placement {res.get('placement')})")
+            print(f"watch it land with `tony-tpu fleet status` or the "
+                  f"job's own `tony-tpu events` stream (GANG_MIGRATED)")
+            return 0
         if args.fleet_cmd == "submit":
             # Ship only the EXPLICIT conf entries: registry defaults
             # would shadow the fleet's own grant-time injections
@@ -1390,6 +1437,24 @@ def build_parser() -> argparse.ArgumentParser:
     rz.add_argument("--workdir", help="client workdir the job was "
                                       "submitted from (default ~/.tony-tpu)")
     rz.set_defaults(fn=_cmd_resize)
+
+    mg = sub.add_parser(
+        "migrate",
+        help="live-migrate a running job's gang to another slice: "
+             "fenced drain at a step barrier, final durable saves, "
+             "relaunch/adopt on the target, restore with reshard — "
+             "steps_lost==0 spot survival and defrag "
+             "(tony.elastic.* keys; docs/operations.md Migration)")
+    mg.add_argument("app_id")
+    mg.add_argument("target",
+                    help="destination node pool / slice name, e.g. "
+                         "slice-1")
+    mg.add_argument("--job", default="",
+                    help="jobtype to migrate (default: the configured "
+                         "tony.elastic.jobtype)")
+    mg.add_argument("--workdir", help="client workdir the job was "
+                                      "submitted from (default ~/.tony-tpu)")
+    mg.set_defaults(fn=_cmd_migrate)
 
     st = sub.add_parser("status",
                         help="live report for a running job (falls back "
@@ -1624,6 +1689,20 @@ def build_parser() -> argparse.ArgumentParser:
     fc.add_argument("--conf-file")
     fc.add_argument("--conf", action="append", metavar="K=V")
     fc.set_defaults(fn=_cmd_fleet)
+    fm = fl_sub.add_parser(
+        "migrate",
+        help="live-migrate a RUNNING fleet job to another slice by "
+             "hand (defrag, pre-maintenance evacuation): the daemon "
+             "drives the job's own drain→move→reshard migration and "
+             "re-books the pool — the policy engine also plans these "
+             "itself on fragmentation and reclaim notices")
+    fm.add_argument("job")
+    fm.add_argument("target", type=int, help="destination slice index")
+    fm.add_argument("--dir")
+    fm.add_argument("--workdir")
+    fm.add_argument("--conf-file")
+    fm.add_argument("--conf", action="append", metavar="K=V")
+    fm.set_defaults(fn=_cmd_fleet)
     fe = fl_sub.add_parser(
         "explain",
         help="why is my job queued: the causal hold timeline — every "

@@ -62,6 +62,33 @@ TERMINAL_STATES = frozenset({
     "PREEMPTED", "TERMINATED", "STOPPED", "STOPPING", "SUSPENDED",
     "SUSPENDING", "DELETING", "DELETED", "FAILED"})
 
+#: queued-resource states that are a RECLAIM NOTICE: the provider has
+#: decided to take the capacity back but the nodes still run — the
+#: warning window the fleet daemon's proactive live migration spends
+#: moving jobs OFF the doomed slice (fleet/daemon.py ``_poll_reclaim``)
+#: instead of absorbing host losses after the reclaim lands.
+RECLAIM_NOTICE_STATES = frozenset({"SUSPENDING"})
+
+
+def reclaim_notices(api: "TpuApiClient") -> List[str]:
+    """Queued-resource ids the provider is actively reclaiming — the
+    production feed behind the fleet daemon's slice-preemption intake
+    (drills use the ``slice.preempt`` fault site instead). A flaky API
+    yields no notices, never an exception: a poll hiccup must not read
+    as a reclaim."""
+    try:
+        qrs = api.list_queued_resources()
+    except Exception as e:  # noqa: BLE001 — a flaky feed is no notice
+        log.debug("queued-resource reclaim poll failed: %s", e)
+        return []
+    out: List[str] = []
+    for qr in qrs:
+        state = str((qr.get("state") or {}).get("state", ""))
+        if state in RECLAIM_NOTICE_STATES:
+            name = str(qr.get("name", "") or "")
+            out.append(name.rsplit("/", 1)[-1] or name)
+    return sorted(out)
+
 
 class TpuApiError(RuntimeError):
     """Non-transient Cloud TPU API failure (carries the HTTP code)."""

@@ -755,6 +755,35 @@ FAULT_FLEET_EXPLAIN = _key(
     "shape on the observability path. The decision is still applied to "
     "the in-memory ring and the FLEET_JOB_HELD event still fires; one "
     "warning, scheduling unaffected.")
+FAULT_CKPT_ASYNC_WRITE = _key(
+    "tony.fault.ckpt-async-write", "", str,
+    "Fail the checkpoint manager's background writer before a snapshot "
+    "is serialized (tony_tpu/checkpoint/manager.py) — the torn "
+    "in-flight-async-save shape. The step is NOT committed (no "
+    "manifest); restore falls back to the last committed step and "
+    "training continues — an async save failure must never crash the "
+    "job.")
+FAULT_MIGRATE_SNAPSHOT = _key(
+    "tony.fault.migrate-snapshot", "", str,
+    "Fail a live migration at the snapshot seal (checked once per "
+    "migration, after the gang drained but before the topology moves): "
+    "the migration aborts into an INFRA_TRANSIENT epoch failure — the "
+    "ordinary retry ladder relaunches on the ORIGINAL slice, so a "
+    "failed migration is never worse than a plain host loss.")
+FAULT_MIGRATE_ADOPT = _key(
+    "tony.fault.migrate-adopt", "", str,
+    "Fail a live migration at destination adoption (checked once per "
+    "migration, after the topology moved but before the destination "
+    "executors launch) — the unadoptable-target shape; the migration "
+    "aborts into an INFRA_TRANSIENT epoch failure and the retry "
+    "machinery relaunches.")
+FAULT_SLICE_PREEMPT = _key(
+    "tony.fault.slice-preempt", "", str,
+    "Mark one fleet-held slice as dying on the reclaim-notice poll "
+    "(tony_tpu/fleet/daemon.py) — the queued-resource spot-reclaim "
+    "advance notice. The fleet must proactively migrate tenants off "
+    "the dying slice instead of absorbing the loss; the call counter "
+    "is daemon ticks.")
 FAULT_PROFILE_CAPTURE = _key(
     "tony.fault.profile-capture", "", str,
     "Fail an on-demand device capture at the step boundary that would "

@@ -40,6 +40,7 @@ from tony_tpu.coordinator.elastic import (BARRIER, DRAIN, ElasticManager,
                                           ResizeRefused)
 from tony_tpu.coordinator.journal import SessionJournal
 from tony_tpu.coordinator.liveness import ProgressTracker
+from tony_tpu.coordinator.migrate import MigrateRefused, plan_migration
 from tony_tpu.coordinator.scheduler import GangScheduler
 from tony_tpu.coordinator.session import (FailureDomain, Session,
                                           SessionStatus, Task, TaskStatus)
@@ -107,6 +108,13 @@ class _RpcService:
     def resize_application(self, size: int, job: str = "") -> dict:
         """Operator-initiated elastic resize (`tony-tpu resize`)."""
         return self._c.resize_application(int(size), job=str(job or ""))
+
+    def migrate_application(self, target: str, job: str = "",
+                            reason: str = "") -> dict:
+        """Live migration to another slice (`tony-tpu migrate`)."""
+        return self._c.migrate_application(str(target or ""),
+                                           job=str(job or ""),
+                                           reason=str(reason or ""))
 
     def get_application_report(self) -> dict:
         return self._c.application_report()
@@ -231,6 +239,12 @@ class Coordinator:
                 for job_name, members in st.applied_members.items():
                     if job_name in self.session.jobs:
                         self.session.resize_job(job_name, members)
+                # The last APPLIED migration moved the job: re-pin its
+                # node pool so recovery relaunches land on the slice the
+                # gang actually runs on, not the conf's original.
+                for job_name, target in st.migrated_target.items():
+                    if job_name in self.session.jobs:
+                        self.session.jobs[job_name].node_pool = target
             for task_id, tr in st.tasks.items():
                 self.session.restore_task(
                     task_id, TaskStatus(tr.status),
@@ -1462,7 +1476,12 @@ class Coordinator:
             return False
         domain = classify_exit(exit_code, domain_hint) \
             or FailureDomain.INFRA_TRANSIENT
-        released = el.is_released(t.task_id)
+        # A migrating member that already ACKED its park self-exits (it
+        # cannot follow the gang to the destination slice) — that exit is
+        # as expected as a released task's, and must never fold the move
+        # into a shrink.
+        released = el.is_released(t.task_id) or \
+            el.is_parked_for_migration(t.task_id)
         if not released and not el.may_absorb(t, domain.value,
                                               self.session):
             return False
@@ -1509,9 +1528,26 @@ class Coordinator:
             # Second loss during the drain: supersede the op with the
             # smaller membership (mgen bumps again; parked survivors
             # adopt it through the directive channel).
-            members = [m for m in el.op.members if m != t.index]
-            log.warning("resize: member %s lost mid-drain — superseding "
-                        "to %d member(s)", task_id, len(members))
+            op = el.op
+            members = [m for m in op.members if m != t.index]
+            if op.migrate:
+                # A host died mid-migration: the move is abandoned and
+                # the loss folds into an ordinary elastic shrink — a
+                # failed migration is never worse than a host loss. The
+                # superseded record closes the journaled migrate start
+                # write-ahead of the resize that replaces it.
+                self.journal.migrate(el.job, op.mgen, op.members,
+                                     "superseded", op.target,
+                                     self.session.session_id,
+                                     reason=f"lost {task_id} mid-"
+                                            f"migration: {reason}")
+                log.warning("migrate: member %s lost mid-drain — move to "
+                            "%r abandoned, folding into a shrink to %d "
+                            "member(s)", task_id, op.target, len(members))
+            else:
+                log.warning("resize: member %s lost mid-drain — "
+                            "superseding to %d member(s)", task_id,
+                            len(members))
         else:
             members = [x.index for x in self.session.all_tasks()
                        if x.job_name == el.job and not x.status.terminal]
@@ -1539,6 +1575,36 @@ class Coordinator:
                     el.job, len(op.members), op.mgen, reason,
                     len(op.awaiting), len(op.release))
 
+    def _start_migrate(self, members, target: str, reason: str,
+                       mgen: Optional[int] = None,
+                       resumed: bool = False) -> None:
+        """Begin a live migration (coordinator/migrate.py): journal the
+        REC_MIGRATE start write-ahead, emit the timeline event, and let
+        the whole-gang drain directives ride the next heartbeats — every
+        member parks (its user process makes one final durable save via
+        the SIGTERM handler), then _apply_migrate moves the topology."""
+        el = self.elastic
+        live = [t for t in self.session.all_tasks()
+                if t.job_name == el.job and not t.status.terminal]
+        op = el.begin(sorted(members), live, reason, mgen=mgen,
+                      target=target, migrate=True)
+        self.journal.migrate(el.job, op.mgen, op.members, "start",
+                             target, self.session.session_id,
+                             reason=reason)
+        job_spec = self.session.jobs.get(el.job)
+        source = str(job_spec.node_pool or "") if job_spec else ""
+        payload = {"job": el.job, "phase": "started", "mgen": op.mgen,
+                   "members": list(op.members), "source": source,
+                   "target": target, "reason": reason,
+                   "session_id": self.session.session_id}
+        if resumed:
+            payload["resumed"] = True
+        self.events.emit(Event(EventType.GANG_MIGRATED, payload))
+        log.warning("migrate: %s (%d member(s)) %r -> %r under membership "
+                    "generation %d (%s); draining the whole gang",
+                    el.job, len(op.members), source, target, op.mgen,
+                    reason)
+
     def _elastic_tick(self) -> None:
         """Advance the resize state machine (monitor-loop cadence):
         drain done → apply the re-mesh; barrier reopened → finish; the
@@ -1548,8 +1614,10 @@ class Coordinator:
             return
         if el.timed_out():
             op = el.abandon()
+            what = (f"live migration to {op.target!r}" if op.migrate
+                    else f"elastic resize to {len(op.members)} member(s)")
             self.session.fail(
-                f"elastic resize to {len(op.members)} member(s) did not "
+                f"{what} did not "
                 f"complete within {el.barrier_timeout_s}s "
                 f"(phase {op.phase}, still draining "
                 f"{sorted(op.awaiting)})",
@@ -1557,10 +1625,26 @@ class Coordinator:
             return
         op = el.op
         if op.phase == DRAIN and el.drain_complete:
-            self._apply_remesh()
+            if op.migrate:
+                self._apply_migrate()
+            else:
+                self._apply_remesh()
         elif op.phase == BARRIER and self.session.all_registered():
             done = el.finish()
             duration_s = round(time.monotonic() - done.started, 3)
+            if done.migrate:
+                self.events.emit(Event(EventType.GANG_MIGRATED, {
+                    "job": el.job, "phase": "completed",
+                    "mgen": done.mgen, "members": list(done.members),
+                    "target": done.target, "reason": done.reason,
+                    "duration_s": duration_s,
+                    "session_id": self.session.session_id}))
+                log.warning("migrate: %s live on %r at %d member(s) "
+                            "(mgen %d) in %.1fs — training continues in "
+                            "the SAME epoch, zero steps lost", el.job,
+                            done.target, len(done.members), done.mgen,
+                            duration_s)
+                return
             self.events.emit(Event(EventType.GANG_RESIZED, {
                 "job": el.job, "phase": "completed", "mgen": done.mgen,
                 "members": list(done.members), "from": done.size_before,
@@ -1623,6 +1707,71 @@ class Coordinator:
                     "%d fresh launch(es)); waiting at the barrier",
                     el.job, op.members, op.mgen, len(fresh))
 
+    def _apply_migrate(self) -> None:
+        """The whole gang is parked (every member's final save durable):
+        kill the source-slice executors, re-pin the job's node pool to
+        the target, journal the applied record write-ahead, and relaunch
+        the SAME member indices on the destination — warm-pool adoption
+        or cold spawn, the backend's ordinary launch ladder. Any failure
+        degrades to the INFRA_TRANSIENT retry machinery."""
+        el = self.elastic
+        op = el.op
+        try:
+            faults.check("migrate.snapshot")
+        except faults.InjectedFault as e:
+            el.abandon()
+            self.session.fail(f"migration snapshot seal failed: {e}",
+                              FailureDomain.INFRA_TRANSIENT)
+            return
+        # Source executors die BEFORE their indices exist again: a
+        # straggling frame from the old slice then meets a closed drain
+        # barrier or a non-member fence, never the destination gang.
+        kills: List[threading.Thread] = []
+        for t in self.session.all_tasks():
+            if t.job_name != el.job or t.status.terminal:
+                continue
+            self._end_task_span(t.task_id, resized_out=True)
+            with self._hb_lock:
+                self._last_hb.pop(t.task_id, None)
+            self.progress.forget(t.task_id)
+            el.note_task_gone(t.task_id)
+            self.session.mark_killed(t.task_id)
+            if t.handle is not None:
+                th = threading.Thread(
+                    target=self.backend.kill_task, args=(t.handle,),
+                    kwargs={"grace_s": float(el.drain_grace_s)},
+                    daemon=True, name=f"migrate-release-{t.task_id}")
+                th.start()
+                kills.append(th)
+        for th in kills:
+            th.join(timeout=float(el.drain_grace_s) + 15.0)
+        job_spec = self.session.jobs.get(el.job)
+        source = str(job_spec.node_pool or "") if job_spec else ""
+        if job_spec is not None:
+            job_spec.node_pool = op.target
+        fresh = self.session.resize_job(el.job, op.members)
+        self.journal.migrate(el.job, op.mgen, op.members, "applied",
+                             op.target, self.session.session_id,
+                             reason=op.reason)
+        try:
+            faults.check("migrate.adopt")
+        except faults.InjectedFault as e:
+            el.abandon()
+            self.session.fail(
+                f"migration destination adoption failed: {e}",
+                FailureDomain.INFRA_TRANSIENT)
+            return
+        for t in fresh:
+            if not self._launch_task(t):
+                el.abandon()
+                return             # session already failed INFRA_TRANSIENT
+        self._schedule_start = time.monotonic()
+        el.mark_remeshed()
+        log.warning("migrate: topology moved %r -> %r — %s members %s "
+                    "(mgen %d, %d destination launch(es)); waiting at "
+                    "the barrier", source, op.target, el.job, op.members,
+                    op.mgen, len(fresh))
+
     def resize_application(self, size: int, job: str = "") -> dict:
         """Operator-initiated resize (`tony-tpu resize <app> <n>`):
         validated by policy, then the same drain→remesh→barrier path a
@@ -1644,6 +1793,31 @@ class Coordinator:
         return {"ok": True, "mgen": el.mgen, "members": members,
                 "message": f"resizing {el.job} to {len(members)} "
                            f"member(s) (membership generation {el.mgen})"}
+
+    def migrate_application(self, target: str, job: str = "",
+                            reason: str = "") -> dict:
+        """Live migration (`tony-tpu migrate <app> <target>`): validated
+        by policy (coordinator/migrate.py), then DRAIN the whole gang →
+        final durable saves → relaunch on the target slice → barrier —
+        the same machinery as a resize, pointed at a different slice."""
+        el = self.elastic
+        if el is None:
+            return {"ok": False,
+                    "message": "migration rides the elastic drain "
+                               "machinery — set tony.elastic.enabled"
+                               "=true"}
+        try:
+            plan = plan_migration(el, self.session, target, job=job,
+                                  reason=reason)
+        except MigrateRefused as e:
+            return {"ok": False, "message": str(e)}
+        self._start_migrate(plan.members, plan.target, plan.reason)
+        return {"ok": True, "mgen": el.mgen,
+                "members": list(plan.members), "source": plan.source,
+                "target": plan.target,
+                "message": f"migrating {el.job} ({len(plan.members)} "
+                           f"member(s)) to {plan.target} (membership "
+                           f"generation {el.mgen})"}
 
     def _check_heartbeats(self) -> None:
         """Liveness monitor (reference AbstractLivelinessMonitor usage
@@ -2124,8 +2298,40 @@ class Coordinator:
             # The pre-crash gang had completed its rendezvous (or the
             # journal would hold no registrations worth re-adopting).
             self.elastic.established = True
-            if st is not None and st.inflight_job == self.elastic.job \
-                    and st.inflight_members:
+            has_migrate = (st is not None
+                           and st.inflight_migrate_job == self.elastic.job
+                           and st.inflight_migrate_members)
+            has_resize = (st is not None
+                          and st.inflight_job == self.elastic.job
+                          and st.inflight_members)
+            if has_migrate and has_resize:
+                # Both in flight on the journal means one superseded the
+                # other without its closing record landing — the newer
+                # membership generation owns the gang.
+                if st.inflight_migrate_mgen >= st.inflight_mgen:
+                    has_resize = False
+                else:
+                    has_migrate = False
+            if has_migrate:
+                # Mid-migration crash: RE-ENTER the drain toward the
+                # journaled target at the journaled mgen — parked
+                # survivors re-register with that mgen and the move
+                # completes instead of the job restarting.
+                reason = st.inflight_migrate_reason \
+                    or "resumed mid-migration"
+                self._start_migrate(st.inflight_migrate_members,
+                                    st.inflight_migrate_target, reason,
+                                    mgen=st.inflight_migrate_mgen,
+                                    resumed=True)
+                op = self.elastic.op
+                log.warning(
+                    "recovery: resuming in-flight migration to %r "
+                    "(%d member(s), mgen %d) — %d survivor(s) still to "
+                    "park", st.inflight_migrate_target,
+                    len(op.members) if op else 0,
+                    st.inflight_migrate_mgen,
+                    len(op.awaiting) if op else 0)
+            elif has_resize:
                 # Mid-resize crash: RE-ENTER the drain at the journaled
                 # membership generation instead of abandoning the resize
                 # — parked survivors re-register with that mgen and the

@@ -69,7 +69,8 @@ class ResizeRefused(ValueError):
 
 class _Op:
     def __init__(self, mgen: int, job: str, members: List[int],
-                 reason: str, started: float) -> None:
+                 reason: str, started: float, target: str = "",
+                 migrate: bool = False) -> None:
         self.mgen = mgen
         self.job = job
         self.members = sorted(members)
@@ -83,6 +84,11 @@ class _Op:
         self.parked: Set[str] = set()
         self.release: Set[str] = set()
         self.size_before = 0
+        # Live migration (coordinator/migrate.py): same drain/barrier
+        # machinery, but at remesh the WHOLE parked gang is relaunched on
+        # ``target`` (a node-pool/slice name) instead of in place.
+        self.target = target
+        self.migrate = migrate
 
 
 @guarded
@@ -138,6 +144,8 @@ class ElasticManager:
             if self._op is not None:
                 out["target_size"] = len(self._op.members)
                 out["phase"] = self._op.phase
+                if self._op.migrate:
+                    out["migrating_to"] = self._op.target
             return out
 
     # -- policy -----------------------------------------------------------
@@ -214,17 +222,23 @@ class ElasticManager:
 
     # -- op lifecycle (driven by the coordinator) -------------------------
     def begin(self, members: List[int], live_tasks: "Iterable[Task]",
-              reason: str, mgen: Optional[int] = None) -> _Op:
+              reason: str, mgen: Optional[int] = None, target: str = "",
+              migrate: bool = False) -> _Op:
         """Start a resize (or supersede the in-flight one with a smaller
         membership — the second host dying during a drain). Bumps the
         membership generation unless ``mgen`` pins it (recovery re-entry
         of a journaled in-flight resize). ``live_tasks`` are the elastic
         jobtype's current non-terminal tasks; members of the new set must
-        park, the rest are released."""
+        park, the rest are released. ``migrate``/``target`` turn the op
+        into a live migration: every member drains and the remesh
+        relaunches the gang on the target slice (a plain ``begin`` that
+        supersedes a migrate op folds the move into an ordinary shrink —
+        a failed migration is never worse than a host loss)."""
         with self._lock:
             new_mgen = int(mgen) if mgen is not None else self.mgen + 1
             self.mgen = max(self.mgen, new_mgen)
-            op = _Op(new_mgen, self.job, members, reason, self._now())
+            op = _Op(new_mgen, self.job, members, reason, self._now(),
+                     target=target, migrate=migrate)
             prev = self._op
             if prev is not None:
                 # Supersede: keep the ORIGINAL start time so the barrier
@@ -257,6 +271,15 @@ class ElasticManager:
             if task_id in op.release:
                 return {**base, "action": "release"}
             if task_id in op.awaiting or task_id in op.parked:
+                if op.migrate:
+                    # A migrating executor must NOT wait at the barrier:
+                    # the spec it would receive belongs to its fresh
+                    # replacement on the destination slice (same task_id,
+                    # same mgen), and relaunching here would put two
+                    # incarnations of the gang in training at once. The
+                    # marker tells it to ack the park and exit instead.
+                    return {**base, "action": "drain", "migrate": True,
+                            "target": op.target}
                 return {**base, "action": "drain"}
             return None
 
@@ -289,6 +312,17 @@ class ElasticManager:
     def is_released(self, task_id: str) -> bool:
         with self._lock:
             return self._op is not None and task_id in self._op.release
+
+    def is_parked_for_migration(self, task_id: str) -> bool:
+        """Did this task park under an in-flight migration's DRAIN? A
+        migrating executor acks the park and then self-exits (its
+        incarnation cannot follow the gang to the destination slice), so
+        its backend completion is EXPECTED — absorbed like a released
+        task's, never folded into a shrink that would abandon the move."""
+        with self._lock:
+            op = self._op
+            return op is not None and op.migrate and op.phase == DRAIN \
+                and task_id in op.parked
 
     @property
     def drain_complete(self) -> bool:

@@ -47,6 +47,8 @@ REC_TASK = "task"                 # task state transition
 REC_VERDICT = "verdict"           # failure-domain verdict for an epoch
 REC_PROGRESS = "progress"         # throttled task step-counter checkpoint
 REC_RESIZE = "resize"             # elastic membership change (start/applied)
+REC_MIGRATE = "migrate"           # live slice migration (start/applied/
+                                  # superseded) — coordinator/migrate.py
 
 
 class JournalError(RuntimeError):
@@ -101,6 +103,21 @@ class ReplayState:
     inflight_mgen: int = 0
     inflight_members: list = dataclasses.field(default_factory=list)
     inflight_reason: str = ""
+    # --- live migration (coordinator/migrate.py) -----------------------
+    # Target slice of the LAST applied migration per job: the recovered
+    # coordinator re-pins job.node_pool so relaunches land on the slice
+    # the job actually moved to.
+    migrated_target: Dict[str, str] = dataclasses.field(
+        default_factory=dict)
+    # An in-flight migration (start with no applied/superseded): the
+    # recovered coordinator re-enters the drain toward the target
+    # instead of abandoning the move. Empty job = none.
+    inflight_migrate_job: str = ""
+    inflight_migrate_mgen: int = 0
+    inflight_migrate_members: list = dataclasses.field(
+        default_factory=list)
+    inflight_migrate_target: str = ""
+    inflight_migrate_reason: str = ""
 
 
 class SessionJournal:
@@ -194,6 +211,20 @@ class SessionJournal:
                      "phase": phase, "session": session_id,
                      "reason": reason})
 
+    def migrate(self, job: str, mgen: int, members: Iterable[int],
+                phase: str, target: str, session_id: int,
+                reason: str = "") -> None:
+        """Live-migration transition (coordinator/migrate.py). Same
+        write-ahead discipline as ``resize``: ``phase="start"`` lands
+        BEFORE the drain directive, ``phase="applied"`` BEFORE the
+        destination launches, and ``phase="superseded"`` when a host
+        loss mid-migration folds the op into an ordinary elastic
+        shrink — every start is closed by applied/superseded/epoch."""
+        self.append({"t": REC_MIGRATE, "job": job, "mgen": int(mgen),
+                     "members": sorted(int(m) for m in members),
+                     "phase": phase, "target": target,
+                     "session": session_id, "reason": reason})
+
     def close(self) -> None:
         if self._log is not None:
             self._log.close()
@@ -272,6 +303,16 @@ def replay(path: str) -> ReplayState:
             state.inflight_members = []
             state.inflight_reason = ""
             state.inflight_mgen = 0
+            # A retry epoch relaunches wherever its conf points: the
+            # applied-migration pin and any in-flight move die with the
+            # gang they were moving (an epoch reset CLOSES a dangling
+            # migrate start — the invariant checker counts on it).
+            state.migrated_target.clear()
+            state.inflight_migrate_job = ""
+            state.inflight_migrate_members = []
+            state.inflight_migrate_target = ""
+            state.inflight_migrate_reason = ""
+            state.inflight_migrate_mgen = 0
         elif t == REC_JOB_SCHEDULED:
             if int(rec.get("session", 0) or 0) == state.session_id:
                 state.scheduled_jobs.add(str(rec.get("job", "")))
@@ -333,6 +374,53 @@ def replay(path: str) -> ReplayState:
                 state.inflight_mgen = mgen
                 state.inflight_members = members
                 state.inflight_reason = str(rec.get("reason", "") or "")
+        elif t == REC_MIGRATE:
+            if int(rec.get("session", 0) or 0) != state.session_id:
+                continue
+            job = str(rec.get("job", "") or "")
+            mgen = int(rec.get("mgen", 0) or 0)
+            members = [int(m) for m in rec.get("members", []) or []]
+            target = str(rec.get("target", "") or "")
+            state.elastic_mgen = max(state.elastic_mgen, mgen)
+            phase = rec.get("phase")
+            if phase == "applied":
+                # The move completed: relaunches must land on the
+                # target slice, and the same-member topology is the
+                # applied matrix. EVERY task was replaced by a fresh
+                # destination launch — drop the source gang's folded
+                # records (host/port/registered belong to dead
+                # executors); the destination's REC_TASK/REC_REGISTER
+                # records that follow rebuild them.
+                state.migrated_target[job] = target
+                state.applied_members[job] = members
+                state.tasks = {
+                    tid: tr for tid, tr in state.tasks.items()
+                    if tid.partition(":")[0] != job}
+                if state.inflight_migrate_job == job \
+                        and state.inflight_migrate_mgen <= mgen:
+                    state.inflight_migrate_job = ""
+                    state.inflight_migrate_members = []
+                    state.inflight_migrate_target = ""
+                    state.inflight_migrate_reason = ""
+                    state.inflight_migrate_mgen = 0
+            elif phase == "superseded":
+                # A host loss mid-migration folded the op into an
+                # ordinary elastic shrink: the move is abandoned, the
+                # resize records that follow own the membership story.
+                if state.inflight_migrate_job == job \
+                        and state.inflight_migrate_mgen <= mgen:
+                    state.inflight_migrate_job = ""
+                    state.inflight_migrate_members = []
+                    state.inflight_migrate_target = ""
+                    state.inflight_migrate_reason = ""
+                    state.inflight_migrate_mgen = 0
+            else:                  # "start": a migration is in flight
+                state.inflight_migrate_job = job
+                state.inflight_migrate_mgen = mgen
+                state.inflight_migrate_members = members
+                state.inflight_migrate_target = target
+                state.inflight_migrate_reason = str(
+                    rec.get("reason", "") or "")
         elif t == REC_VERDICT:
             pass                   # forensic record; no folded state
         else:

@@ -12,6 +12,15 @@ journal-mgen-monotonic   membership generations never step backwards
 journal-resize-dangling  every REC_RESIZE ``start`` is closed by an
                          ``applied`` (same-or-newer mgen), a superseding
                          ``start``, or an epoch reset — never left open
+journal-migrate-dangling every REC_MIGRATE ``start`` is closed by an
+                         ``applied`` (same-or-newer mgen), a
+                         ``superseded`` record (host loss folded the op
+                         into the elastic ladder), or an epoch reset —
+                         a SUCCEEDED job never ends mid-migration
+journal-migrate-mgen-monotonic
+                         migration records respect the shared
+                         membership-generation fence — no stale-slice
+                         migration frame lands after a newer mgen
 journal-stale-epoch      no sessioned record lands after a newer epoch
                          fence (a stale frame was accepted post-fence)
 journal-terminal         no REC_TASK transition out of SUCCEEDED/FAILED/
@@ -28,8 +37,8 @@ phase-sum                perf.json per-phase seconds sum to the
 metrics-unregistered     every ``tony_*`` family in metrics.prom is in
                          ``tony_tpu.metrics.SERIES``
 fleet-gen-monotonic      fleet daemon generations strictly increase
-fleet-unknown-job        no grant/preempt/state record for a job the
-                         journal never saw submitted
+fleet-unknown-job        no grant/preempt/migrate/state record for a
+                         job the journal never saw submitted
 fleet-double-grant       no second grant for a job without an
                          intervening terminal state or daemon
                          generation bump (a recovered daemon may
@@ -47,7 +56,9 @@ fleet-decision           every REC_FLEET_DECISION names a journaled
 fleet-ledger             the goodput ledger re-folded offline books
                          non-negative phases that sum to each
                          terminal job's wall within 1% (the PR 9
-                         sum-to-wall discipline at the fleet layer)
+                         sum-to-wall discipline at the fleet layer;
+                         migration wall books under its own phase and
+                         participates in the same sum)
 fleet-trace-stitch       every granted job's span tree carries the
                          fleet's trace id (the grant's injected
                          tony.internal.fleet-trace-id reached the
@@ -173,6 +184,8 @@ def _check_journal(path: str, rel: str, rep: Report,
     session: Optional[int] = None
     # job → (record_idx, mgen) of the open resize start
     open_start: Dict[str, Tuple[int, int]] = {}
+    # job → (record_idx, mgen, target) of the open migration start
+    open_migrate: Dict[str, Tuple[int, int, str]] = {}
     # task → folded status for the current epoch
     tasks: Dict[str, str] = {}
     for idx, rec in records:
@@ -200,6 +213,7 @@ def _check_journal(path: str, rel: str, rep: Report,
             session = new_session
             tasks.clear()
             open_start.clear()     # an epoch reset abandons the resize
+            open_migrate.clear()   # ... and the in-flight migration
         elif t == journal_mod.REC_RESIZE:
             if _stale_session(rec, session):
                 rep.violations.append(_stale_violation(rel, idx, rec,
@@ -230,6 +244,46 @@ def _check_journal(path: str, rel: str, rep: Report,
                     del tasks[tid]
             else:
                 open_start[job] = (idx, mgen)
+        elif t == journal_mod.REC_MIGRATE:
+            if _stale_session(rec, session):
+                rep.violations.append(_stale_violation(rel, idx, rec,
+                                                       session, ev))
+                continue
+            job = str(rec.get("job", "") or "")
+            mgen = int(rec.get("mgen", 0) or 0)
+            target = str(rec.get("target", "") or "")
+            if max_mgen is not None and mgen < max_mgen:
+                rep.violations.append(Violation(
+                    "journal-migrate-mgen-monotonic", rel, idx,
+                    f"migration record at mgen {mgen} steps back from "
+                    f"{max_mgen} — a stale-slice migration frame landed "
+                    f"after the membership fence", ev))
+            max_mgen = max(mgen, max_mgen if max_mgen is not None else 0)
+            phase = rec.get("phase")
+            if phase == "applied":
+                start = open_migrate.pop(job, None)
+                if start is not None and mgen < start[1]:
+                    rep.violations.append(Violation(
+                        "journal-migrate-dangling", rel, idx,
+                        f"migration applied at mgen {mgen} but the open "
+                        f"start is newer (mgen {start[1]}) — the "
+                        f"applied move is stale", ev))
+                # Every member relaunched on the target slice: the
+                # source gang's fold is superseded exactly like an
+                # applied resize (mirror replay()). The killed source
+                # executors also strand their spans — this run no
+                # longer owes a fully stitched tree.
+                for tid in [tid for tid in tasks
+                            if tid.partition(":")[0] == job]:
+                    del tasks[tid]
+                clean = False
+            elif phase == "superseded":
+                # A host loss mid-migration folded the op into the
+                # ordinary elastic ladder: the start is closed, the
+                # REC_RESIZE that follows carries the story on.
+                open_migrate.pop(job, None)
+            else:
+                open_migrate[job] = (idx, mgen, target)
         elif t in (journal_mod.REC_REGISTER, journal_mod.REC_TASK,
                    journal_mod.REC_PROGRESS, journal_mod.REC_VERDICT,
                    journal_mod.REC_JOB_SCHEDULED,
@@ -269,6 +323,18 @@ def _check_journal(path: str, rel: str, rep: Report,
                 "journal-resize-dangling", rel, idx, msg))
         else:
             # A job that died/was killed mid-resize legitimately leaves
+            # the start open — that IS the recover re-entry record.
+            rep.notes.append(f"{rel}:{idx}: {msg}")
+    for job, (idx, mgen, target) in sorted(open_migrate.items()):
+        msg = (f"migration start for job {job!r} (mgen {mgen}, target "
+               f"{target!r}) is never applied, superseded, or reset — "
+               f"the journal ends mid-migration (a --recover re-enters "
+               f"the op; a SUCCEEDED job must not end here)")
+        if strict:
+            rep.violations.append(Violation(
+                "journal-migrate-dangling", rel, idx, msg))
+        else:
+            # A coordinator killed mid-migration legitimately leaves
             # the start open — that IS the recover re-entry record.
             rep.notes.append(f"{rel}:{idx}: {msg}")
     return n_gens, clean and n_gens <= 1
@@ -424,7 +490,8 @@ def _check_fleet_journal(path: str, rel: str, rep: Report) -> None:
             states[job] = "QUEUED"
             continue
         if t not in (fj.REC_FLEET_GRANT, fj.REC_FLEET_PREEMPT,
-                     fj.REC_FLEET_STATE, fj.REC_FLEET_DECISION):
+                     fj.REC_FLEET_STATE, fj.REC_FLEET_DECISION,
+                     fj.REC_FLEET_MIGRATE):
             continue
         if job not in submitted:
             rep.violations.append(Violation(
@@ -450,6 +517,16 @@ def _check_fleet_journal(path: str, rel: str, rep: Report) -> None:
                     f"recorded per reason TRANSITION, never per tick "
                     f"(the bounded-journal contract)", ev))
             last_decision[job] = (action, reason)
+            continue
+        if t == fj.REC_FLEET_MIGRATE:
+            # A live move re-books hosts between slices without
+            # changing the count — the capacity fold is untouched; a
+            # migration record for a finished job is still a breach.
+            if prev in fj.TERMINAL_STATES:
+                rep.violations.append(Violation(
+                    "fleet-terminal", rel, idx,
+                    f"migration record for job {job} in terminal state "
+                    f"{prev} — a finished job was moved", ev))
             continue
         if t == fj.REC_FLEET_GRANT:
             # A grant closes the hold episode: the same hold may

@@ -63,6 +63,15 @@ class EventType(str, enum.Enum):
     # the diagnosis engine must not read its absorbed task exits as the
     # job's failure.
     GANG_RESIZED = "GANG_RESIZED"
+    # Live job migration (coordinator/migrate.py): the WHOLE gang drained,
+    # snapshotted, and relaunched on a different slice WITHOUT restarting
+    # the job — spot-reclaim survival or fleet defragmentation. Emitted
+    # with phase="started" when the drain begins and phase="completed"
+    # when the barrier reopens on the destination; payload carries the
+    # jobtype, mgen, members, source/target slice and the trigger reason.
+    # The goodput ledger books the completed window as its own
+    # "migration" phase (fleet/ledger.py), never as train.
+    GANG_MIGRATED = "GANG_MIGRATED"
     # On-demand device profiling (tony-tpu profile <app>): a task's
     # capture reached a terminal state. Payload: task, request id, steps,
     # status ("captured" with the artifact dir, or "failed" with the
@@ -94,6 +103,11 @@ class EventType(str, enum.Enum):
     # `tony-tpu fleet explain`); payload: job, action, reason, blocking
     # (the job ids / tenants holding the capacity).
     FLEET_JOB_HELD = "FLEET_JOB_HELD"
+    # A running fleet job was live-migrated between slices (spot-reclaim
+    # survival or FRAGMENTATION repacking) via its coordinator's migrate
+    # op — drain→move→reshard, no epoch burned, never a kill; payload:
+    # job, source, target, reason.
+    FLEET_JOB_MIGRATED = "FLEET_JOB_MIGRATED"
     # A fleet job reached a terminal state (finished/failed/cancelled);
     # payload: job, state, exit, app_id.
     FLEET_JOB_FINISHED = "FLEET_JOB_FINISHED"

@@ -732,6 +732,41 @@ class TaskExecutor:
         return procutil.poll_till_non_null(
             attempt, interval_s=0.3, timeout_s=timeout_s)
 
+    def _park_ack_for_migration(self) -> bool:
+        """Deliver ONE park acknowledgement for a live migration, then
+        return — never wait for the spec. Survives a coordinator outage
+        the same way a result report does (the mid-migration SIGKILL
+        drill): re-resolve + retry inside the orphan deadline, so the
+        RECOVERED coordinator re-entering the journaled move collects
+        this ack. FencedError is terminal (a live coordinator already
+        moved past this incarnation); an exhausted deadline just exits —
+        the coordinator's drain degrades to the heartbeat-expiry ladder."""
+        deadline = time.monotonic() + float(
+            self.conf.get_int(K.TASK_ORPHAN_DEADLINE_S, 120))
+        while True:
+            try:
+                self.client.call(
+                    "register_worker_spec", task_id=self.task_id,
+                    host=self.hostname, port=self.rendezvous_port.port,
+                    session_id=self.session_id, mgen=self.mgen)
+                return True
+            except FencedError as e:
+                log.warning("migration park ack for %s fenced: %s",
+                            self.task_id, e)
+                return False
+            except Exception as e:  # noqa: BLE001
+                if time.monotonic() >= deadline:
+                    log.warning("migration park ack failed within the "
+                                "orphan deadline: %s", e)
+                    return False
+                log.info("migration park ack failed (%s); re-resolving "
+                         "the coordinator and retrying", e)
+                time.sleep(0.5)
+                self._resolve_coordinator()
+                old, self.client = self.client, self._make_client(
+                    self.coordinator_host, self.coordinator_port)
+                old.close()
+
     def _localize_bundle(self) -> None:
         """Localize the staged job bundle, container resources, and venv
         into this task's working dir (reference ``Utils.extractResources``
@@ -1026,6 +1061,26 @@ class TaskExecutor:
                     # Shrunk out of the gang: no coordinator wants this
                     # exit — the re-meshed topology no longer holds the
                     # task (a result report would be fenced anyway).
+                    self._released = True
+                    break
+                if directive.get("migrate"):
+                    # Live migration: the gang relaunches on the
+                    # DESTINATION slice under this same task identity.
+                    # Waiting at the barrier would hand THIS incarnation
+                    # the re-meshed spec meant for its replacement — two
+                    # gangs training at once — so ack the park (the
+                    # coordinator's drain completes on it) and exit with
+                    # the quiet released shape.
+                    log.warning("migrating to %r under membership "
+                                "generation %d: acking the drain and "
+                                "exiting %s", directive.get("target"),
+                                self.mgen, self.task_id)
+                    park_span = self.tracer.start_span(
+                        "executor.park", parent=self._run_span,
+                        task=self.task_id,
+                        attrs={"mgen": self.mgen, "migrate": True})
+                    acked = self._park_ack_for_migration()
+                    park_span.end(acked=acked)
                     self._released = True
                     break
                 # PARK: re-register the existing identity under the new

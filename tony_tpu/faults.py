@@ -95,6 +95,25 @@ site                      where it fires
                           full/failed disk on the observability path;
                           the decision is still applied (ring + event),
                           one-time warning, scheduling unaffected
+``ckpt.async-write``      checkpoint background writer, before the
+                          serialized bytes are handed to orbax — the
+                          failed-in-flight-async-save shape; the step
+                          is NOT committed (no manifest), restore falls
+                          back to the last committed step, training
+                          continues
+``migrate.snapshot``      coordinator migration, before the drained
+                          gang's state is sealed for the move — the
+                          failed-snapshot shape; the migration aborts
+                          into the ordinary INFRA_TRANSIENT retry
+                          ladder (never worse than a host loss)
+``migrate.adopt``         coordinator migration, after the topology
+                          moved but before destination executors
+                          launch — the unadoptable-destination shape;
+                          same abort path
+``slice.preempt``         fleet slice-reclaim notice poll: a firing
+                          marks one held slice as dying (the
+                          queued-resource spot-reclaim shape) so the
+                          fleet rehearses proactive migration off it
 ========================  =====================================================
 
 Spec grammar (the value of ``tony.fault.<site>`` conf keys, or one
@@ -152,7 +171,9 @@ SITES = ("rpc.connect", "rpc.send", "rpc.slow", "heartbeat",
          "pool.lease", "pool.stale", "pool.adopt",
          "host.loss", "resize.barrier", "resize.remesh",
          "profile.capture", "quant.probe", "coord.slow-tick",
-         "fleet.grant", "fleet.preempt", "fleet.ledger", "fleet.explain")
+         "fleet.grant", "fleet.preempt", "fleet.ledger", "fleet.explain",
+         "ckpt.async-write", "migrate.snapshot", "migrate.adopt",
+         "slice.preempt")
 
 
 class InjectedFault(ConnectionError):

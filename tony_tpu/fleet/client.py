@@ -98,6 +98,15 @@ class FleetClient:
                 f"malformed explain response: {res!r}")
         return res
 
+    def migrate(self, job: str, target: int) -> dict:
+        """Operator-initiated live move of a running fleet job to
+        another slice (defrag by hand, pre-maintenance evacuation)."""
+        res = self.call("fleet.migrate", job=job, target=int(target))
+        if not isinstance(res, dict):
+            raise FleetClientError(
+                f"malformed migrate response: {res!r}")
+        return res
+
     def stop(self) -> None:
         self.call("fleet.stop")
 

@@ -19,7 +19,15 @@ decisions:
   resize RPC (``coordinator/elastic.py`` drain→remesh — the absorb path:
   no kill, no epoch burned) and hands the reclaimed hosts to the
   higher-priority demander;
-- a **grow-back** restores shrunk victims once the queue drains.
+- a **grow-back** restores shrunk victims once the queue drains;
+- a **migration** moves a running job between slices through its
+  coordinator's live-migration op (``coordinator/migrate.py``
+  drain→async-snapshot→relaunch — no kill, no epoch burned): planned by
+  the policy engine to cure FRAGMENTATION holds, triggered proactively
+  by a slice-preemption notice (the ``slice.preempt`` fault site in
+  drills, the queued-resource reclaim feed — ``cluster/gcloud.py``
+  ``reclaim_notices`` — in production), or requested by the operator
+  via ``tony-tpu fleet migrate <job> <slice>``.
 
 Every decision is write-ahead journaled (``fleet/journal.py``) so a
 SIGKILLed daemon restarted with ``--recover`` resumes the same queue
@@ -50,9 +58,10 @@ from tony_tpu.devtools.race import guarded
 from tony_tpu.events.events import Event, EventHandler, EventType
 from tony_tpu.fleet import journal as fjournal
 from tony_tpu.fleet import ledger as fledger
-from tony_tpu.fleet.policy import (GRANT, HOLD_ACTIONS, QUOTA_DENIED,
-                                   SHRINK, Decision, JobRequest,
-                                   PolicyEngine, parse_quotas)
+from tony_tpu.fleet.policy import (GRANT, HOLD_ACTIONS, MIGRATE,
+                                   QUOTA_DENIED, SHRINK, Decision,
+                                   JobRequest, PolicyEngine,
+                                   parse_quotas)
 from tony_tpu.metrics import MetricsRegistry
 from tony_tpu.utils.durable import atomic_write
 
@@ -232,6 +241,26 @@ class SubprocessJobRunner:
         finally:
             rpc.close()
 
+    def migrate(self, job_workdir: str, target: str) -> bool:
+        """Live migration (defrag repack / slice evacuation) via the
+        job's own migrate_application RPC — the coordinator's
+        drain→move→reshard op, no epoch burned. A refusal (op already
+        in flight, unreachable) is a no; the daemon retries next
+        tick."""
+        rpc = self._coordinator_rpc(job_workdir)
+        if rpc is None:
+            return False
+        try:
+            res = rpc.call("migrate_application", target=str(target),
+                           job="")
+            return bool(isinstance(res, dict) and res.get("ok"))
+        except Exception as e:  # noqa: BLE001 — a dead mover is a no
+            log.warning("fleet migrate of %s to %r failed: %s",
+                        job_workdir, target, e)
+            return False
+        finally:
+            rpc.close()
+
     def kill(self, job_workdir: str) -> bool:
         rpc = self._coordinator_rpc(job_workdir)
         if rpc is None:
@@ -270,6 +299,9 @@ class _FleetService:
     def fleet__explain(self, job: str) -> dict:
         return self._d.explain(str(job))
 
+    def fleet__migrate(self, job: str, target: int) -> dict:
+        return self._d.migrate(str(job), int(target))
+
     def fleet__stop(self) -> bool:
         self._d.request_stop()
         return True
@@ -290,6 +322,7 @@ class FleetDaemon:
         "_ledger_rollup": "_lock",
         "_grant_waits": "_lock",
         "_preempts_per_job": "_lock",
+        "_dying_slices": "_lock",
         "_ledger_degraded": None,
         "_ledger_next_mono": None,
         "_explain_warned": None,
@@ -301,6 +334,7 @@ class FleetDaemon:
                  pool_dir: str = "", cache_root: str = "",
                  tick_s: float = 0.5, recover: bool = False,
                  runner: Optional[Any] = None,
+                 reclaim_probe: Optional[Any] = None,
                  python: str = sys.executable,
                  decision_ring: int = 64,
                  ledger_interval_s: float = 5.0) -> None:
@@ -334,6 +368,13 @@ class FleetDaemon:
         self._explain_warned = False
         self._grant_waits: List[float] = []
         self._preempts_per_job: Dict[str, int] = {}
+        # Slice-preemption notices: slices the provider has marked for
+        # reclaim. Remembered for the daemon's life and evacuated
+        # proactively; ``reclaim_probe`` is an optional callable
+        # returning dying slice indices (production: the queued-resource
+        # reclaim feed, cluster/gcloud.py reclaim_notices).
+        self.reclaim_probe = reclaim_probe
+        self._dying_slices: set = set()
 
         journal_path = os.path.join(self.fleet_dir,
                                     constants.FLEET_JOURNAL_FILE)
@@ -677,6 +718,7 @@ class FleetDaemon:
                     "held": held})
             queue_depth = self.engine.queue_depth
             free = self.engine.pool.free_total
+            dying = sorted(self._dying_slices)
         hist = self.metrics.histogram(
             "tony_fleet_queue_wait_seconds",
             buckets=QUEUE_WAIT_BUCKETS_S,
@@ -700,7 +742,8 @@ class FleetDaemon:
             "fleet_dir": self.fleet_dir, "generation": self.generation,
             "pool": {"slices": self.slices,
                      "hosts_per_slice": self.hosts_per_slice,
-                     "total": total, "used": total - free, "free": free},
+                     "total": total, "used": total - free, "free": free,
+                     "dying": dying},
             "tenants": tenants,
             "queue_depth": queue_depth,
             "jobs": rows,
@@ -717,7 +760,9 @@ class FleetDaemon:
     def tick(self) -> None:
         self._poll_jobs()
         self._discover_apps()
+        self._poll_reclaim()
         self._apply_plan()
+        self._evacuate()
         self._restore()
         self._export()
 
@@ -800,6 +845,9 @@ class FleetDaemon:
             elif d.action == SHRINK:
                 if not self._apply_preempt(d.job_id, d.hosts, d.for_job,
                                            d.reason):
+                    return
+            elif d.action == MIGRATE:
+                if not self._apply_migrate(d):
                     return
             elif d.action in HOLD_ACTIONS:
                 self._note_decision(d)
@@ -1004,6 +1052,139 @@ class FleetDaemon:
         log.warning("fleet preempt: %s shrunk %d->%d host(s) for %s",
                     victim_id, from_hosts, to_hosts, for_job)
         return True
+
+    # -- live migration (coordinator/migrate.py over the fleet) -----------
+    @staticmethod
+    def _slice_pool(i: int) -> str:
+        """The node-pool name slice ``i`` presents to coordinators —
+        the migrate RPC's target string (symbolic on LocalSim)."""
+        return f"slice-{int(i)}"
+
+    def _poll_reclaim(self) -> None:
+        """Slice-preemption notice intake. Two feeds: the
+        ``slice.preempt`` fault site (drills: each daemon tick is one
+        call; the injected notice marks the lowest-indexed slice still
+        holding running jobs as dying) and the optional
+        ``reclaim_probe``. A dying slice is remembered for the daemon's
+        life and evacuated proactively every tick (_evacuate)."""
+        notices: List[int] = []
+        try:
+            faults.check("slice.preempt")
+        except faults.InjectedFault:
+            with self._lock:
+                held = sorted(
+                    i for j in self.jobs.values()
+                    if j.state == RUNNING for i in j.placement)
+            if held:
+                notices.append(held[0])
+        if self.reclaim_probe is not None:
+            try:
+                notices.extend(int(i) for i in self.reclaim_probe())
+            except Exception as e:  # noqa: BLE001 — a flaky feed is no notice
+                log.debug("fleet reclaim probe failed: %s", e)
+        fresh: List[int] = []
+        with self._lock:
+            for i in notices:
+                if 0 <= i < self.slices and i not in self._dying_slices:
+                    self._dying_slices.add(i)
+                    fresh.append(i)
+        for i in fresh:
+            self.metrics.counter(
+                "tony_fleet_reclaim_notices_total",
+                help="slice-preemption notices received").inc()
+            self.tracer.instant("fleet.reclaim-notice",
+                                attrs={"slice": i})
+            log.warning("fleet: slice %d preemption notice — evacuating "
+                        "its jobs by live migration", i)
+
+    def _evacuate(self) -> None:
+        """Move every elastic job off the dying slices (policy
+        ``evacuation_candidates``); jobs with no landing room stay and
+        the ordinary host-loss ladder absorbs them when the slice
+        actually dies."""
+        with self._lock:
+            dying = sorted(self._dying_slices)
+            moves = self.engine.evacuation_candidates(dying) \
+                if dying else []
+        for d in moves:
+            if not self._apply_migrate(d):
+                return              # retry the rest next tick
+
+    def _apply_migrate(self, d: Decision) -> bool:
+        with self._lock:
+            job = self.jobs.get(d.job_id)
+            if job is None or job.state != RUNNING:
+                return True
+        # The move lands through the job's own coordinator (drain →
+        # async snapshot → relaunch on the target), then the
+        # accounting — same order as preempt: a crash in between
+        # leaves the journal one move behind, which the next life's
+        # placement replay tolerates (host COUNT never drifts).
+        if not self.runner.migrate(job.workdir,
+                                   self._slice_pool(d.target)):
+            log.warning("fleet migrate: %s move to slice %d refused/"
+                        "unreachable; retried next tick", d.job_id,
+                        d.target)
+            return False
+        with self._lock:
+            placement = self.engine.migrate_applied(d.job_id,
+                                                    d.placement)
+            job.placement = placement
+        self.journal.migrate(d.job_id, d.source, d.target, placement,
+                             reason=d.reason)
+        self.tracer.instant("fleet.migrate", parent=job.job_span,
+                            task=d.job_id,
+                            attrs={"source": d.source,
+                                   "target": d.target,
+                                   "reason": d.reason})
+        self.metrics.counter("tony_fleet_migrations_total",
+                             help="live job migrations applied").inc()
+        self.events.emit(Event(EventType.FLEET_JOB_MIGRATED, {
+            "job": d.job_id, "source": d.source, "target": d.target,
+            "reason": d.reason}))
+        log.warning("fleet migrate: %s moved slice %d -> %d (%s)",
+                    d.job_id, d.source, d.target, d.reason)
+        return True
+
+    def migrate(self, job_id: str, target: int) -> dict:
+        """`tony-tpu fleet migrate <job> <slice>`: operator-initiated
+        live move (defrag by hand, pre-maintenance evacuation)."""
+        t = int(target)
+        with self._lock:
+            job = self.jobs.get(job_id)
+            if job is None:
+                return {"ok": False,
+                        "message": f"unknown job {job_id!r}"}
+            if job.state != RUNNING:
+                return {"ok": False,
+                        "message": f"{job_id} is {job.state}, not "
+                                   f"RUNNING"}
+            if not 0 <= t < self.slices:
+                return {"ok": False,
+                        "message": f"target slice {t} outside the pool "
+                                   f"(0..{self.slices - 1})"}
+            if set(job.placement) == {t}:
+                return {"ok": False,
+                        "message": f"{job_id} already runs on slice "
+                                   f"{t}"}
+            trial = self.engine.pool.clone()
+            trial.release(job.placement)
+            free_t = trial.free_on(t)
+            if free_t < job.hosts:
+                return {"ok": False,
+                        "message": f"slice {t} has only {free_t} free "
+                                   f"host(s); {job_id} holds "
+                                   f"{job.hosts}"}
+            d = Decision(MIGRATE, job_id, hosts=job.hosts,
+                         placement={t: job.hosts},
+                         source=min(job.placement), target=t,
+                         reason=f"operator migrate to slice {t}")
+        if not self._apply_migrate(d):
+            return {"ok": False,
+                    "message": "the job's coordinator refused the move "
+                               "or is unreachable — see the daemon log"}
+        return {"ok": True, "job": job_id, "source": d.source,
+                "target": t, "placement": {str(t): job.hosts}}
 
     def _restore(self) -> None:
         """Grow shrunk victims back toward their requested size once the

@@ -46,6 +46,12 @@ REC_FLEET_STATE = "fstate"      # job state transition (spawned/running/...)
 # contract, checked by the fleet-decision invariant) so the journal
 # holds the job's full causal hold timeline without per-tick bloat.
 REC_FLEET_DECISION = "fdecision"
+# A running job live-migrated between slices (spot-reclaim survival or
+# FRAGMENTATION repacking): write-ahead of the victim coordinator's
+# migrate RPC; the post-move placement is journaled so replay
+# re-accounts the pool exactly (host COUNT is unchanged — migration
+# moves capacity, it never shrinks it).
+REC_FLEET_MIGRATE = "fmigrate"
 
 #: in-fold cap on per-job decision history (the journal keeps all of it
 #: on disk; the replayed fold only needs enough to seed the explain
@@ -172,6 +178,17 @@ class FleetJournal:
                      "placement": {str(i): int(n)
                                    for i, n in placement.items()}})
 
+    def migrate(self, job_id: str, source: int, target: int,
+                placement: Dict[int, int], reason: str = "") -> None:
+        """Write-ahead of a live migration: the job's gang moves from
+        slice ``source`` to slice ``target`` with its host count intact;
+        ``placement`` is the post-move slice map."""
+        self.append({"t": REC_FLEET_MIGRATE, "job": job_id,
+                     "source": int(source), "target": int(target),
+                     "placement": {str(i): int(n)
+                                   for i, n in placement.items()},
+                     "reason": str(reason)})
+
     def decision(self, job_id: str, action: str, reason: str,
                  blocking: Optional[List[str]] = None,
                  free: int = 0) -> None:
@@ -289,6 +306,12 @@ def replay(path: str) -> FleetReplayState:
             fold.hosts = int(rec.get("to", fold.hosts) or 0)
             fold.placement = _placement(rec)
             fold.host_events.append((ts_ms, fold.hosts))
+        elif t == REC_FLEET_MIGRATE:
+            fold = state.jobs.get(str(rec.get("job", "") or ""))
+            if fold is None:
+                continue
+            # Host count is unchanged by a move — only the slice map.
+            fold.placement = _placement(rec)
         elif t == REC_FLEET_DECISION:
             fold = state.jobs.get(str(rec.get("job", "") or ""))
             if fold is None:

@@ -30,6 +30,9 @@ Wall phases (seconds, sum == wall within rounding)::
     preempted        elastic drain windows a fleet preemption caused
                      (GANG_RESIZED completed with to < from)
     resize_drain     the other drain windows (grow-backs, host loss)
+    migration        live-migration windows (GANG_MIGRATED completed):
+                     drain→move→reshard wall — its own phase, never
+                     booked as train
     train            the remainder — steps actually advancing
 
 Chip-seconds: each post-grant phase is weighted by the average host
@@ -63,7 +66,7 @@ log = logging.getLogger(__name__)
 #: anchor for tests and the exposition's label set.
 PHASES = ("queued", "provision", "cold_start", "warm_start",
           "retry_recompute", "ckpt_stall", "preempted", "resize_drain",
-          "train")
+          "migration", "train")
 
 #: sum-to-wall tolerance the fleet-ledger invariant enforces (matches
 #: the perf.json phase-sum discipline: 1% relative + rounding epsilon).
@@ -122,12 +125,13 @@ def _span_anchors(job_dir: str) -> Dict[str, Any]:
     return out
 
 
-def _event_windows(job_dir: str) -> Tuple[float, float]:
-    """(preempted_s, resize_drain_s) from the job's GANG_RESIZED
-    completed events: shrink drains (to < from) book as preempted —
-    the fleet reclaims via elastic shrink, never a kill — everything
-    else (grow-backs, host-loss absorbs that grew nothing) books as
-    resize_drain."""
+def _event_windows(job_dir: str) -> Tuple[float, float, float]:
+    """(preempted_s, resize_drain_s, migration_s) from the job's
+    completed gang events: GANG_RESIZED shrink drains (to < from) book
+    as preempted — the fleet reclaims via elastic shrink, never a
+    kill — the other GANG_RESIZED windows (grow-backs, host-loss
+    absorbs that grew nothing) book as resize_drain, and GANG_MIGRATED
+    windows (drain→move→reshard wall) book as migration."""
     from tony_tpu.events import events as events_mod
 
     path = None
@@ -138,25 +142,27 @@ def _event_windows(job_dir: str) -> Tuple[float, float]:
                 path = os.path.join(job_dir, name)
                 break
     except OSError:
-        return 0.0, 0.0
+        return 0.0, 0.0, 0.0
     if path is None:
-        return 0.0, 0.0
-    preempted = drain = 0.0
+        return 0.0, 0.0, 0.0
+    preempted = drain = migration = 0.0
     try:
         evs = events_mod.read_events(path)
     except OSError:
-        return 0.0, 0.0
+        return 0.0, 0.0, 0.0
     for ev in evs:
-        if ev.type.value != "GANG_RESIZED" \
-                or ev.payload.get("phase") != "completed":
+        if ev.payload.get("phase") != "completed":
             continue
         dur = float(ev.payload.get("duration_s", 0.0) or 0.0)
-        if int(ev.payload.get("to", 0) or 0) \
-                < int(ev.payload.get("from", 0) or 0):
-            preempted += dur
-        else:
-            drain += dur
-    return preempted, drain
+        if ev.type.value == "GANG_MIGRATED":
+            migration += dur
+        elif ev.type.value == "GANG_RESIZED":
+            if int(ev.payload.get("to", 0) or 0) \
+                    < int(ev.payload.get("from", 0) or 0):
+                preempted += dur
+            else:
+                drain += dur
+    return preempted, drain, migration
 
 
 def _last_retry_reset_ms(job_dir: str) -> int:
@@ -234,11 +240,11 @@ def compute_job_ledger(fold: JobFold, job_dir: Optional[str] = None,
 
     anchors = {"submit_us": 0, "first_step_us": 0, "rendezvous_us": 0,
                "warm": False, "trace_id": ""}
-    preempted_s = drain_s = ckpt_s = 0.0
+    preempted_s = drain_s = migration_s = ckpt_s = 0.0
     last_reset_ms = 0
     if job_dir and os.path.isdir(job_dir):
         anchors = _span_anchors(job_dir)
-        preempted_s, drain_s = _event_windows(job_dir)
+        preempted_s, drain_s, migration_s = _event_windows(job_dir)
         ckpt_s = _ckpt_stall_s(job_dir)
         last_reset_ms = _last_retry_reset_ms(job_dir)
     doc["trace_id"] = anchors["trace_id"]
@@ -277,7 +283,7 @@ def compute_job_ledger(fold: JobFold, job_dir: Optional[str] = None,
     phases["retry_recompute"] = retry_s
     post_s = run_s - retry_s
     stalls = {"ckpt_stall": ckpt_s, "preempted": preempted_s,
-              "resize_drain": drain_s}
+              "resize_drain": drain_s, "migration": migration_s}
     stall_total = sum(stalls.values())
     if stall_total > post_s > 0:
         # Over-attribution (overlapping windows, artifact rounding):

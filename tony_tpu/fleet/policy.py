@@ -34,6 +34,15 @@ Model:
 - **Grow-back**: with the queue drained and hosts free, previously
   shrunk jobs are restored toward their requested size, highest
   priority first — preemption is a loan, not a confiscation.
+- **Defrag-by-migration**: when a FRAGMENTATION hold is computed (free
+  hosts exist but do not pack), the planner looks for ONE running
+  sub-slice elastic job whose live migration to another slice merges
+  the holes so the demander places — cheaper than preempting anybody
+  (no victim loses a host, the mover loses only its drain window).
+- **Slice evacuation**: on a slice-preemption notice (the cloud is
+  reclaiming a queued resource), every elastic job touching the dying
+  slice gets a MIGRATE plan onto surviving capacity — spot survival by
+  moving, not by dying and retrying.
 """
 
 from __future__ import annotations
@@ -44,6 +53,7 @@ from typing import Dict, List, Optional, Tuple
 #: decision kinds (Decision.action)
 GRANT = "grant"
 SHRINK = "shrink"          # preempt-to-reclaim: victim shrinks via resize
+MIGRATE = "migrate"        # live move between slices (defrag / evacuation)
 QUOTA_DENIED = "quota"     # tenant at quota: stays queued, never holds
 CAPACITY_DENIED = "capacity"  # pool full and nothing preemptible: holds
 # Explainer-only decisions (tony-tpu fleet explain): the policy engine
@@ -91,6 +101,10 @@ class Decision:
     #: requested means the hosts EXIST but do not pack — fragmentation,
     #: not capacity (the fleet-diagnose FRAGMENTATION rule keys off it)
     free: int = 0
+    #: MIGRATE only: the slice the job vacates and the slice it lands
+    #: on (``placement`` already holds the POST-move layout)
+    source: int = -1
+    target: int = -1
 
 
 @dataclasses.dataclass
@@ -115,6 +129,10 @@ class SlicePool:
     @property
     def free_total(self) -> int:
         return sum(self._free)
+
+    def free_on(self, i: int) -> int:
+        """Free hosts on one slice (the operator-migrate room check)."""
+        return self._free[int(i)]
 
     def clone(self) -> "SlicePool":
         c = SlicePool(self.slices, self.hosts_per_slice)
@@ -312,6 +330,19 @@ class PolicyEngine:
                            f"host(s) via elastic shrink of {victims} "
                            f"(priority {req.priority}); the grant lands "
                            f"once the drain completes"))
+            elif free >= req.hosts \
+                    and (moves := self._plan_defrag(req, tentative)):
+                # FRAGMENTATION with a cure: one live migration merges
+                # the holes. Nobody shrinks — the mover only pays its
+                # drain window; the grant lands once the move completes.
+                plan.extend(moves)
+                movers = [d.job_id for d in moves]
+                plan.append(Decision(
+                    PREEMPT_WAIT, req.job_id, hosts=req.hosts,
+                    free=free, blocking=movers,
+                    reason=f"defragmentation: repacking via live "
+                           f"migration of {movers} — the grant lands "
+                           f"once the move completes"))
             else:
                 holders = self._largest_holders()
                 if free >= req.hosts:
@@ -403,6 +434,102 @@ class PolicyEngine:
         # holds the head of the line right after this either way.
         return shrinks if tentative.place(req.hosts) is not None else []
 
+    def _plan_defrag(self, req: JobRequest,
+                     tentative: SlicePool) -> List[Decision]:
+        """ONE live migration that merges the fragmented holes so
+        ``req`` places, or []. Candidates are running sub-slice elastic
+        jobs (``min_hosts`` > 0 — migration rides the same drain
+        machinery as a shrink) at or below the demander's priority,
+        cheapest move first (fewest hosts), then youngest — the job
+        that has run longest is disturbed last. Pure: works on clones
+        of ``tentative``."""
+        hps = self.pool.hosts_per_slice
+        movers = sorted(
+            (r for r in self._running.values()
+             if len(r.placement) == 1 and r.hosts < hps
+             and r.req.min_hosts > 0
+             and r.req.priority <= req.priority),
+            key=lambda r: (r.hosts, -r.req.seq))
+        for v in movers:
+            src = next(iter(v.placement))
+            trial = tentative.clone()
+            trial.release(v.placement)
+            # Land the mover anywhere BUT its own slice — the point is
+            # to merge the hole it leaves behind.
+            src_free = trial._free[src]
+            trial._free[src] = 0
+            dest = trial.place(v.hosts)
+            if dest is None or src in dest:
+                continue
+            trial.allocate(dest)
+            trial._free[src] = src_free
+            if trial.place(req.hosts) is None:
+                continue
+            tgt = next(iter(dest))
+            return [Decision(
+                MIGRATE, v.req.job_id, hosts=v.hosts, placement=dest,
+                source=src, target=tgt, for_job=req.job_id,
+                reason=f"defragmentation: moving {v.hosts} host(s) "
+                       f"from slice {src} to slice {tgt} packs a "
+                       f"{req.hosts}-host gang for {req.job_id!r}")]
+        return []
+
+    def evacuation_candidates(self, dying: List[int]) -> List[Decision]:
+        """MIGRATE plan moving every elastic job off the ``dying``
+        slices (a slice-preemption notice) onto surviving capacity,
+        highest priority first. Jobs with no landing room — or without
+        the elastic machinery a live move rides — are skipped; the
+        ordinary host-loss ladder absorbs them when the slice dies.
+        Pure: the daemon applies each move write-ahead and calls
+        ``migrate_applied`` when it lands."""
+        dying_set = {int(i) for i in dying
+                     if 0 <= int(i) < self.pool.slices}
+        if not dying_set:
+            return []
+        tentative = self.pool.clone()
+        for i in dying_set:
+            tentative._free[i] = 0      # never a migration target
+        out: List[Decision] = []
+        for r in sorted(self._running.values(),
+                        key=lambda r: (-r.req.priority, r.req.seq)):
+            doomed = {i: n for i, n in r.placement.items()
+                      if i in dying_set}
+            if not doomed or r.req.min_hosts <= 0:
+                continue
+            # The WHOLE gang moves (drain→move→reshard is one op), so
+            # its healthy hosts free up for the placement too.
+            for i, n in r.placement.items():
+                if i not in dying_set:
+                    tentative._free[i] = min(self.pool.hosts_per_slice,
+                                             tentative._free[i] + n)
+            dest = tentative.place(r.hosts)
+            if dest is None:
+                for i, n in r.placement.items():
+                    if i not in dying_set:
+                        tentative._free[i] -= n
+                continue
+            tentative.allocate(dest)
+            src = min(doomed)
+            tgt = min(dest)
+            out.append(Decision(
+                MIGRATE, r.req.job_id, hosts=r.hosts, placement=dest,
+                source=src, target=tgt,
+                reason=f"slice {sorted(doomed)} preemption notice: "
+                       f"evacuating {r.hosts} host(s) to slice(s) "
+                       f"{sorted(dest)} before the reclaim lands"))
+        return out
+
+    def migrate_applied(self, job_id: str,
+                        placement: Dict[int, int]) -> Dict[int, int]:
+        """A live migration landed: re-account the job's hosts at the
+        new placement (host COUNT unchanged — a move, not a resize)."""
+        r = self._running[job_id]
+        self.pool.release(r.placement)
+        self.pool.allocate(placement)
+        r.placement = dict(placement)
+        r.hosts = sum(placement.values())
+        return dict(r.placement)
+
     def restore_candidates(self) -> List[Tuple[str, int, Dict[int, int]]]:
         """Grow-back plan: with an empty queue and free hosts, restore
         shrunk jobs toward their requested size, highest priority
@@ -486,6 +613,37 @@ def _self_check() -> None:
     eng.release("hi")
     restores = eng.restore_candidates()
     assert restores and restores[0][0] == "c" and restores[0][1] == 4
+    # Defrag-by-migration: 2+2 free hosts split across both slices
+    # cannot pack a 4-host gang — moving one sub-slice elastic job
+    # merges the holes, nobody shrinks.
+    eng = PolicyEngine(2, 4)
+    eng.submit(JobRequest("m1", "t1", hosts=2, min_hosts=1, seq=1))
+    eng.grant("m1", {0: 2})
+    eng.submit(JobRequest("m2", "t1", hosts=2, min_hosts=1, seq=2))
+    eng.grant("m2", {1: 2})
+    eng.submit(JobRequest("big", "t2", hosts=4, seq=3))
+    plan = eng.schedule()
+    assert [d.action for d in plan] == [MIGRATE, PREEMPT_WAIT], plan
+    mv = plan[0]
+    assert mv.job_id == "m2" and (mv.source, mv.target) == (1, 0), mv
+    assert plan[1].blocking == ["m2"] \
+        and plan[1].reason.startswith("defragmentation"), plan[1]
+    eng.migrate_applied(mv.job_id, mv.placement)
+    plan = eng.schedule()
+    assert [(d.action, d.job_id) for d in plan] == [(GRANT, "big")], plan
+    # Slice evacuation: a preemption notice on slice 0 moves the
+    # elastic job there to surviving capacity; the job without a
+    # shrink floor is left to the ordinary retry ladder.
+    eng = PolicyEngine(2, 4)
+    eng.submit(JobRequest("ev", "t1", hosts=2, min_hosts=1, seq=1))
+    eng.grant("ev", {0: 2})
+    eng.submit(JobRequest("pin", "t1", hosts=2, seq=2))
+    eng.grant("pin", {0: 2})
+    plan = eng.evacuation_candidates([0])
+    assert [(d.action, d.job_id) for d in plan] == [(MIGRATE, "ev")], plan
+    assert (plan[0].source, plan[0].target) == (0, 1), plan[0]
+    eng.migrate_applied("ev", plan[0].placement)
+    assert eng.running("ev") == (2, {1: 2})
     print("fleet policy self-check OK")
 
 

@@ -80,6 +80,10 @@ SERIES: Dict[str, str] = {
     "tony_fleet_tenant_hosts": "granted hosts per tenant",
     "tony_fleet_grants_total": "job grants applied",
     "tony_fleet_preemptions_total": "preempt-to-reclaim shrinks applied",
+    "tony_fleet_migrations_total": "live slice migrations applied "
+                                   "(defrag, evacuation, operator)",
+    "tony_fleet_reclaim_notices_total": "slice-preemption notices "
+                                        "received from the reclaim feed",
     "tony_fleet_quota_denials_total": "grants deferred by tenant quota",
     "tony_fleet_queue_wait_seconds": "submit-to-grant wait latency",
     # -- fleet goodput ledger (tony_tpu/fleet/ledger.py) ------------------

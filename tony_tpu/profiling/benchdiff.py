@@ -40,7 +40,11 @@ _HIGHER = ("tokens_per_sec", "samples_per_sec", "mfu_vs_peak_bf16",
            # BENCH_FLEET family (bench --suite fleet): chip-seconds
            # doing useful steps / chip-seconds held, and the warm-pool
            # adoption rate across tenants.
-           "goodput_fraction", "warm_start_fraction")
+           "goodput_fraction", "warm_start_fraction",
+           # BENCH_MIGRATE family (bench --suite migrate): share of the
+           # synchronous save cost the async writer hides, and the
+           # destination gang's warm-pool adoption rate.
+           "ckpt_overlap_fraction", "warm_adoption_fraction")
 #: metric-name suffixes where smaller is better
 _LOWER = ("submit_to_first_step_s", "probe_self_reported_s",
           "phase_total_s", "seconds_per_step", "mean_step_s",
@@ -50,7 +54,11 @@ _LOWER = ("submit_to_first_step_s", "probe_self_reported_s",
           "fsync_stall_fraction", "resize_latency_s",
           # BENCH_FLEET family: scheduler latency/churn metrics.
           "queue_wait_p50_s", "queue_wait_p99_s",
-          "preemptions_per_job", "drain_s")
+          "preemptions_per_job", "drain_s",
+          # BENCH_MIGRATE family: the move's wall, training steps the
+          # move lost (the e2e drills pin 0), and save()-blocking share
+          # of the step loop under the async snapshot writer.
+          "migration_wall_s", "steps_lost", "ckpt_stall_fraction")
 #: path components under which every plain numeric leaf is seconds of a
 #: phase breakdown → lower is better
 _LOWER_CONTAINERS = ("phases", "step_phases_s", "phase_span_durations")
