@@ -1,0 +1,99 @@
+"""Slow chaos drills: the seeded sweep and the seed-corpus replays.
+
+The sweep is the acceptance drill in miniature — a 40-schedule seeded
+run over the migrate and fleet suites (the two with the most moving
+parts), every schedule asserted clean on the full invariant ladder.
+Because this module is named ``test_e2e_*`` and each schedule runs
+under ``tmp_path``, conftest's autouse ``_verify_drill_artifacts``
+fixture re-checks every surviving job dir with `tony-tpu check` at
+teardown: the sweep is auto-verified twice, once per schedule by the
+oracle and once in aggregate by the fixture.
+
+The corpus test replays every checked-in shrunk repro in
+tests/chaos_corpus/ — each one is a schedule that USED to violate the
+ladder (the bug it found is named in its ``note``). A regression
+reopens the exact violation the artifact records, so these are the
+chaos engine's pinned bug museum.
+"""
+
+import json
+import os
+
+import pytest
+
+from tony_tpu.chaos import artifact as chaos_artifact
+from tony_tpu.chaos.runner import run_schedule
+from tony_tpu.chaos.schedule import plan
+
+pytestmark = [pytest.mark.slow, pytest.mark.faults]
+
+CORPUS = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                      "chaos_corpus")
+
+SWEEP_SEED = 17
+SWEEP_SCHEDULES = 40
+
+
+@pytest.mark.timeout_s(560)
+def test_seeded_sweep_migrate_and_fleet_hold_the_ladder(tmp_path):
+    suites = ("migrate", "fleet")
+    failures = []
+    for index in range(SWEEP_SCHEDULES):
+        sched = plan(SWEEP_SEED, index, suites[index % len(suites)])
+        workdir = str(tmp_path / sched.name)
+        outcome = run_schedule(sched, workdir)
+        if not outcome.ok:
+            # Keep the evidence: a replayable artifact for `tony-tpu
+            # chaos replay` / `chaos shrink`, plus the scratch tree.
+            path = chaos_artifact.save_artifact(
+                str(tmp_path / "findings"), sched, outcome)
+            failures.append(
+                f"{sched.name} [{sched.suite}] {outcome.status}/"
+                f"{outcome.failure_domain}: "
+                + "; ".join(f"{v.rung}: {v.detail}"
+                            for v in outcome.violations)
+                + f" (artifact: {path})")
+    assert not failures, (
+        f"{len(failures)}/{SWEEP_SCHEDULES} schedule(s) violated the "
+        f"invariant ladder (seed {SWEEP_SEED}):\n" + "\n".join(failures))
+
+
+def _corpus_docs():
+    return [(name, chaos_artifact.load_artifact(os.path.join(CORPUS, name)))
+            for name in sorted(os.listdir(CORPUS))
+            if name.endswith(".json")]
+
+
+@pytest.mark.timeout_s(300)
+def test_corpus_repros_stay_fixed(tmp_path):
+    """Every corpus schedule re-runs clean: the chaos-found bugs each
+    artifact's note describes must stay fixed."""
+    docs = _corpus_docs()
+    assert docs, "seed corpus must not be empty"
+    for name, doc in docs:
+        sched = chaos_artifact.schedule_from_doc(doc)
+        outcome = run_schedule(sched, str(tmp_path / name))
+        recorded = chaos_artifact.outcome_from_doc(doc)
+        assert outcome.ok, (
+            f"{name} regressed — note: {doc.get('note', '?')!r}; "
+            f"violations: "
+            + "; ".join(f"{v.rung}: {v.detail}"
+                        for v in outcome.violations))
+        # Terminal shape should match the recorded post-fix outcome.
+        assert (outcome.status, outcome.failure_domain) == \
+               (recorded.status, recorded.failure_domain), (
+            f"{name}: replay ended {outcome.status}/"
+            f"{outcome.failure_domain}, artifact recorded "
+            f"{recorded.status}/{recorded.failure_domain}")
+
+
+def test_corpus_artifacts_are_canonical_json():
+    """Corpus files are hand-checked-in: keep them loadable, sorted and
+    newline-terminated so diffs stay reviewable."""
+    for name, doc in _corpus_docs():
+        path = os.path.join(CORPUS, name)
+        with open(path, encoding="utf-8") as f:
+            raw = f.read()
+        assert raw == json.dumps(doc, indent=2, sort_keys=True) + "\n", (
+            f"{name} is not canonical: rewrite with "
+            f"json.dumps(doc, indent=2, sort_keys=True)")

@@ -813,3 +813,165 @@ def test_policy_self_check_runs_clean():
     from tony_tpu.fleet import policy
 
     policy._self_check()
+
+
+# ---------------------------------------------------------------------------
+# Simultaneous-crash window: daemon SIGKILLed between a victim's preempt
+# resize RPC and the journal record of it. --recover must reconcile the
+# victim's ACTUAL gang size (from its own session journal) instead of
+# double-granting the reclaimed hosts — or losing them forever.
+# ---------------------------------------------------------------------------
+def _sigkill_daemon_with_live_pids(d, fleet_dir):
+    """The SIGKILL shape: drop the daemon with no shutdown, then pin
+    every journaled client pid to a live one so recovery adopts."""
+    d.journal.close()
+    jpath = os.path.join(fleet_dir, constants.FLEET_JOURNAL_FILE)
+    recs = [json.loads(line) for line in open(jpath)]
+    for r in recs:
+        if r.get("t") == fj.REC_FLEET_STATE and r.get("pid"):
+            r["pid"] = os.getpid()
+    with open(jpath, "w") as f:
+        for r in recs:
+            f.write(json.dumps(r) + "\n")
+
+
+def _write_victim_session_journal(workdir, app_id, members_applied):
+    """Materialize the victim coordinator's own write-ahead journal
+    showing a resize that LANDED (phase applied) while the fleet daemon
+    was dead."""
+    from tony_tpu.coordinator import journal as cjournal
+
+    job_dir = os.path.join(workdir, "jobs", app_id)
+    os.makedirs(job_dir, exist_ok=True)
+    vj = cjournal.SessionJournal(
+        os.path.join(job_dir, constants.JOURNAL_FILE))
+    vj.generation(1)
+    vj.app(app_id, 0, "t")
+    vj.resize("worker", 1, members_applied, "start", 0, "fleet preempt")
+    vj.resize("worker", 1, members_applied, "applied", 0, "fleet preempt")
+    vj.close()
+
+
+def test_recover_completes_unjournaled_preempt_shrink(tmp_path):
+    """The resize RPC landed (victim shrank 4->2) but the daemon died
+    before journaling the preempt: recovery must free the 2 reclaimed
+    hosts and journal the completed shrink — the waiting demander's
+    grant then proceeds with no double-booking."""
+    fleet_dir = str(tmp_path / "fleet")
+    d = _daemon(tmp_path, slices=1, hosts_per_slice=4)
+    victim = d.submit("t", 4, min_hosts=1, conf={})["job"]
+    d.tick()
+    assert _job_row(d, victim)["state"] == RUNNING
+    _sigkill_daemon_with_live_pids(d, fleet_dir)
+    # the victim's own journal says the gang settled at 2 members
+    wd = os.path.join(fleet_dir, "jobs", victim)
+    app_id = "app_x_" + victim.replace("-", "_")
+    _write_victim_session_journal(wd, app_id, [0, 1])
+
+    r2 = FakeRunner()
+    d2 = FleetDaemon(fleet_dir, slices=1, hosts_per_slice=4, runner=r2,
+                     recover=True)
+    row = _job_row(d2, victim)
+    assert row["state"] == RUNNING and row["hosts"] == 2
+    assert d2.status()["pool"]["used"] == 2          # NOT 4: hosts freed
+    # the completed shrink was journaled write-ahead for the NEXT crash
+    recs = [json.loads(line) for line in open(
+        os.path.join(fleet_dir, constants.FLEET_JOURNAL_FILE))]
+    pre = [r for r in recs if r.get("t") == fj.REC_FLEET_PREEMPT
+           and r.get("job") == victim]
+    assert pre and pre[-1]["to"] == 2
+    # a demander can now be granted the reclaimed hosts — no livelock,
+    # no double-grant (pool: 2 used by victim + 2 to the demander)
+    dem = d2.submit("t2", 2, conf={})["job"]
+    d2.tick()
+    assert _job_row(d2, dem)["state"] == RUNNING
+    assert d2.status()["pool"]["used"] == 4
+    d2._shutdown()
+    from tony_tpu.devtools import invariants
+
+    rep = invariants.check_job_dir(fleet_dir)
+    assert rep.ok, invariants.render_text([rep])
+
+
+def test_recover_books_unjournaled_grow_back(tmp_path):
+    """The mirror window on the restore path: the grow-back resize
+    landed (2->4) but the daemon died before grow_applied/journal —
+    recovery must book the extra hosts so they cannot be double-granted."""
+    fleet_dir = str(tmp_path / "fleet")
+    d = _daemon(tmp_path, slices=1, hosts_per_slice=4)
+    victim = d.submit("t", 2, min_hosts=1, conf={})["job"]
+    d.tick()
+    _sigkill_daemon_with_live_pids(d, fleet_dir)
+    wd = os.path.join(fleet_dir, "jobs", victim)
+    app_id = "app_x_" + victim.replace("-", "_")
+    _write_victim_session_journal(wd, app_id, [0, 1, 2, 3])
+
+    d2 = FleetDaemon(fleet_dir, slices=1, hosts_per_slice=4,
+                     runner=FakeRunner(), recover=True)
+    row = _job_row(d2, victim)
+    assert row["state"] == RUNNING and row["hosts"] == 4
+    assert d2.status()["pool"]["used"] == 4
+    # a 2-host submit must now WAIT instead of double-granting hosts
+    # the grown gang actually occupies
+    dem = d2.submit("t2", 2, conf={})["job"]
+    d2.tick()
+    assert _job_row(d2, dem)["state"] != RUNNING
+    d2._shutdown()
+
+
+def test_recover_mid_drain_resize_completes_via_retry(tmp_path):
+    """Daemon dies while the victim is STILL draining (resize start
+    journaled by the victim, no applied yet): recovery keeps the
+    conservative journaled accounting, and the preempt retries against
+    the (now idempotent) resize RPC instead of livelocking."""
+    fleet_dir = str(tmp_path / "fleet")
+    d = _daemon(tmp_path, slices=1, hosts_per_slice=4)
+    victim = d.submit("t", 4, min_hosts=1, conf={})["job"]
+    d.tick()
+    _sigkill_daemon_with_live_pids(d, fleet_dir)
+    wd = os.path.join(fleet_dir, "jobs", victim)
+    app_id = "app_x_" + victim.replace("-", "_")
+    from tony_tpu.coordinator import journal as cjournal
+
+    job_dir = os.path.join(wd, "jobs", app_id)
+    os.makedirs(job_dir, exist_ok=True)
+    vj = cjournal.SessionJournal(
+        os.path.join(job_dir, constants.JOURNAL_FILE))
+    vj.generation(1)
+    vj.app(app_id, 0, "t")
+    vj.resize("worker", 1, [0, 1], "start", 0, "fleet preempt")   # in flight
+    vj.close()
+
+    d2 = FleetDaemon(fleet_dir, slices=1, hosts_per_slice=4,
+                     runner=FakeRunner(), recover=True)
+    # conservative: the journaled grant stands until the drain lands
+    row = _job_row(d2, victim)
+    assert row["state"] == RUNNING and row["hosts"] == 4
+    assert d2.status()["pool"]["used"] == 4
+    d2._shutdown()
+
+
+def test_resize_rpc_idempotent_at_size():
+    """resize_application to the CURRENT size answers ok (no-op), not a
+    refusal: at-least-once delivery retries must converge."""
+    from tony_tpu.coordinator.elastic import ElasticManager
+
+    class _T:
+        def __init__(self, i):
+            self.job_name = "worker"
+            self.index = i
+            self.task_id = f"worker:{i}"
+            self.status = types.SimpleNamespace(terminal=False)
+
+    class _S:
+        def all_tasks(self):
+            return [_T(0), _T(1)]
+
+    from tony_tpu.conf.config import TonyTpuConfig
+
+    conf = TonyTpuConfig()
+    conf.set(K.ELASTIC_ENABLED, "true")
+    el = ElasticManager(conf)
+    el.established = True
+    assert el.at_size(2, _S())
+    assert not el.at_size(3, _S())

@@ -1,0 +1,301 @@
+"""Execute chaos schedules against the in-process control plane.
+
+Three suites, all subprocess-free so a 200-schedule sweep fits in
+minutes, and all REAL control-plane code paths — real RPC frames over
+real TCP, real write-ahead journals on real disk, real policy engine:
+
+``e2e``
+    One :class:`Coordinator` over a virtual gang (executor/virtual.py):
+    4 beat-only tasks self-finish after ``run_s`` through the ordinary
+    result path while the schedule storms transport, disk and hosts.
+``migrate``
+    The e2e substrate, plus a live ``migrate_application`` issued the
+    moment the gang establishes — the storm lands on a gang mid-move.
+``fleet``
+    One :class:`FleetDaemon` over an in-process fake job runner: a
+    seeded multi-tenant workload (submits, completions) ticks through
+    grant/preempt storms, slice reclaims and journal disk faults.
+
+The runner OWNS the global fault injector for the run's duration
+(install before, uninstall in finally) and climbs the oracle ladder
+afterwards. A schedule that stalls past its deadline is itself a
+ladder violation — a chaos storm may fail a job, but it must never
+wedge the control plane.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import threading
+import time
+from typing import Optional
+
+from tony_tpu import faults
+from tony_tpu.chaos import oracle
+from tony_tpu.chaos.oracle import Outcome, Violation
+from tony_tpu.chaos.schedule import Schedule, fault_seed
+
+log = logging.getLogger(__name__)
+
+#: wall-clock budget per schedule: generous enough for a full retry
+#: ladder (seeded backoff), tight enough that a wedged run is a finding.
+DEADLINE_S = 90.0
+
+
+# ---------------------------------------------------------------------------
+# e2e / migrate: coordinator over a virtual gang
+# ---------------------------------------------------------------------------
+def _coord_conf(workers: int = 4, run_s: float = 1.0):
+    from tony_tpu.conf import keys as K
+    from tony_tpu.conf.config import TonyTpuConfig
+
+    conf = TonyTpuConfig()
+    conf.set("tony.worker.instances", workers)
+    conf.set("tony.worker.command", "virtual")
+    conf.set(K.SCALE_VIRTUAL_EXECUTORS, True)
+    conf.set(K.SCALE_VIRTUAL_RUN_S, run_s)
+    conf.set(K.TASK_HEARTBEAT_INTERVAL_MS, 150)
+    conf.set(K.COORDINATOR_MONITOR_INTERVAL_MS, 50)
+    conf.set(K.APPLICATION_NUM_CLIENTS_TO_WAIT, False)
+    conf.set(K.DIAGNOSIS_ENABLED, False)
+    # Elastic on: host.loss storms shrink-and-continue (the production
+    # absorption path) instead of burning a whole epoch per death.
+    conf.set(K.ELASTIC_ENABLED, True)
+    conf.set(K.ELASTIC_MIN_TASKS, 1)
+    conf.set(K.ELASTIC_DRAIN_GRACE_S, 5)
+    conf.set(K.ELASTIC_BARRIER_TIMEOUT_S, 20)
+    return conf
+
+
+def _run_coordinator_suite(schedule: Schedule, workdir: str,
+                           migrate: bool) -> Outcome:
+    from tony_tpu.cluster.local import VirtualExecutorBackend
+    from tony_tpu.coordinator.coordinator import Coordinator
+
+    app_id = f"chaos_{schedule.suite}_{schedule.index:06d}"
+    conf = _coord_conf()
+    backend = VirtualExecutorBackend.from_conf(
+        conf, os.path.join(workdir, "work"))
+    history = os.path.join(workdir, "history")
+    outcome = Outcome()
+    crash: list = []
+
+    coord = Coordinator(conf, app_id, backend, history, user="chaos")
+
+    def _run() -> None:
+        try:
+            coord.run()
+        except BaseException as e:  # noqa: BLE001 — a crash IS a finding
+            crash.append(e)
+
+    runner = threading.Thread(target=_run, daemon=True,
+                              name=f"chaos-coord-{schedule.index}")
+    runner.start()
+    deadline = time.monotonic() + DEADLINE_S
+    try:
+        if migrate:
+            # Fire the move the moment the gang establishes; if the
+            # storm kills establishment first, the migrate is skipped —
+            # the schedule still exercised the launch path.
+            while time.monotonic() < deadline:
+                if coord.session.status.value in ("FAILED", "KILLED",
+                                                  "SUCCEEDED"):
+                    break
+                if coord.elastic.established \
+                        and not coord.elastic.resizing:
+                    try:
+                        coord.migrate_application("slice-1",
+                                                  reason="chaos drill")
+                    except Exception as e:  # noqa: BLE001
+                        log.info("chaos migrate refused: %s", e)
+                    break
+                time.sleep(0.05)
+        while time.monotonic() < deadline:
+            if not runner.is_alive():
+                break
+            time.sleep(0.05)
+    finally:
+        stalled = runner.is_alive()
+        if stalled:
+            try:
+                coord.request_stop("chaos deadline")
+            except Exception:  # noqa: BLE001
+                pass
+            runner.join(timeout=15)
+        if runner.is_alive():
+            outcome.violations.append(Violation(
+                "verdict", f"run wedged: coordinator still alive "
+                           f"{DEADLINE_S:.0f}s past launch and deaf to "
+                           f"request_stop"))
+            # last-resort teardown so the sweep can continue
+            try:
+                coord.rpc._server.server_close()
+            except Exception:  # noqa: BLE001
+                pass
+        try:
+            backend.stop()
+        except Exception:  # noqa: BLE001
+            pass
+
+    status = coord.session.status.value
+    domain = (coord.session.failure_domain.value
+              if coord.session.failure_domain else "")
+    outcome.status = status
+    outcome.failure_domain = domain
+    if crash:
+        outcome.detail = f"coordinator crashed: {crash[0]!r}"
+        if status not in ("SUCCEEDED", "FAILED", "KILLED"):
+            outcome.violations.append(Violation(
+                "verdict", f"coordinator thread died on unhandled "
+                           f"{crash[0]!r} with the session left "
+                           f"{status}"))
+    return outcome
+
+
+# ---------------------------------------------------------------------------
+# fleet: daemon over an in-process runner
+# ---------------------------------------------------------------------------
+class _ChaosHandle:
+    def __init__(self, pid: int) -> None:
+        self.pid = pid
+        self.exit: Optional[int] = None
+
+    def poll(self) -> Optional[int]:
+        return self.exit
+
+
+class _ChaosRunner:
+    """SubprocessJobRunner stand-in (the tests' FakeRunner shape): no
+    processes, handles exit on command — the chaos workload script
+    completes jobs between ticks."""
+
+    def __init__(self) -> None:
+        self.handles = {}
+        self._next_pid = 40000
+
+    def spawn(self, workdir: str, overrides: dict) -> _ChaosHandle:
+        os.makedirs(workdir, exist_ok=True)
+        self._next_pid += 1
+        h = _ChaosHandle(self._next_pid)
+        self.handles[os.path.basename(workdir)] = h
+        return h
+
+    def poll(self, handle: _ChaosHandle) -> Optional[int]:
+        return handle.poll()
+
+    def resize(self, workdir: str, size: int) -> bool:
+        return True
+
+    def migrate(self, workdir: str, target: str) -> bool:
+        return True
+
+    def kill(self, workdir: str) -> bool:
+        h = self.handles.get(os.path.basename(workdir))
+        if h is not None and h.exit is None:
+            h.exit = 143
+        return True
+
+
+def _run_fleet_suite(schedule: Schedule, workdir: str) -> Outcome:
+    import random
+
+    from tony_tpu.fleet.daemon import FleetDaemon, RUNNING
+    from tony_tpu.utils.durable import DurableWriteError
+
+    outcome = Outcome()
+    fleet_dir = os.path.join(workdir, "fleet")
+    runner = _ChaosRunner()
+    daemon = FleetDaemon(fleet_dir, slices=2, hosts_per_slice=4,
+                         quotas="", runner=runner, tick_s=0.05)
+    # The WORKLOAD is seeded like the faults: same schedule, same
+    # submit/complete script, tick for tick.
+    rng = random.Random(f"workload:{fault_seed(schedule.seed, schedule.index)}")
+    submits = [("tenant-" + str(rng.randint(0, 2)),
+                rng.choice((1, 2, 4)), rng.randint(0, 2))
+               for _ in range(rng.randint(3, 6))]
+    ticks = 40
+    journal_dead = False
+    try:
+        for tick_no in range(ticks):
+            if daemon.journal.dead is not None:
+                journal_dead = True
+                break
+            while submits and rng.random() < 0.4:
+                tenant, hosts, prio = submits.pop()
+                daemon.submit(tenant, hosts, priority=prio,
+                              min_hosts=1, conf={})
+            try:
+                daemon.tick()
+            except DurableWriteError:
+                journal_dead = True
+                break
+            except Exception as e:  # noqa: BLE001 — run() survives these
+                if daemon.journal.dead is not None:
+                    journal_dead = True
+                    break
+                log.info("chaos fleet tick error (absorbed): %s", e)
+            # Complete a running job now and then: churn admits the
+            # next queued tenant and exercises release accounting.
+            if rng.random() < 0.2:
+                with daemon._lock:
+                    running = [j for j in daemon.jobs.values()
+                               if j.state == RUNNING]
+                if running:
+                    victim = rng.choice(running)
+                    h = runner.handles.get(victim.req.job_id)
+                    if h is not None and h.exit is None:
+                        h.exit = 0
+    finally:
+        try:
+            daemon._shutdown()
+        except Exception:  # noqa: BLE001
+            pass
+
+    if journal_dead:
+        # The documented degrade: stop loudly, point at --recover.
+        outcome.status = "FAILED"
+        outcome.failure_domain = "INFRA_TRANSIENT"
+        outcome.detail = f"fleet journal dead: {daemon.journal.dead}"
+    else:
+        outcome.status = "SUCCEEDED"
+        # Accounting must balance: pool used == sum of RUNNING grants.
+        st = daemon.status()
+        booked = sum(j["hosts"] for j in st["jobs"]
+                     if j["state"] == RUNNING)
+        if st["pool"]["used"] != booked:
+            outcome.violations.append(Violation(
+                "verdict", f"pool accounting skew: used="
+                           f"{st['pool']['used']} but RUNNING grants "
+                           f"book {booked}"))
+    return outcome
+
+
+# ---------------------------------------------------------------------------
+# entry point
+# ---------------------------------------------------------------------------
+def run_schedule(schedule: Schedule, workdir: str) -> Outcome:
+    """Execute one schedule in a fresh workdir and climb the ladder."""
+    os.makedirs(workdir, exist_ok=True)
+    gates = oracle.snapshot_gates()
+    injector = schedule.injector()
+    faults.install(injector)
+    try:
+        if schedule.suite in ("e2e", "migrate"):
+            outcome = _run_coordinator_suite(
+                schedule, workdir, migrate=(schedule.suite == "migrate"))
+        elif schedule.suite == "fleet":
+            outcome = _run_fleet_suite(schedule, workdir)
+        else:
+            raise ValueError(f"unknown chaos suite {schedule.suite!r}")
+    finally:
+        faults.uninstall()
+
+    oracle.check_verdict(outcome.status, outcome.failure_domain,
+                         outcome.violations)
+    oracle.check_artifacts(workdir, outcome.violations)
+    app_id = f"chaos_{schedule.suite}_{schedule.index:06d}"
+    oracle.check_orphans(app_id, outcome.violations,
+                         timeout_s=2.0)
+    oracle.check_gates(gates, outcome.violations)
+    return outcome

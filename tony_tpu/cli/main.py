@@ -23,6 +23,7 @@ import sys
 import time
 from typing import List, Optional
 
+from tony_tpu import faults as _faults
 from tony_tpu.client import TaskUpdateListener, TonyTpuClient
 from tony_tpu.conf import keys as K
 
@@ -177,7 +178,8 @@ def _coordinator_rpc(app_id: str, workdir: Optional[str]):
         tls = client_tls_context(addr["tls_cert"])
     return RpcClient(addr["host"], addr["port"],
                      token=addr.get("token") or None,
-                     max_retries=2, retry_sleep_s=0.5, tls=tls)
+                     max_retries=2, retry_sleep_s=0.5, tls=tls,
+                     peer="coordinator")
 
 
 def _cmd_resize(args: argparse.Namespace) -> int:
@@ -1006,6 +1008,147 @@ def _cmd_check(args: argparse.Namespace) -> int:
     return 0 if report.ok else 1
 
 
+def _chaos_workdir(base: str, schedule) -> str:
+    return os.path.join(base, "runs", schedule.name)
+
+
+def _chaos_run_one(schedule, outdir: str, runs_root: str):
+    """Execute one schedule, save its artifact, return the outcome."""
+    import shutil
+
+    from tony_tpu.chaos import artifact as chaos_artifact
+    from tony_tpu.chaos import runner as chaos_runner
+
+    workdir = _chaos_workdir(runs_root, schedule)
+    if os.path.isdir(workdir):
+        shutil.rmtree(workdir)
+    outcome = chaos_runner.run_schedule(schedule, workdir)
+    chaos_artifact.save_artifact(outdir, schedule, outcome)
+    # a clean run's scratch tree is noise; a failing run's is evidence
+    if outcome.ok:
+        shutil.rmtree(workdir, ignore_errors=True)
+    return outcome
+
+
+def _cmd_chaos_run(args: argparse.Namespace) -> int:
+    """`tony-tpu chaos run` — seeded multi-fault sweep."""
+    from tony_tpu.chaos import schedule as chaos_schedule
+
+    seed = int(args.seed)
+    outdir = os.path.abspath(args.out)
+    runs_root = os.path.join(outdir, "scratch")
+    os.environ[_faults.FAULT_SEED_ENV] = str(seed)
+    suites = [args.suite] if args.suite else list(chaos_schedule.SUITES)
+    failed = 0
+    total = 0
+    t0 = time.monotonic()
+    for index in range(int(args.schedules)):
+        suite = suites[index % len(suites)]
+        sched = chaos_schedule.plan(seed, index, suite)
+        total += 1
+        outcome = _chaos_run_one(sched, outdir, runs_root)
+        tag = "ok" if outcome.ok else "FAIL"
+        sites = ", ".join(i.site for i in sched.injections)
+        print(f"{sched.name} [{suite:8s}] {outcome.status:9s} "
+              f"{outcome.failure_domain or '-':16s} {tag}  "
+              f"({sites or 'no injections'})")
+        if not outcome.ok:
+            failed += 1
+            for v in outcome.violations:
+                print(f"    {v.rung}: {v.detail}")
+            if args.fail_fast:
+                break
+    dt = time.monotonic() - t0
+    print(f"chaos: {total} schedule(s), {failed} failing, "
+          f"{dt:.1f}s (seed {seed})")
+    if failed:
+        print(f"artifacts + scratch trees under {outdir}; shrink with "
+              f"`tony-tpu chaos shrink <artifact>`")
+    return 1 if failed else 0
+
+
+def _cmd_chaos_replay(args: argparse.Namespace) -> int:
+    """`tony-tpu chaos replay` — re-run an artifact's schedule and
+    prove the planner regenerates it bit-identically."""
+    from tony_tpu.chaos import artifact as chaos_artifact
+    from tony_tpu.chaos import schedule as chaos_schedule
+
+    doc = chaos_artifact.load_artifact(args.artifact)
+    sched = chaos_artifact.schedule_from_doc(doc)
+    os.environ[_faults.FAULT_SEED_ENV] = str(sched.seed)
+    if not doc.get("shrunk_from"):
+        # full schedules must replan bit-identically — THE determinism
+        # contract; shrunk ones are subsets the planner never emits
+        replanned = chaos_schedule.plan(sched.seed, sched.index,
+                                        sched.suite)
+        if replanned.as_dict() != sched.as_dict():
+            print("REPLAY MISMATCH: the planner no longer regenerates "
+                  "this artifact's schedule — planner drift:",
+                  file=sys.stderr)
+            print(f"  recorded:  {sched.as_dict()}", file=sys.stderr)
+            print(f"  replanned: {replanned.as_dict()}", file=sys.stderr)
+            return 2
+    outdir = os.path.abspath(args.out)
+    outcome = _chaos_run_one(sched, outdir, os.path.join(outdir,
+                                                         "scratch"))
+    recorded = chaos_artifact.outcome_from_doc(doc)
+    print(f"{sched.name}: recorded {recorded.status}"
+          f"{'/' + recorded.failure_domain if recorded.failure_domain else ''}"
+          f" ({'ok' if recorded.ok else 'FAIL'}), replay "
+          f"{outcome.status}"
+          f"{'/' + outcome.failure_domain if outcome.failure_domain else ''}"
+          f" ({'ok' if outcome.ok else 'FAIL'})")
+    for v in outcome.violations:
+        print(f"    {v.rung}: {v.detail}")
+    return 0 if outcome.ok == recorded.ok else 1
+
+
+def _cmd_chaos_shrink(args: argparse.Namespace) -> int:
+    """`tony-tpu chaos shrink` — ddmin a failing artifact to the
+    minimal injection set that still violates the ladder."""
+    import dataclasses
+
+    from tony_tpu.chaos import artifact as chaos_artifact
+    from tony_tpu.chaos import shrink as chaos_shrink
+
+    doc = chaos_artifact.load_artifact(args.artifact)
+    sched = chaos_artifact.schedule_from_doc(doc)
+    os.environ[_faults.FAULT_SEED_ENV] = str(sched.seed)
+    outdir = os.path.abspath(args.out)
+    runs_root = os.path.join(outdir, "scratch")
+    attempts = [0]
+
+    def _fails(injections) -> bool:
+        attempts[0] += 1
+        candidate = dataclasses.replace(sched, injections=list(injections))
+        outcome = _chaos_run_one(candidate, outdir, runs_root)
+        print(f"  shrink run #{attempts[0]}: "
+              f"{len(injections)} injection(s) -> "
+              f"{'FAIL' if not outcome.ok else 'ok'}")
+        return not outcome.ok
+
+    try:
+        minimal = chaos_shrink.ddmin(sched.injections, _fails,
+                                     max_runs=int(args.max_runs))
+    except ValueError as e:
+        print(f"error: {e} — is {args.artifact} a FAILING artifact?",
+              file=sys.stderr)
+        return 2
+    shrunk = dataclasses.replace(sched, injections=minimal)
+    final = _chaos_run_one(shrunk, outdir, runs_root)
+    path = chaos_artifact.save_artifact(
+        outdir, shrunk, final,
+        shrunk_from={"injections": len(sched.injections),
+                     "artifact": os.path.abspath(args.artifact)},
+        note=args.note or "")
+    print(f"shrunk {len(sched.injections)} -> {len(minimal)} "
+          f"injection(s) in {attempts[0]} run(s):")
+    for inj in minimal:
+        print(f"  {inj.site} = {inj.spec}")
+    print(f"minimal repro saved to {path}")
+    return 0
+
+
 def _cmd_pool(args: argparse.Namespace) -> int:
     """Warm-executor-pool operations (tony_tpu/pool.py): `start` spawns
     the daemon detached and waits for its endpoint; `status` prints the
@@ -1765,6 +1908,54 @@ def build_parser() -> argparse.ArgumentParser:
     ck.add_argument("--json", action="store_true",
                     help="emit the report as JSON")
     ck.set_defaults(fn=_cmd_check)
+
+    ch = sub.add_parser(
+        "chaos",
+        help="the seeded multi-fault chaos engine (tony_tpu/chaos/): "
+             "plan correlated-failure schedules from one seed, run "
+             "them against the in-process control plane under the "
+             "invariant ladder, replay any artifact bit-identically, "
+             "and delta-debug a failing schedule to its minimal repro "
+             "(docs/operations.md \u00a7 Chaos drills).")
+    ch_sub = ch.add_subparsers(dest="chaos_cmd", required=True)
+    cr = ch_sub.add_parser(
+        "run", help="sweep N seeded schedules; exit nonzero if any "
+                    "run violates the invariant ladder")
+    cr.add_argument("--seed", type=int, default=0,
+                    help="sweep seed: same seed, same schedules, "
+                         "same per-call fault decisions (default 0)")
+    cr.add_argument("--schedules", type=int, default=20,
+                    help="how many schedules to plan and run")
+    cr.add_argument("--suite", choices=["e2e", "fleet", "migrate"],
+                    default=None,
+                    help="restrict to one suite (default: round-robin "
+                         "across all three)")
+    cr.add_argument("--out", default="chaos-artifacts",
+                    help="artifact directory (one JSON per schedule)")
+    cr.add_argument("--fail-fast", action="store_true",
+                    help="stop at the first ladder violation")
+    cr.set_defaults(fn=_cmd_chaos_run)
+    cp = ch_sub.add_parser(
+        "replay", help="re-plan + re-run one artifact's schedule; "
+                       "proves planner determinism, then compares the "
+                       "ladder verdict against the recording")
+    cp.add_argument("artifact", help="a chaos artifact JSON path")
+    cp.add_argument("--out", default="chaos-artifacts",
+                    help="artifact directory for the re-run")
+    cp.set_defaults(fn=_cmd_chaos_replay)
+    cs = ch_sub.add_parser(
+        "shrink", help="ddmin a FAILING artifact's schedule to the "
+                       "1-minimal injection set that still fails; "
+                       "saves the minimal repro as a new artifact")
+    cs.add_argument("artifact", help="a failing chaos artifact JSON")
+    cs.add_argument("--out", default="chaos-artifacts",
+                    help="artifact directory for shrink runs")
+    cs.add_argument("--max-runs", type=int, default=60,
+                    help="shrink budget: predicate re-runs (default 60)")
+    cs.add_argument("--note", default="",
+                    help="provenance note stored in the shrunk artifact")
+    cs.set_defaults(fn=_cmd_chaos_shrink)
+
     return p
 
 

@@ -395,7 +395,7 @@ class TonyTpuClient:
         # delayed dead-coordinator detection by ~20 s.
         return RpcClient(addr["host"], addr["port"],
                          token=addr.get("token") or None, tls=tls,
-                         max_retries=3, retry_sleep_s=0.5)
+                         max_retries=3, retry_sleep_s=0.5, peer="coordinator")
 
     def _monitor(self, addr_file: str) -> int:
         """Reference ``monitorApplication`` :838-892 (1 s poll; task-info

@@ -790,6 +790,27 @@ FAULT_PROFILE_CAPTURE = _key(
     "arm jax.profiler (unsupported runtime / profiler crash shape): the "
     "task reports PROFILE_FAILED on its next beat and training "
     "continues — capture must never kill or stall the job.")
+FAULT_RPC_PARTITION = _key(
+    "tony.fault.rpc-partition", "", str,
+    "Cut the RPC wire asymmetrically (tony_tpu/rpc/wire.py): 'dir:c2s' "
+    "drops request frames before they are sent (the callee never sees "
+    "them), 'dir:s2c' drops RESPONSE frames after the callee already "
+    "processed the request — its side effects land, the caller sees a "
+    "reset and retries. 'peer:NAME' scopes the cut to one labelled "
+    "wire (coordinator/pool/fleet). No dir: token = both directions.")
+FAULT_DISK_FULL = _key(
+    "tony.fault.disk-full", "", str,
+    "Raise ENOSPC on a durable AppendLog append (utils/durable.py) — "
+    "the journal-disk-full shape. Writers must degrade LOUDLY: the "
+    "coordinator monitor folds it into a terminal INFRA verdict, the "
+    "fleet daemon stops instead of scheduling against a dead journal, "
+    "and --recover replays the committed prefix.")
+FAULT_DISK_TORN = _key(
+    "tony.fault.disk-torn", "", str,
+    "Tear a durable write (utils/durable.py): an AppendLog append "
+    "writes a partial record then fails EIO, and atomic_write drops "
+    "the rename (the old bytes survive) — the power-cut-mid-write "
+    "shape the replay-of-prefix readers must absorb.")
 
 # --- warm executor pool (tony_tpu/pool.py) --------------------------------
 POOL_DIR = _key(

@@ -50,6 +50,7 @@ from tony_tpu.events.events import Event, EventHandler, EventType
 from tony_tpu.events import history
 from tony_tpu.rpc.wire import FencedError, RpcServer
 from tony_tpu.utils import durable
+from tony_tpu.utils.durable import DurableWriteError
 
 log = logging.getLogger(__name__)
 
@@ -1788,6 +1789,16 @@ class Coordinator:
         try:
             members = el.plan_explicit(int(size), self.session)
         except ResizeRefused as e:
+            if el.at_size(int(size), self.session):
+                # Idempotent no-op: the gang is already exactly there.
+                # At-least-once delivery (a lost response, a fleet
+                # daemon that crashed between the resize RPC and its
+                # journal record) retries the same resize — the second
+                # delivery must read as success or the caller livelocks
+                # re-sending a resize that can never "succeed".
+                return {"ok": True, "noop": True, "mgen": el.mgen,
+                        "message": f"gang already has {size} member(s) "
+                                   f"— no-op"}
             return {"ok": False, "message": str(e)}
         self._start_resize(members, f"operator resize to {size}")
         return {"ok": True, "mgen": el.mgen, "members": members,
@@ -2091,42 +2102,73 @@ class Coordinator:
                 if single_node:
                     self.session.status = SessionStatus.SUCCEEDED
                     return self.final_status
-            while True:
-                if first and recovered:
-                    self._resume_session()
-                else:
-                    self._start_session(attempt, retry_domain)
-                first = False
-                status = self._monitor()
-                self._close_epoch_spans(status)
-                if status == SessionStatus.SUCCEEDED \
-                        or self._stop_requested.is_set():
-                    break
-                retry_domain = (self.session.failure_domain
-                                or FailureDomain.INFRA_TRANSIENT)
-                self.journal.verdict(
-                    self.session.session_id, retry_domain.value,
-                    self.session.failure_reason or "")
-                if not self._retry_available(retry_domain):
-                    if retry_domain == FailureDomain.USER_ERROR \
-                            and not self._retry_user_errors:
-                        log.error(
-                            "session %d failed with USER_ERROR (%s) — "
-                            "terminal on first occurrence (set %s to "
-                            "retry user errors anyway)", attempt,
-                            self.session.failure_reason,
-                            K.APPLICATION_RETRY_USER_ERRORS)
-                    break
-                log.warning(
-                    "session %d failed [%s] (%s); retrying "
-                    "(transient budget %d/%d used, preemption %d/%d)",
-                    attempt, retry_domain.value,
-                    self.session.failure_reason,
-                    self._infra_retries_used, self._retries_total,
-                    self._preempt_retries_used,
-                    self._preempt_retries_total)
-                self._reset_session()
-                attempt += 1
+            try:
+                while True:
+                    if first and recovered:
+                        self._resume_session()
+                    else:
+                        self._start_session(attempt, retry_domain)
+                    first = False
+                    status = self._monitor()
+                    self._close_epoch_spans(status)
+                    if self.journal.dead is not None:
+                        # An RPC-handler append (register/progress) hit
+                        # the dead disk first: same terminal INFRA shape
+                        # as the raise below, even if the monitor's own
+                        # ticks kept succeeding in memory. fail_terminal
+                        # on purpose — a finished epoch whose verdict
+                        # can no longer be journaled must NOT read as
+                        # SUCCEEDED (the history would claim a success
+                        # the write-ahead journal never saw).
+                        self.session.fail_terminal(
+                            f"journal write failed: {self.journal.dead}",
+                            FailureDomain.INFRA_TRANSIENT)
+                        break
+                    if status == SessionStatus.SUCCEEDED \
+                            or self._stop_requested.is_set():
+                        break
+                    retry_domain = (self.session.failure_domain
+                                    or FailureDomain.INFRA_TRANSIENT)
+                    self.journal.verdict(
+                        self.session.session_id, retry_domain.value,
+                        self.session.failure_reason or "")
+                    if not self._retry_available(retry_domain):
+                        if retry_domain == FailureDomain.USER_ERROR \
+                                and not self._retry_user_errors:
+                            log.error(
+                                "session %d failed with USER_ERROR (%s) "
+                                "— terminal on first occurrence (set %s "
+                                "to retry user errors anyway)", attempt,
+                                self.session.failure_reason,
+                                K.APPLICATION_RETRY_USER_ERRORS)
+                        break
+                    log.warning(
+                        "session %d failed [%s] (%s); retrying "
+                        "(transient budget %d/%d used, preemption %d/%d)",
+                        attempt, retry_domain.value,
+                        self.session.failure_reason,
+                        self._infra_retries_used, self._retries_total,
+                        self._preempt_retries_used,
+                        self._preempt_retries_total)
+                    self._reset_session()
+                    attempt += 1
+            except DurableWriteError as e:
+                # The write-ahead journal died (ENOSPC/EIO) — whether
+                # mid-monitor, on the retry path's verdict record, or in
+                # a session reset. TERMINAL, domain INFRA: retrying
+                # would schedule state transitions recovery can never
+                # see, and the verdict/retry machinery itself journals.
+                # Kill the gang with the full grace and stop — the
+                # committed journal prefix stays replayable for
+                # --recover.
+                log.critical(
+                    "journal write failed (%s) — failing the job "
+                    "terminally [INFRA_TRANSIENT]", e)
+                self.session.fail_terminal(
+                    f"journal write failed: {e}",
+                    FailureDomain.INFRA_TRANSIENT)
+                self._kill_all_tasks(
+                    self.conf.get_int(K.COORDINATOR_STOP_GRACE_S, 15))
         finally:
             self.final_status = self.session.update_status()
             if self._stop_requested.is_set() and self.final_status in (
@@ -2136,7 +2178,16 @@ class Coordinator:
                 # races the chief-failure policy) — YARN semantics: a
                 # user-killed app is KILLED, not FAILED.
                 self.final_status = SessionStatus.KILLED
-            self._stop()
+            try:
+                self._stop()
+            except DurableWriteError as e:
+                # Teardown writes the journal too (terminal states,
+                # close). A disk that dies HERE must not crash the
+                # coordinator out of its own exit path: the committed
+                # prefix is already replayable, the history record below
+                # still lands (separate file), so scream and finish.
+                log.critical("journal write failed during teardown "
+                             "(%s); committed prefix intact", e)
         return self.final_status
 
     def _do_local_job(self, cmd: str, register_tb: bool) -> int:

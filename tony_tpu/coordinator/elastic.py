@@ -187,6 +187,21 @@ class ElasticManager:
                      and t.task_id != task.task_id]
         return len(survivors) >= self.min_tasks
 
+    def at_size(self, size: int, session: "Session") -> bool:
+        """Is the established gang ALREADY at ``size`` with no resize in
+        flight? The idempotent-resize probe: a caller retrying a resize
+        whose first RESPONSE was lost (asymmetric partition, daemon
+        crash between the RPC and its journal record) must read
+        already-there as success, not as a refusal to retry forever."""
+        if not self.enabled or not self.established:
+            return False
+        with self._lock:
+            if self._op is not None:
+                return False
+        live = [t.index for t in session.all_tasks()
+                if t.job_name == self.job and not t.status.terminal]
+        return len(live) == int(size)
+
     def plan_explicit(self, size: int, session: "Session") -> List[int]:
         """Member list for an operator resize to ``size`` — shrink drops
         the HIGHEST indices (never the chief at index 0), grow re-adds

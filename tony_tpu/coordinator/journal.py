@@ -32,7 +32,7 @@ import os
 import time
 from typing import Callable, Dict, Iterable, Iterator, Optional, Set, Tuple
 
-from tony_tpu.utils.durable import AppendLog
+from tony_tpu.utils.durable import AppendLog, DurableWriteError
 
 log = logging.getLogger(__name__)
 
@@ -137,15 +137,33 @@ class SessionJournal:
         self.path = path
         self.enabled = enabled
         self.observer = observer
+        #: first durable-write failure, sticky (ENOSPC/EIO). The FIRST
+        #: failing append raises so the caller hears it; later appends
+        #: no-op — the journal is declared dead ONCE, loudly, and the
+        #: teardown/verdict paths must not cascade tracebacks against a
+        #: disk that cannot take the write anyway. The committed prefix
+        #: on disk stays replayable (readers tolerate a torn tail).
+        self.dead: Optional[DurableWriteError] = None
         self._log: Optional[AppendLog] = AppendLog(path) if enabled else None
 
     def append(self, record: Dict) -> None:
         if self._log is None:
             return
+        if self.dead is not None:
+            return
         record.setdefault("ts", int(time.time() * 1000))
         data = (json.dumps(record, sort_keys=True) + "\n").encode("utf-8")
         t0 = time.monotonic()
-        self._log.append(data)
+        try:
+            self._log.append(data)
+        except DurableWriteError as e:
+            self.dead = e
+            log.critical(
+                "session journal %s is DEAD (%s): failing loudly — a "
+                "coordinator that cannot journal cannot be recovered "
+                "truthfully; the committed prefix remains replayable",
+                self.path, e)
+            raise
         if self.observer is not None:
             try:
                 self.observer(len(data), time.monotonic() - t0)

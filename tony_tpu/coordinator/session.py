@@ -402,6 +402,19 @@ class Session:
         with self._lock:
             self._fail_locked(reason, domain)
 
+    def fail_terminal(self, reason: str,
+                      domain: Optional[FailureDomain] = None) -> None:
+        """Force FAILED even over a completed epoch — the journal-dead
+        degrade: an outcome the coordinator can no longer durably
+        record must not read as SUCCEEDED (the history would claim a
+        success the write-ahead journal never saw)."""
+        with self._lock:
+            if self.status != SessionStatus.FAILED:
+                self.status = SessionStatus.FAILED
+                self.failure_reason = reason
+            self.failure_domain = worst_domain(self.failure_domain,
+                                               domain)
+
     # -- reduction --------------------------------------------------------
     def update_status(self) -> SessionStatus:
         """Reduce tracked-task states to a session status (reference

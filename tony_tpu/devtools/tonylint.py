@@ -19,10 +19,10 @@ Rules (ids are what ``# tony: lint-ignore[<rule>]`` suppresses):
 conf-key        every ``tony.*`` dotted token in a string literal outside
                 ``conf/keys.py`` must resolve to a registered ConfigKey, a
                 dynamic per-jobtype key, or a registered key family prefix
-fault-site      ``faults.fire/check/fire_amount`` call sites use literal
-                site names from ``faults.SITES``; every listed site has at
-                least one call site (both directions, like the reference's
-                fault-hook constants)
+fault-site      ``faults.fire/check/fire_amount/check_partition`` call
+                sites use literal site names from ``faults.SITES``; every
+                listed site has at least one call site (both directions,
+                like the reference's fault-hook constants)
 event-type      events are built only from live ``EventType`` members;
                 ``diagnosis/rules.py`` ``events_used`` tuples and
                 ``events_of("...")`` strings reference only live members
@@ -323,7 +323,8 @@ class Linter:
                 continue
             for node in ast.walk(src.tree):
                 if not _is_call_to(node, "faults",
-                                   ("fire", "check", "fire_amount")):
+                                   ("fire", "check", "fire_amount",
+                                    "check_partition")):
                     continue
                 site = _const_str(node.args[0]) if node.args else None
                 if site is None:

@@ -361,7 +361,7 @@ class TaskExecutor:
             retry_sleep_s=self._rpc_retry_sleep_s,
             tls=self._tls, generation=self.generation,
             call_timeout_s=self._rpc_call_timeout_s,
-            on_latency=self._record_rpc_latency)
+            on_latency=self._record_rpc_latency, peer="coordinator")
         client.trace_context = self._trace_ctx
         return client
 
@@ -419,7 +419,7 @@ class TaskExecutor:
             connect_timeout_s=5.0, tls=self._tls,
             generation=self.generation,
             call_timeout_s=self._rpc_call_timeout_s,
-            on_latency=self._record_rpc_latency)
+            on_latency=self._record_rpc_latency, peer="coordinator")
         client.trace_context = self._trace_ctx
         try:
             client.call("register_worker_spec", task_id=self.task_id,

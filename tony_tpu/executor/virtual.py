@@ -35,6 +35,7 @@ import threading
 import time
 from typing import Dict, Optional
 
+from tony_tpu import faults
 from tony_tpu.rpc.wire import FencedError, RpcClient
 
 log = logging.getLogger(__name__)
@@ -159,7 +160,8 @@ class VirtualGang:
             self._tls.client = RpcClient(
                 self._addr[0], self._addr[1], token=self._token,
                 generation=self._generation, max_retries=2,
-                retry_sleep_s=0.2, call_timeout_s=30.0)
+                retry_sleep_s=0.2, call_timeout_s=30.0,
+                peer="coordinator")
         return self._tls.client
 
     def _worker(self) -> None:
@@ -252,6 +254,16 @@ class VirtualGang:
                 return None
             # _BEAT: one heartbeat with a synthetic progress beacon —
             # real beacon_fold work for the coordinator, real liveness.
+            # host.loss here mirrors the real executor's heartbeat-loop
+            # poll (executor.py): a firing kills THIS virtual host with
+            # the vanished-host exit shape. ``task:*`` correlates the
+            # loss across hosts — the chaos planner's multi-host-death
+            # schedules ride this one site.
+            if faults.fire("host.loss", task_id=task.task_id):
+                log.warning("FAULT host.loss: virtual task %s vanishes",
+                            task.task_id)
+                task.handle.returncode = 137
+                return None
             steps = self.steps_per_s * (time.monotonic()
                                         - (task.beat_t0 or task.started))
             progress = {"steps": round(steps, 2), "age_s": 0.0,

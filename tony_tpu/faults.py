@@ -114,6 +114,26 @@ site                      where it fires
                           marks one held slice as dying (the
                           queued-resource spot-reclaim shape) so the
                           fleet rehearses proactive migration off it
+``rpc.partition``         RpcClient.call, per frame and per DIRECTION —
+                          ``dir:c2s`` drops the request before it is
+                          sent (the callee never sees it), ``dir:s2c``
+                          drops the RESPONSE after the callee has
+                          already processed the request (its side
+                          effects land; the caller sees a reset and
+                          retries) — the asymmetric-partition shape;
+                          ``peer:NAME`` scopes the cut to one wire
+                          (coordinator / pool / fleet)
+``disk.full``             utils/durable AppendLog.append, before the
+                          write — ENOSPC on the fsync'd journal append;
+                          the writer must degrade LOUDLY (terminal
+                          INFRA verdict / daemon stop), never silently
+                          truncate, and ``--recover`` must replay the
+                          committed prefix
+``disk.torn``             utils/durable — AppendLog.append writes a
+                          torn partial record then fails EIO, and
+                          atomic_write drops the rename (old bytes
+                          survive) — the power-cut-mid-write shape the
+                          replay-of-prefix readers must absorb
 ========================  =====================================================
 
 Spec grammar (the value of ``tony.fault.<site>`` conf keys, or one
@@ -127,12 +147,23 @@ Spec grammar (the value of ``tony.fault.<site>`` conf keys, or one
 - ``p:X``       — fire with probability X, from a per-site RNG seeded
   with (seed, site) — the sequence of decisions is identical for a given
   seed, machine-independent
+- ``prob:P``    — fire with probability P, decided by a stable hash of
+  (seed, site, call-index): unlike ``p:X``'s sequential RNG the decision
+  for call #N is a pure function of the seed — chaos schedules can
+  predict, replay and SHRINK around it. Seed comes from the injector
+  (``seed=N`` / ``tony.fault.seed``), defaulting to ``TONY_FAULT_SEED``
 - ``session:S`` — additional filter: only fire when this process's
   ``TONY_SESSION_ID`` is S (lets a fault hit epoch 0 and spare the retry)
 - ``task:T``    — additional filter: only fire when this process's
   ``TONY_TASK_ID`` is T (e.g. ``task:worker:1`` — slow ONE gang member)
 - ``amt:X``     — payload for sites that take a magnitude (float,
   site-interpreted: ``user.slow_step`` reads it as seconds of delay)
+- ``dir:D``     — additional filter for directional sites
+  (``rpc.partition``): only fire when the call site reports direction D
+  (``c2s`` = request frames, ``s2c`` = response frames)
+- ``peer:NAME`` — additional filter for labelled wires: only fire when
+  the call site reports peer NAME (the RpcClient's ``peer`` label:
+  ``coordinator``, ``pool``, ``fleet``)
 
 Tokens combine with ``,``: ``p:0.5,session:0``. Example conf:
 
@@ -151,16 +182,22 @@ Zero overhead when disabled: ``fire(site)`` is a module-global None check
 
 from __future__ import annotations
 
+import hashlib
 import logging
 import os
 import random
 import threading
-from typing import Dict, List, Optional, Tuple
+from typing import Any, Dict, List, Optional, Tuple
 
 log = logging.getLogger(__name__)
 
 #: env var carrying the serialized spec into executor/user processes
 FAULTS_ENV = "TONY_FAULTS"
+
+#: env var supplying the DEFAULT injector seed (chaos schedules export it
+#: so ``prob:P`` decisions replay bit-identically in every child process;
+#: an explicit ``seed=N`` token / ``tony.fault.seed`` conf still wins)
+FAULT_SEED_ENV = "TONY_FAULT_SEED"
 
 #: the canonical site names (kept in lockstep with the conf keys in
 #: tony_tpu/conf/keys.py: ``tony.fault.<site with . -> ->``)
@@ -173,7 +210,7 @@ SITES = ("rpc.connect", "rpc.send", "rpc.slow", "heartbeat",
          "profile.capture", "quant.probe", "coord.slow-tick",
          "fleet.grant", "fleet.preempt", "fleet.ledger", "fleet.explain",
          "ckpt.async-write", "migrate.snapshot", "migrate.adopt",
-         "slice.preempt")
+         "slice.preempt", "rpc.partition", "disk.full", "disk.torn")
 
 
 class InjectedFault(ConnectionError):
@@ -202,9 +239,12 @@ class _SiteRule:
         self.after = 0
         self.every = 0
         self.p = 0.0
+        self.prob = 0.0
         self.amount = 0.0
         self.session: Optional[int] = None
         self.task: Optional[str] = None
+        self.direction: Optional[str] = None
+        self.peer: Optional[str] = None
         for token in spec.split(","):
             token = token.strip()
             if not token:
@@ -215,8 +255,8 @@ class _SiteRule:
             if not sep:
                 raise ValueError(
                     f"fault spec token {token!r} for {site!r} needs "
-                    f"key:value (one of first/at/after/every/p/amt/"
-                    f"session/task)")
+                    f"key:value (one of first/at/after/every/p/prob/amt/"
+                    f"session/task/dir/peer)")
             key = key.strip().lower()
             value = value.strip()
             try:
@@ -230,12 +270,21 @@ class _SiteRule:
                     self.every = int(value)
                 elif key == "p":
                     self.p = float(value)
+                elif key == "prob":
+                    self.prob = float(value)
                 elif key == "amt":
                     self.amount = float(value)
                 elif key == "session":
                     self.session = int(value)
                 elif key == "task":
                     self.task = value
+                elif key == "dir":
+                    if value not in ("c2s", "s2c"):
+                        raise ValueError(
+                            f"dir: must be c2s or s2c, got {value!r}")
+                    self.direction = value
+                elif key == "peer":
+                    self.peer = value
                 else:
                     raise ValueError(f"unknown fault spec key {key!r}")
             except ValueError as e:
@@ -244,11 +293,50 @@ class _SiteRule:
         # Per-site RNG seeded by (seed, site): decision sequences are
         # reproducible and independent across sites.
         self._rng = random.Random(f"{seed}:{site}")
+        self._seed = seed
         self._calls = 0
         self._lock = threading.Lock()
 
-    def decide(self) -> Tuple[bool, int]:
-        """(fire?, call number) — one deterministic decision per call."""
+    def _hash_draw(self, n: int) -> float:
+        """Stable uniform [0, 1) for call #n: a pure function of
+        (seed, site, n) — unlike the sequential ``p:`` RNG, the decision
+        for a given call index is independent of every other call, so a
+        shrunk schedule keeps the surviving injections' decisions."""
+        h = hashlib.sha256(
+            f"{self._seed}:{self.site}:{n}".encode()).digest()
+        return int.from_bytes(h[:8], "big") / float(1 << 64)
+
+    def decide(self, direction: Optional[str] = None,
+               peer: Optional[str] = None,
+               task_id: Optional[str] = None) -> Tuple[bool, int]:
+        """(fire?, call number) — one deterministic decision per call.
+
+        ``dir:``/``peer:`` filters are scope, not outcome: an
+        out-of-scope frame does NOT consume a call index, so
+        ``dir:s2c,at:3`` means "the 3rd RESPONSE frame", not "call 3 if
+        it happens to be a response".
+
+        ``task_id`` lets IN-PROCESS callers (the virtual gang, where
+        every task shares one process) name the task on whose behalf the
+        site is polled; subprocess executors keep the env-derived
+        identity. ``task:*`` matches every task — the correlated-loss
+        spec (``host.loss=task:*,first:2`` kills the first two beats to
+        poll, i.e. two DIFFERENT hosts near-simultaneously)."""
+        if self.direction is not None and direction != self.direction:
+            with self._lock:
+                return False, self._calls
+        if self.peer is not None and peer != self.peer:
+            with self._lock:
+                return False, self._calls
+        # The task filter is scope too — WHEN the caller names the task
+        # in-process (``task:worker:1,at:3`` = that task's 3rd poll, not
+        # "poll 3 if it happens to be hers"). Subprocess executors keep
+        # the env-derived post-counter check: their counter is already
+        # per-process, so the filter always matches locally.
+        if self.task is not None and task_id is not None:
+            if self.task != "*" and task_id != self.task:
+                with self._lock:
+                    return False, self._calls
         with self._lock:
             self._calls += 1
             n = self._calls
@@ -259,8 +347,9 @@ class _SiteRule:
             env_session = int(os.environ.get("TONY_SESSION_ID", "0") or 0)
             if env_session != self.session:
                 return False, n
-        if self.task is not None:
-            if os.environ.get("TONY_TASK_ID", "") != self.task:
+        if self.task is not None and task_id is None:
+            if self.task != "*" and \
+                    os.environ.get("TONY_TASK_ID", "") != self.task:
                 return False, n
         if self.first and n <= self.first:
             return True, n
@@ -271,6 +360,8 @@ class _SiteRule:
         if self.every and n % self.every == 0:
             return True, n
         if self.p and draw < self.p:
+            return True, n
+        if self.prob and self._hash_draw(n) < self.prob:
             return True, n
         return False, n
 
@@ -286,11 +377,11 @@ class FaultInjector:
         self.rules = {site: _SiteRule(site, spec, seed)
                       for site, spec in rules.items() if spec}
 
-    def fire(self, site: str) -> bool:
+    def fire(self, site: str, task_id: Optional[str] = None) -> bool:
         rule = self.rules.get(site)
         if rule is None:
             return False
-        fired, call_no = rule.decide()
+        fired, call_no = rule.decide(task_id=task_id)
         if fired:
             log.warning("FAULT INJECTED at %s (call #%d, spec %r)",
                         site, call_no, rule.spec)
@@ -321,6 +412,20 @@ class FaultInjector:
                         site, call_no, rule.spec)
             raise InjectedFault(site, call_no)
 
+    def check_partition(self, site: str, direction: str, peer: str) -> None:
+        """Directional ``check``: the wire layer reports which way the
+        frame is travelling (``c2s``/``s2c``) and over which labelled
+        wire; a rule's ``dir:``/``peer:`` filters scope the cut."""
+        rule = self.rules.get(site)
+        if rule is None:
+            return
+        fired, call_no = rule.decide(direction=direction, peer=peer)
+        if fired:
+            log.warning("FAULT INJECTED at %s (call #%d, dir %s, peer %s, "
+                        "spec %r)", site, call_no, direction, peer,
+                        rule.spec)
+            raise InjectedFault(site, call_no)
+
     def to_env_value(self) -> str:
         """Serialize for the TONY_FAULTS env passthrough."""
         parts = [f"seed={self.seed}"]
@@ -338,10 +443,12 @@ def active() -> Optional[FaultInjector]:
     return _active
 
 
-def fire(site: str) -> bool:
-    """Did the site fire? (bool-style sites: heartbeat skip)."""
+def fire(site: str, task_id: Optional[str] = None) -> bool:
+    """Did the site fire? (bool-style sites: heartbeat skip). In-process
+    multi-task callers pass ``task_id`` for the ``task:`` filter;
+    subprocess callers rely on the TONY_TASK_ID env identity."""
     inj = _active
-    return inj is not None and inj.fire(site)
+    return inj is not None and inj.fire(site, task_id=task_id)
 
 
 def fire_amount(site: str) -> Optional[float]:
@@ -356,6 +463,27 @@ def check(site: str) -> None:
     inj = _active
     if inj is not None:
         inj.check(site)
+
+
+def check_partition(site: str, direction: str, peer: str) -> None:
+    """Raise InjectedFault if the directional site fires for this
+    (direction, peer) — the asymmetric-partition hook (rpc.partition)."""
+    inj = _active
+    if inj is not None:
+        inj.check_partition(site, direction, peer)
+
+
+def env_seed(default: int = 0) -> int:
+    """The ambient injector seed: TONY_FAULT_SEED when set (chaos runs
+    export it), else ``default``."""
+    raw = os.environ.get(FAULT_SEED_ENV, "")
+    if not raw:
+        return default
+    try:
+        return int(raw)
+    except ValueError:
+        log.warning("ignoring non-integer %s=%r", FAULT_SEED_ENV, raw)
+        return default
 
 
 def install(injector: Optional[FaultInjector]) -> None:
@@ -375,10 +503,11 @@ def uninstall() -> None:
     install(None)
 
 
-def parse_spec(spec: str, default_seed: int = 0) -> "FaultInjector":
-    """Parse the serialized ``site=spec;site=spec;seed=N`` form."""
+def parse_spec(spec: str, default_seed: Optional[int] = None) -> "FaultInjector":
+    """Parse the serialized ``site=spec;site=spec;seed=N`` form. With no
+    explicit default, the seed falls back to TONY_FAULT_SEED then 0."""
     rules: Dict[str, str] = {}
-    seed = default_seed
+    seed = env_seed(0) if default_seed is None else default_seed
     for part in spec.split(";"):
         part = part.strip()
         if not part:
@@ -417,7 +546,8 @@ def install_from_conf(conf: Any) -> bool:
             rules[site] = spec
     if not rules:
         return False
-    install(FaultInjector(rules, seed=conf.get_int(K.FAULT_SEED, 0)))
+    install(FaultInjector(rules, seed=conf.get_int(K.FAULT_SEED,
+                                                   env_seed(0))))
     return True
 
 

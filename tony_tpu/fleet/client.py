@@ -52,7 +52,7 @@ class FleetClient:
                 token=addr.get("token") or None,
                 generation=int(addr.get("generation", 0) or 0),
                 max_retries=2, retry_sleep_s=0.2,
-                connect_timeout_s=3.0, call_timeout_s=30.0)
+                connect_timeout_s=3.0, call_timeout_s=30.0, peer="fleet")
         return self._rpc
 
     def call(self, method: str, **args: Any) -> Any:

@@ -63,7 +63,7 @@ from tony_tpu.fleet.policy import (GRANT, HOLD_ACTIONS, MIGRATE,
                                    JobRequest, PolicyEngine,
                                    parse_quotas)
 from tony_tpu.metrics import MetricsRegistry
-from tony_tpu.utils.durable import atomic_write
+from tony_tpu.utils.durable import DurableWriteError, atomic_write
 
 log = logging.getLogger(__name__)
 
@@ -223,7 +223,8 @@ class SubprocessJobRunner:
         return RpcClient(addr["host"], int(addr["port"]),
                          token=addr.get("token") or None,
                          max_retries=2, retry_sleep_s=0.2,
-                         connect_timeout_s=5.0, call_timeout_s=15.0)
+                         connect_timeout_s=5.0, call_timeout_s=15.0,
+                         peer="coordinator")
 
     def resize(self, job_workdir: str, size: int) -> bool:
         """Elastic resize (shrink = preempt-to-reclaim, grow =
@@ -517,6 +518,14 @@ class FleetDaemon:
                 log.info("fleet recover: adopted running job %s "
                          "(client pid %d, app %s)", fold.job_id,
                          fold.pid, app_id or "?")
+                # Simultaneous-crash window: the daemon can die BETWEEN
+                # a victim's resize RPC and the journal record of it
+                # (_apply_preempt lands the resize first, then the
+                # accounting). The victim kept draining while we were
+                # down — its own write-ahead journal knows the size the
+                # gang actually reached, and the books must agree with
+                # the gang, not with our torn decision.
+                self._reconcile_adopted_size(job, fold, app_id)
             elif app_id:
                 # The client is gone but the job got as far as an app
                 # dir: read its outcome from history (an unfinished
@@ -550,6 +559,86 @@ class FleetDaemon:
                 log.info("fleet recover: re-queued granted-but-never-"
                          "started job %s", fold.job_id)
 
+    def _victim_gang_size(self, job: "_FleetJob",
+                          app_id: Optional[str]) -> Optional[int]:
+        """The member count an adopted victim's gang ACTUALLY settled
+        at, replayed from the victim coordinator's own write-ahead
+        journal (its jobs/<app>/session.journal.jsonl). None = unknown
+        or still in flight — the caller must then keep the journaled
+        (conservative, never-double-grant) accounting."""
+        if not app_id:
+            return None
+        path = os.path.join(job.workdir, "jobs", app_id,
+                            constants.JOURNAL_FILE)
+        from tony_tpu.coordinator import journal as cjournal
+
+        try:
+            st = cjournal.replay(path)
+        except Exception:  # noqa: BLE001 — an unreadable victim journal
+            return None    # is an unknown, not a recovery failure
+        if st.inflight_job:
+            # A resize is STILL draining inside the victim: nothing has
+            # landed, so the journaled size is the truthful one for now;
+            # the ordinary preempt retry completes it (the resize RPC is
+            # idempotent about already-at-size).
+            return None
+        if not st.applied_members:
+            return None        # never resized: journaled size stands
+        members = next(iter(st.applied_members.values()))
+        return len(members)
+
+    def _reconcile_adopted_size(self, job: "_FleetJob",
+                                fold: fjournal.JobFold,
+                                app_id: Optional[str]) -> None:
+        """Complete (or supersede) a resize whose accounting the crash
+        window swallowed: the victim's actual gang size wins over the
+        journaled grant. A shrink that landed un-journaled frees the
+        reclaimed hosts NOW (the demander's grant was equally torn, so
+        nothing was double-booked); an un-journaled grow books the
+        extra hosts so the pool cannot grant them twice."""
+        actual = self._victim_gang_size(job, app_id)
+        if actual is None or actual == fold.hosts or actual <= 0:
+            return
+        job_id = fold.job_id
+        if actual < fold.hosts:
+            with self._lock:
+                placement = self.engine.shrink_applied(job_id, actual)
+                job.hosts = actual
+                job.placement = placement
+                job.host_events.append((int(time.time() * 1000), actual))
+            self.journal.preempt(job_id, fold.hosts, actual, "",
+                                 placement)
+            log.warning(
+                "fleet recover: %s gang is at %d host(s) but the journal "
+                "granted %d — completing the in-flight shrink the crash "
+                "window hid (reclaimed hosts freed, preempt journaled)",
+                job_id, actual, fold.hosts)
+        else:
+            with self._lock:
+                delta = self.engine.pool.place(actual - fold.hosts)
+                if delta is None:
+                    # The pool cannot cover the difference — the books
+                    # are over-subscribed either way; keep the journaled
+                    # size and say so loudly rather than corrupt the
+                    # accounting.
+                    log.error(
+                        "fleet recover: %s gang is at %d host(s) but "
+                        "only %d are journaled and the pool cannot "
+                        "cover the difference — accounting left at the "
+                        "journaled size", job_id, actual, fold.hosts)
+                    return
+                placement = self.engine.grow_applied(job_id, delta)
+                job.hosts = actual
+                job.placement = placement
+                job.host_events.append((int(time.time() * 1000), actual))
+            self.journal.state(job_id, fjournal.STATE_RESTORED,
+                               hosts=actual, placement=placement)
+            log.warning(
+                "fleet recover: %s gang is at %d host(s) but the journal "
+                "granted %d — booking the un-journaled grow so the pool "
+                "cannot double-grant those hosts", job_id, actual,
+                fold.hosts)
+
     # -- lifecycle --------------------------------------------------------
     def start(self) -> None:
         self._started = True
@@ -567,15 +656,45 @@ class FleetDaemon:
 
     def run(self) -> int:
         self.start()
+        rc = 0
         try:
             while not self._stop_evt.wait(self.tick_s):
+                if self.journal.dead is not None:
+                    # A submit/RPC handler hit the dead disk first.
+                    log.critical(
+                        "fleet journal is DEAD (%s) — stopping the "
+                        "daemon; restart with `fleet start --recover` "
+                        "once the disk is healthy", self.journal.dead)
+                    rc = 1
+                    break
                 try:
                     self.tick()
+                except DurableWriteError as e:
+                    # The write-ahead journal died (ENOSPC/EIO): STOP.
+                    # A daemon that keeps scheduling against a journal
+                    # that cannot write ahead makes decisions `--recover`
+                    # can never see — worse than being down. Running jobs
+                    # are left alone (tenant-owned session leaders); the
+                    # committed journal prefix stays replayable.
+                    log.critical(
+                        "fleet journal is DEAD (%s) — stopping the "
+                        "daemon; restart with `fleet start --recover` "
+                        "once the disk is healthy", e)
+                    rc = 1
+                    break
                 except Exception:  # noqa: BLE001 — the daemon must live
+                    if self.journal.dead is not None:
+                        log.critical(
+                            "fleet journal is DEAD (%s) — stopping the "
+                            "daemon; restart with `fleet start "
+                            "--recover` once the disk is healthy",
+                            self.journal.dead)
+                        rc = 1
+                        break
                     log.exception("fleet tick failed")
         finally:
             self._shutdown()
-        return 0
+        return rc
 
     def request_stop(self) -> None:
         self._stop_evt.set()
@@ -621,6 +740,15 @@ class FleetDaemon:
     def submit(self, tenant: str, hosts: int, priority: int = 0,
                min_hosts: int = 0, model: str = "",
                conf: Optional[Dict[str, str]] = None) -> dict:
+        if self.journal.dead is not None:
+            # Sticky-dead journal: appends silently no-op from here on,
+            # so an ack would promise crash-survival the write-ahead
+            # log can no longer give. Refuse until --recover.
+            return {"ok": False,
+                    "message": f"fleet journal is dead "
+                               f"({self.journal.dead}); the daemon is "
+                               f"stopping — resubmit after `fleet "
+                               f"start --recover`"}
         if not tenant:
             return {"ok": False, "message": "submission needs a tenant"}
         if hosts <= 0 or hosts > self.engine.pool.total:
@@ -645,9 +773,18 @@ class FleetDaemon:
         req = JobRequest(job_id, tenant, priority=priority, hosts=hosts,
                          min_hosts=min_hosts, model=model, seq=seq)
         # Write-ahead of the ack: a submission the caller saw accepted
-        # must survive a daemon crash into the recovered queue.
-        self.journal.submit(job_id, tenant, priority, hosts, min_hosts,
-                            model, seq, conf)
+        # must survive a daemon crash into the recovered queue — so a
+        # submission that CANNOT be journaled must be refused, never
+        # acked on the side of a dead journal (chaos schedule
+        # disk.full x submit: the RPC verb must answer, not traceback).
+        try:
+            self.journal.submit(job_id, tenant, priority, hosts,
+                                min_hosts, model, seq, conf)
+        except DurableWriteError as e:
+            return {"ok": False,
+                    "message": f"fleet journal is dead ({e}); the "
+                               f"daemon is stopping — resubmit after "
+                               f"`fleet start --recover`"}
         job = _FleetJob(req, conf,
                         os.path.join(self.fleet_dir, "jobs", job_id),
                         decision_ring=self.decision_ring)
@@ -667,6 +804,11 @@ class FleetDaemon:
         return {"ok": True, "job": job_id, "state": QUEUED}
 
     def cancel(self, job_id: str) -> dict:
+        if self.journal.dead is not None:
+            return {"ok": False,
+                    "message": f"fleet journal is dead "
+                               f"({self.journal.dead}); restart with "
+                               f"`fleet start --recover`"}
         with self._lock:
             job = self.jobs.get(job_id)
             if job is None:
@@ -679,7 +821,15 @@ class FleetDaemon:
             if was_queued:
                 self.engine.withdraw(job_id)
         if was_queued:
-            self._finish_job(job_id, fjournal.STATE_CANCELLED, None)
+            try:
+                self._finish_job(job_id, fjournal.STATE_CANCELLED, None)
+            except DurableWriteError as e:
+                # Same contract as submit: an RPC verb answers, the
+                # daemon's run loop does the dying.
+                return {"ok": False,
+                        "message": f"fleet journal is dead ({e}); "
+                                   f"restart with `fleet start "
+                                   f"--recover`"}
             return {"ok": True, "state": fjournal.STATE_CANCELLED}
         # Running: ask its coordinator to die; the poll loop records the
         # exit as CANCELLED (job.cancelled wins over the exit code).
@@ -1150,6 +1300,11 @@ class FleetDaemon:
         """`tony-tpu fleet migrate <job> <slice>`: operator-initiated
         live move (defrag by hand, pre-maintenance evacuation)."""
         t = int(target)
+        if self.journal.dead is not None:
+            return {"ok": False,
+                    "message": f"fleet journal is dead "
+                               f"({self.journal.dead}); restart with "
+                               f"`fleet start --recover`"}
         with self._lock:
             job = self.jobs.get(job_id)
             if job is None:
@@ -1179,7 +1334,13 @@ class FleetDaemon:
                          placement={t: job.hosts},
                          source=min(job.placement), target=t,
                          reason=f"operator migrate to slice {t}")
-        if not self._apply_migrate(d):
+        try:
+            applied = self._apply_migrate(d)
+        except DurableWriteError as e:
+            return {"ok": False,
+                    "message": f"fleet journal is dead ({e}); restart "
+                               f"with `fleet start --recover`"}
+        if not applied:
             return {"ok": False,
                     "message": "the job's coordinator refused the move "
                                "or is unreachable — see the daemon log"}

@@ -28,7 +28,7 @@ import os
 import time
 from typing import Any, Dict, List, Optional, Tuple
 
-from tony_tpu.utils.durable import AppendLog
+from tony_tpu.utils.durable import AppendLog, DurableWriteError
 
 log = logging.getLogger(__name__)
 
@@ -131,16 +131,33 @@ class FleetJournal:
 
         self.path = path
         self.enabled = enabled
+        #: first durable-write failure, sticky (ENOSPC/EIO). The first
+        #: failing append raises; later appends no-op — the daemon must
+        #: STOP scheduling against a journal that can no longer write
+        #: ahead (daemon.run checks this), and the committed prefix on
+        #: disk stays replayable for `fleet start --recover`.
+        self.dead: Optional[DurableWriteError] = None
         self._log: Optional[AppendLog] = AppendLog(path) if enabled else None
         self._lock = io_lock()
 
     def append(self, record: Dict[str, Any]) -> None:
         if self._log is None:
             return
+        if self.dead is not None:
+            return
         record.setdefault("ts", int(time.time() * 1000))
         data = (json.dumps(record, sort_keys=True) + "\n").encode("utf-8")
         with self._lock:
-            self._log.append(data)
+            try:
+                self._log.append(data)
+            except DurableWriteError as e:
+                self.dead = e
+                log.critical(
+                    "fleet journal %s is DEAD (%s): the daemon must stop "
+                    "— scheduling decisions it cannot write ahead would "
+                    "be lost to recovery; the committed prefix remains "
+                    "replayable", self.path, e)
+                raise
 
     # -- typed appenders --------------------------------------------------
     def generation(self, generation: int, slices: int,

@@ -514,7 +514,8 @@ class PoolClient:
                                   token=addr.get("token") or None,
                                   max_retries=1, retry_sleep_s=0.1,
                                   connect_timeout_s=2.0,
-                                  call_timeout_s=10.0)
+                                  call_timeout_s=10.0,
+                                  peer="pool")
         return self._rpc
 
     def call(self, method: str, **args):

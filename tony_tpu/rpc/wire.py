@@ -422,9 +422,14 @@ class RpcClient:
                  tls: Optional[ssl.SSLContext] = None,
                  generation: int = 0,
                  call_timeout_s: Optional[float] = None,
-                 on_latency: Optional[Any] = None) -> None:
+                 on_latency: Optional[Any] = None,
+                 peer: str = "") -> None:
         self._addr = (host, port)
         self._token = token or None     # "" = unauthenticated, like None
+        # Wire label for directional fault scoping (rpc.partition
+        # peer:NAME): which service this client dials — "coordinator",
+        # "pool", "fleet". Purely observational; "" = unlabelled.
+        self._peer = peer
         self._tls = tls
         # (trace_id, span_id) stamped into every request ("tc") when set —
         # the caller's edge of the cross-process span tree.
@@ -571,6 +576,10 @@ class RpcClient:
                     # rides the same reconnect+backoff path a real reset
                     # takes (tony_tpu/faults.py site table).
                     faults.check("rpc.send")
+                    # Asymmetric partition, request direction: the frame
+                    # dies BEFORE the send — the callee never sees it.
+                    faults.check_partition("rpc.partition", "c2s",
+                                           self._peer)
                     t_call = time.monotonic()
                     self._id += 1
                     req = {"id": self._id, "method": method, "args": args}
@@ -583,6 +592,14 @@ class RpcClient:
                     _send_signed(self._sock, req, self._token, self._nonce,
                                  _TO_SERVER, extra=extra)
                     self._hello_pending = False
+                    # Asymmetric partition, response direction: the
+                    # request was DELIVERED — the callee processes it and
+                    # its side effects land — but the response never
+                    # comes back. The caller sees a reset and retries,
+                    # so non-idempotent handlers rehearse the
+                    # duplicate-delivery shape a real one-way cut causes.
+                    faults.check_partition("rpc.partition", "s2c",
+                                           self._peer)
                     # Response MAC proves the SERVER holds the secret too
                     # (mutual auth); a mismatch raises AuthError and is
                     # not retried.

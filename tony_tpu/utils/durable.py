@@ -23,11 +23,31 @@ power cut" bugs are born.
 
 from __future__ import annotations
 
+import errno
 import logging
 import os
 from typing import IO, Optional
 
+from tony_tpu import faults
+
 log = logging.getLogger(__name__)
+
+
+class DurableWriteError(OSError):
+    """A durable write (fsync'd append / atomic replace) FAILED — the
+    bytes may not be on disk. ENOSPC/EIO on the write-ahead path must
+    surface loudly (terminal INFRA verdict, daemon stop): proceeding as
+    if the record landed is how recovery later resurrects state the
+    rest of the cluster already saw retired. The committed prefix on
+    disk stays intact — ``--recover`` replays it (readers tolerate a
+    torn final record)."""
+
+    def __init__(self, path: str, op: str, cause: BaseException) -> None:
+        eno = cause.errno if isinstance(cause, OSError) and cause.errno \
+            else errno.EIO
+        super().__init__(eno, f"durable {op} failed for {path}: {cause}")
+        self.path = path
+        self.op = op
 
 
 def fsync_dir(path: str) -> None:
@@ -63,6 +83,11 @@ def atomic_write(path: str, data: bytes, mode: int = 0o644) -> None:
             f.write(data)
             f.flush()
             os.fsync(f.fileno())
+        if faults.fire("disk.torn"):
+            # The injected power-cut-at-rename shape: the temp file was
+            # durable but the RENAME never landed — a reader still sees
+            # the OLD bytes, and the caller must hear about it.
+            raise OSError(errno.EIO, "injected torn rename (disk.torn)")
         os.replace(tmp, path)
     except BaseException:
         try:
@@ -128,10 +153,25 @@ class AppendLog:
             fsync_dir(d)
 
     def append(self, record: bytes) -> None:
+        """Append + flush + fsync, STRICT: any failure raises
+        DurableWriteError instead of pretending the record landed.
+        A torn append (partial write, then the failure) is exactly the
+        shape the journal readers already absorb — replay-of-prefix —
+        so the committed records before it stay recoverable."""
         if self._f is None:
             raise ValueError(f"append log {self.path} is closed")
-        self._f.write(record)
-        fsync_file(self._f)
+        try:
+            faults.check("disk.full")
+            if faults.fire("disk.torn"):
+                self._f.write(record[:max(1, len(record) // 2)])
+                self._f.flush()
+                raise OSError(errno.EIO,
+                              "injected torn append (disk.torn)")
+            self._f.write(record)
+            self._f.flush()
+            os.fsync(self._f.fileno())
+        except OSError as e:
+            raise DurableWriteError(self.path, "append", e) from e
 
     def close(self) -> None:
         if self._f is not None:
