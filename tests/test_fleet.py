@@ -724,7 +724,9 @@ def test_fleet_fixture_golden_passes_and_bad_fails():
     rules = {v.rule for v in bad.violations}
     assert rules == {"fleet-gen-monotonic", "fleet-unknown-job",
                      "fleet-double-grant", "fleet-terminal",
-                     "fleet-capacity", "fleet-decision"}
+                     "fleet-capacity", "fleet-decision",
+                     "health-quarantine-evidence",
+                     "health-dangling-cordon"}
 
 
 def test_daemon_lifecycle_artifacts_pass_invariants(tmp_path):

@@ -307,6 +307,7 @@ def test_verdict_fleet_healthy_carries_goodput_evidence():
 
 def test_rule_engine_categories_cover_the_contract():
     assert set(fdiagnose.CATEGORY_PRECEDENCE) == {
+        "SICK_SLICE", "FLAKY_HOST",
         "STARVATION", "QUOTA_SATURATED", "FRAGMENTATION",
         "PREEMPT_STORM", "POOL_COLD", "FLEET_HEALTHY"}
 

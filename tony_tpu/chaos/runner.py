@@ -1,6 +1,6 @@
 """Execute chaos schedules against the in-process control plane.
 
-Three suites, all subprocess-free so a 200-schedule sweep fits in
+Four suites, all subprocess-free so a 200-schedule sweep fits in
 minutes, and all REAL control-plane code paths — real RPC frames over
 real TCP, real write-ahead journals on real disk, real policy engine:
 
@@ -15,6 +15,12 @@ real TCP, real write-ahead journals on real disk, real policy engine:
     One :class:`FleetDaemon` over an in-process fake job runner: a
     seeded multi-tenant workload (submits, completions) ticks through
     grant/preempt storms, slice reclaims and journal disk faults.
+``health``
+    The fleet substrate with ONE seeded flaky host (``host.flaky``
+    pinned by name) plus probe/journal noise: the ladder demands the
+    failure-attribution ledger quarantines the host, every later grant
+    routes around it (journal-proven), fresh jobs still drain, and no
+    USER_ERROR ever enters the evidence ledger.
 
 The runner OWNS the global fault injector for the run's duration
 (install before, uninstall in finally) and climbs the oracle ladder
@@ -31,7 +37,7 @@ import threading
 import time
 from typing import Optional
 
-from tony_tpu import faults
+from tony_tpu import constants, faults
 from tony_tpu.chaos import oracle
 from tony_tpu.chaos.oracle import Outcome, Violation
 from tony_tpu.chaos.schedule import Schedule, fault_seed
@@ -272,6 +278,176 @@ def _run_fleet_suite(schedule: Schedule, workdir: str) -> Outcome:
 
 
 # ---------------------------------------------------------------------------
+# health: daemon over an in-process runner with one seeded flaky host
+# ---------------------------------------------------------------------------
+def _flaky_host_of(schedule: Schedule) -> str:
+    for inj in schedule.injections:
+        if inj.site == "host.flaky":
+            for part in inj.spec.split(","):
+                if part.startswith("task:"):
+                    return part[len("task:"):]
+    return ""
+
+
+def _run_health_suite(schedule: Schedule, workdir: str) -> Outcome:
+    """The flaky-host drill: the schedule pins ``host.flaky`` to one
+    host; the ladder demands (a) the ledger quarantines that host, (b)
+    every grant journaled after the cordon routes around it, (c) jobs
+    submitted after the cordon still drain, and (d) no USER_ERROR ever
+    enters the evidence ledger — an infra-only storm must never be
+    pinned on the user."""
+    import random
+
+    from tony_tpu.fleet import health as fhealth
+    from tony_tpu.fleet import journal as fjournal
+    from tony_tpu.fleet.daemon import GRANTED, QUEUED, RUNNING, \
+        FleetDaemon
+    from tony_tpu.utils.durable import DurableWriteError
+
+    outcome = Outcome()
+    flaky = _flaky_host_of(schedule)
+    fleet_dir = os.path.join(workdir, "fleet")
+    runner = _ChaosRunner()
+    # Tight thresholds so the drill converges inside the tick budget:
+    # two attributed kills quarantine the host; the long half-life and
+    # cooldown keep the cordon from decaying or re-admitting mid-run.
+    hcfg = fhealth.HealthConfig(half_life_s=3600.0,
+                                suspect_threshold=1.0,
+                                quarantine_threshold=2.0,
+                                quarantine_s=3600.0)
+    daemon = FleetDaemon(fleet_dir, slices=2, hosts_per_slice=4,
+                         quotas="", runner=runner, tick_s=0.05,
+                         health_conf=hcfg)
+    rng = random.Random(
+        f"workload:{fault_seed(schedule.seed, schedule.index)}")
+    # Saturating workload: until the cordon lands, keep enough
+    # shrink-to-fit 2-host gangs in flight that EVERY free host (the
+    # flaky one included) hosts work each round — attribution becomes
+    # a matter of ticks, not placement luck. Small gangs on purpose: a
+    # 4-host gang cannot pack once each slice carries a cordon, and
+    # the policy's head-of-line hold would then (correctly, but
+    # uninterestingly for THIS drill) wedge the queue behind it.
+    journal_dead = False
+
+    def _tick() -> bool:
+        """One daemon tick; False when the journal died."""
+        nonlocal journal_dead
+        if daemon.journal.dead is not None:
+            journal_dead = True
+            return False
+        try:
+            daemon.tick()
+        except DurableWriteError:
+            journal_dead = True
+            return False
+        except Exception as e:  # noqa: BLE001 — run() survives these
+            if daemon.journal.dead is not None:
+                journal_dead = True
+                return False
+            log.info("chaos health tick error (absorbed): %s", e)
+        return True
+
+    def _complete_some(p: float) -> None:
+        with daemon._lock:
+            running = [j for j in daemon.jobs.values()
+                       if j.state == RUNNING]
+        if running and rng.random() < p:
+            victim = rng.choice(running)
+            hnd = runner.handles.get(victim.req.job_id)
+            if hnd is not None and hnd.exit is None:
+                hnd.exit = 0
+
+    def _cordoned() -> bool:
+        with daemon._lock:
+            h = daemon.book.hosts.get(flaky)
+            return h is not None and h.state in (
+                fhealth.QUARANTINED, fhealth.PROBATION)
+
+    try:
+        # Phase 1: saturate until the ledger cordons the flaky host.
+        for _ in range(80):
+            if _cordoned():
+                break
+            with daemon._lock:
+                alive = sum(1 for j in daemon.jobs.values()
+                            if j.state in (QUEUED, GRANTED, RUNNING))
+            while alive < 6:
+                daemon.submit("tenant-" + str(rng.randint(0, 2)), 2,
+                              priority=rng.randint(0, 1), min_hosts=1,
+                              conf={})
+                alive += 1
+            if not _tick():
+                break
+            _complete_some(0.3)
+        # Phase 2: the drain probe — fresh work submitted AFTER the
+        # cordon must still grant, minus the bad host. Top priority so
+        # it outranks whatever phase 1 left queued.
+        if not journal_dead and _cordoned():
+            daemon.submit("tenant-drain", 2, priority=3, min_hosts=1,
+                          conf={})
+            daemon.submit("tenant-drain", 2, priority=3, min_hosts=1,
+                          conf={})
+            for _ in range(40):
+                if not _tick():
+                    break
+                _complete_some(0.5)
+    finally:
+        try:
+            daemon._shutdown()
+        except Exception:  # noqa: BLE001
+            pass
+
+    if journal_dead:
+        outcome.status = "FAILED"
+        outcome.failure_domain = "INFRA_TRANSIENT"
+        outcome.detail = f"fleet journal dead: {daemon.journal.dead}"
+        return outcome
+    outcome.status = "SUCCEEDED"
+
+    # Journal-proven ladder: fold the record stream in order.
+    from tony_tpu.devtools.invariants import _iter_journal_records
+    recs, _ = _iter_journal_records(
+        os.path.join(fleet_dir, constants.FLEET_JOURNAL_FILE))
+    cordon_at = None        # record index of the first flaky quarantine
+    grants_after = 0
+    for idx, rec in recs:
+        t = rec.get("t")
+        if t == fjournal.REC_FLEET_HEALTH:
+            for ev in rec.get("evidence") or []:
+                if ev.get("kind") == "USER_ERROR":
+                    outcome.violations.append(Violation(
+                        "verdict",
+                        f"record {idx}: USER_ERROR entered the health "
+                        f"evidence ledger for {rec.get('host')} — user "
+                        f"bugs must never cordon hardware"))
+            if rec.get("host") == flaky \
+                    and rec.get("state") == fhealth.QUARANTINED \
+                    and cordon_at is None:
+                cordon_at = idx
+        elif t == fjournal.REC_FLEET_GRANT and cordon_at is not None:
+            grants_after += 1
+            if flaky in (rec.get("host_ids") or []):
+                outcome.violations.append(Violation(
+                    "verdict",
+                    f"record {idx}: grant of {rec.get('job')} placed "
+                    f"on {flaky} AFTER its quarantine at record "
+                    f"{cordon_at} — placements must route around a "
+                    f"cordoned host"))
+    if cordon_at is None:
+        outcome.violations.append(Violation(
+            "verdict",
+            f"seeded flaky host {flaky} was never quarantined — the "
+            f"failure-attribution ledger missed the drill's storm"))
+    elif grants_after == 0:
+        outcome.violations.append(Violation(
+            "verdict",
+            f"no grant landed after {flaky}'s quarantine at record "
+            f"{cordon_at} — the fleet wedged instead of draining "
+            f"around the bad host"))
+    return outcome
+
+
+# ---------------------------------------------------------------------------
 # entry point
 # ---------------------------------------------------------------------------
 def run_schedule(schedule: Schedule, workdir: str) -> Outcome:
@@ -286,6 +462,8 @@ def run_schedule(schedule: Schedule, workdir: str) -> Outcome:
                 schedule, workdir, migrate=(schedule.suite == "migrate"))
         elif schedule.suite == "fleet":
             outcome = _run_fleet_suite(schedule, workdir)
+        elif schedule.suite == "health":
+            outcome = _run_health_suite(schedule, workdir)
         else:
             raise ValueError(f"unknown chaos suite {schedule.suite!r}")
     finally:

@@ -26,7 +26,7 @@ from typing import Callable, Dict, List, Tuple
 
 from tony_tpu import faults
 
-SUITES = ("e2e", "migrate", "fleet")
+SUITES = ("e2e", "migrate", "fleet", "health")
 
 
 @dataclass(frozen=True)
@@ -119,6 +119,21 @@ def _spec_slow(rng: random.Random) -> str:
     return f"at:{rng.randint(1, 6)},amt:{rng.choice(('0.1', '0.25'))}"
 
 
+def _spec_flaky(rng: random.Random) -> str:
+    # Exactly ONE flaky host per schedule, pinned by name (the daemon
+    # fires the site with task_id=<host>): the drill's whole point is
+    # that the ledger finds and cordons THIS host.
+    host = f"s{rng.randint(0, 1)}h{rng.randint(0, 3)}"
+    return f"task:{host},prob:{rng.choice(('0.8', '1.0'))}"
+
+
+def _spec_probe(rng: random.Random) -> str:
+    # Pinned per host like host.flaky; first:N so the host fails its
+    # preflight and the grant must self-repair with a spare.
+    host = f"s{rng.randint(0, 1)}h{rng.randint(0, 3)}"
+    return f"task:{host},first:{rng.randint(1, 2)}"
+
+
 _Menu = List[Tuple[str, int, Callable[[random.Random], str]]]
 
 #: e2e: a virtual gang runs to self-finish under transport + disk +
@@ -155,10 +170,21 @@ _FLEET_MENU: _Menu = [
     ("disk.torn", 2, _spec_disk),
 ]
 
+#: health: noise AROUND the mandatory flaky host (plan() pins one
+#: host.flaky injection unconditionally for this suite) — probe
+#: failures force grant self-repair, journal faults stress the
+#: write-ahead cordon records.
+_HEALTH_MENU: _Menu = [
+    ("health.probe", 3, _spec_probe),
+    ("fleet.grant", 2, _spec_first),
+    ("disk.torn", 1, _spec_disk),
+]
+
 _MENUS: Dict[str, _Menu] = {
     "e2e": _E2E_MENU,
     "migrate": _MIGRATE_MENU,
     "fleet": _FLEET_MENU,
+    "health": _HEALTH_MENU,
 }
 
 
@@ -175,6 +201,11 @@ def plan(seed: int, index: int, suite: str) -> Schedule:
     n = rng.randint(1, 4)
     sites: List[str] = []
     injections: List[Injection] = []
+    if suite == "health":
+        # The suite's contract: every health schedule seeds exactly one
+        # flaky host; the menu draws below only add noise around it.
+        sites.append("host.flaky")
+        injections.append(Injection("host.flaky", _spec_flaky(rng)))
     weights = [w for _, w, _ in menu]
     for _ in range(n):
         site, _, spec_fn = rng.choices(menu, weights=weights, k=1)[0]

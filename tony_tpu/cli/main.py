@@ -1281,6 +1281,13 @@ def _render_fleet_top(snap: dict) -> str:
             + (f"  preempt-lost: "
                f"{fleet_led.get('lost_preempted_chip_s', 0)} chip-s"
                if fleet_led.get("lost_preempted_chip_s") else ""))
+    health = snap.get("health") or {}
+    if health.get("cordoned") or health.get("sick_slices"):
+        lines.append(
+            "health: cordoned "
+            + (", ".join(health["cordoned"]) or "-")
+            + (f"  sick slices: {health['sick_slices']}"
+               if health.get("sick_slices") else ""))
     tenants = snap.get("tenants") or {}
     if tenants:
         def _tenant_cell(t, row):
@@ -1355,7 +1362,27 @@ def _cmd_fleet(args: argparse.Namespace) -> int:
                "--dir", fleet_dir, "--slices", str(slices),
                "--hosts-per-slice", str(hps), "--tick-s", str(tick_s),
                "--decision-ring", str(ring),
-               "--ledger-interval-s", str(ledger_s)]
+               "--ledger-interval-s", str(ledger_s),
+               "--health-enabled",
+               str(int(conf.get_bool(K.HEALTH_ENABLED, True))),
+               "--health-half-life-s",
+               str(float(conf.get(K.HEALTH_HALF_LIFE_S, 300.0) or 300.0)),
+               "--health-suspect-threshold",
+               str(float(conf.get(K.HEALTH_SUSPECT_THRESHOLD, 1.0)
+                         or 1.0)),
+               "--health-quarantine-threshold",
+               str(float(conf.get(K.HEALTH_QUARANTINE_THRESHOLD, 3.0)
+                         or 3.0)),
+               "--health-quarantine-s",
+               str(float(conf.get(K.HEALTH_QUARANTINE_S, 120.0)
+                         or 120.0)),
+               "--health-probation-priority",
+               str(conf.get_int(K.HEALTH_PROBATION_PRIORITY, 0)),
+               "--health-blast-n",
+               str(conf.get_int(K.HEALTH_BLAST_N, 2)),
+               "--health-blast-window-s",
+               str(float(conf.get(K.HEALTH_BLAST_WINDOW_S, 120.0)
+                         or 120.0))]
         if quotas:
             cmd += ["--quotas", quotas]
         if pool_dir:
@@ -1471,6 +1498,49 @@ def _cmd_fleet(args: argparse.Namespace) -> int:
                   f"{res.get('target')} (placement {res.get('placement')})")
             print(f"watch it land with `tony-tpu fleet status` or the "
                   f"job's own `tony-tpu events` stream (GANG_MIGRATED)")
+            return 0
+        if args.fleet_cmd == "cordon":
+            res = client.cordon(args.host, reason=args.reason)
+            if not res.get("ok"):
+                print(f"cordon refused: {res.get('message', '?')}",
+                      file=sys.stderr)
+                return 1
+            print(f"{args.host}: {res.get('state', '?')}"
+                  + ("" if res.get("was_free")
+                     else " (leased — placements stop now, the slot "
+                          "leaves the pool when its job releases)"))
+            return 0
+        if args.fleet_cmd == "uncordon":
+            res = client.uncordon(args.host)
+            if not res.get("ok"):
+                print(f"uncordon refused: {res.get('message', '?')}",
+                      file=sys.stderr)
+                return 1
+            print(f"{args.host}: {res.get('state', '?')}")
+            return 0
+        if args.fleet_cmd == "health":
+            res = client.health()
+            if not res.get("ok"):
+                print(f"health refused: {res.get('message', '?')}",
+                      file=sys.stderr)
+                return 1
+            if args.json:
+                print(json.dumps(res, indent=1, sort_keys=True))
+                return 0
+            if not res.get("enabled"):
+                print("host health: DISABLED (tony.health.enabled)")
+                return 0
+            print("cordoned: "
+                  + (", ".join(res.get("cordoned") or []) or "-"))
+            if res.get("sick_slices"):
+                print(f"sick slices: {res['sick_slices']}")
+            for row in res.get("hosts", []):
+                ev = "; ".join(
+                    str(e.get("kind", "?"))
+                    + (f" in {e['job']}" if e.get("job") else "")
+                    for e in row.get("evidence", []))
+                print(f"  {row.get('host'):<8} {row.get('state'):<12} "
+                      f"score {row.get('score', 0):<6} {ev}")
             return 0
         if args.fleet_cmd == "submit":
             # Ship only the EXPLICIT conf entries: registry defaults
@@ -1875,6 +1945,40 @@ def build_parser() -> argparse.ArgumentParser:
     fd.add_argument("--conf-file")
     fd.add_argument("--conf", action="append", metavar="K=V")
     fd.set_defaults(fn=_cmd_fleet)
+    fco = fl_sub.add_parser(
+        "cordon",
+        help="pull one pool host out of placement by hand "
+             "(pre-maintenance, suspected hardware); manual cordons "
+             "never auto-expire — close with uncordon "
+             "(docs/operations.md 'Host health')")
+    fco.add_argument("host", help="pool host id, e.g. s0h3")
+    fco.add_argument("--reason", default="", help="recorded in the "
+                     "health journal and `fleet health` evidence")
+    fco.add_argument("--dir")
+    fco.add_argument("--workdir")
+    fco.add_argument("--conf-file")
+    fco.add_argument("--conf", action="append", metavar="K=V")
+    fco.set_defaults(fn=_cmd_fleet)
+    fun = fl_sub.add_parser(
+        "uncordon", help="return a cordoned host to the placement pool")
+    fun.add_argument("host")
+    fun.add_argument("--dir")
+    fun.add_argument("--workdir")
+    fun.add_argument("--conf-file")
+    fun.add_argument("--conf", action="append", metavar="K=V")
+    fun.set_defaults(fn=_cmd_fleet)
+    fh = fl_sub.add_parser(
+        "health",
+        help="the host-health ledger: per-host state/score/evidence, "
+             "the current cordon set and any sick slices "
+             "(tony.health.* keys)")
+    fh.add_argument("--dir")
+    fh.add_argument("--workdir")
+    fh.add_argument("--json", action="store_true",
+                    help="print the raw ledger document")
+    fh.add_argument("--conf-file")
+    fh.add_argument("--conf", action="append", metavar="K=V")
+    fh.set_defaults(fn=_cmd_fleet)
 
     ln = sub.add_parser(
         "lint",
@@ -1926,10 +2030,11 @@ def build_parser() -> argparse.ArgumentParser:
                          "same per-call fault decisions (default 0)")
     cr.add_argument("--schedules", type=int, default=20,
                     help="how many schedules to plan and run")
-    cr.add_argument("--suite", choices=["e2e", "fleet", "migrate"],
+    cr.add_argument("--suite",
+                    choices=["e2e", "fleet", "migrate", "health"],
                     default=None,
                     help="restrict to one suite (default: round-robin "
-                         "across all three)")
+                         "across all of them)")
     cr.add_argument("--out", default="chaos-artifacts",
                     help="artifact directory (one JSON per schedule)")
     cr.add_argument("--fail-fast", action="store_true",

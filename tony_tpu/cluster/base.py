@@ -38,6 +38,11 @@ class TaskLaunchSpec:
     chips: int = 0
     node_pool: str = ""
     docker_image: str = ""
+    # Hosts this task must NOT land on (health exclude-on-retry: the
+    # coordinator threads the hosts that already failed this task so a
+    # relaunch never re-rolls the same bad hardware). Best-effort — a
+    # backend with no alternative host may still use one.
+    exclude_hosts: Tuple[str, ...] = ()
 
 
 def container_name(spec: TaskLaunchSpec) -> str:
@@ -139,6 +144,13 @@ class Backend(abc.ABC):
         code alone can't distinguish that from an OOM kill. None = no
         backend knowledge; the coordinator classifies from the exit code
         (coordinator/session.py classify_exit)."""
+        return None
+
+    def host_of(self, task_id: str) -> Optional[str]:
+        """Which physical host a launched task runs on, if the backend
+        places tasks on distinguishable hosts (slice VMs). None = no
+        host identity (local processes) — the health exclude-on-retry
+        path and fleet failure attribution both no-op then."""
         return None
 
     def gang_active(self) -> bool:

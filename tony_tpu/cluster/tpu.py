@@ -60,6 +60,7 @@ from typing import Dict, List, Optional, Sequence, Tuple
 
 from tony_tpu.devtools import sanitizer
 from tony_tpu.utils import durable
+from tony_tpu import constants
 from tony_tpu.cluster.base import (Backend, TaskLaunchSpec,
                                    build_executor_argv)
 
@@ -690,7 +691,19 @@ class TpuSliceBackend(Backend):
                                  python=self.python)
         lease = self._ensure_lease()
         with self._lock:
+            # Round-robin, skipping hosts the coordinator excluded
+            # (exclude-on-retry: this task already failed there). Only
+            # best-effort — with every lease host excluded the plain
+            # rotation wins; a relaunch beats no launch.
             host = lease.hosts[self._next_host % len(lease.hosts)]
+            if spec.exclude_hosts and len(lease.hosts) > 1:
+                excluded = set(spec.exclude_hosts)
+                if not excluded.issuperset(
+                        h.host_id for h in lease.hosts):
+                    while host.host_id in excluded:
+                        self._next_host += 1
+                        host = lease.hosts[
+                            self._next_host % len(lease.hosts)]
             self._next_host += 1
             local_ordinal = self._host_tasks.get(host.host_id, 0)
             self._host_tasks[host.host_id] = local_ordinal + 1
@@ -706,7 +719,7 @@ class TpuSliceBackend(Backend):
                  local_ordinal: int, python: str,
                  lease: Optional[SliceLease] = None) -> "_SliceTask":
         env = dict(spec.env)
-        env["TONY_HOST_ID"] = host.host_id
+        env[constants.HOST_ID_ENV] = host.host_id
         env["TONY_HOST_LOCAL_ORDINAL"] = str(local_ordinal)
         if lease is not None:
             # libtpu multi-host topology (see module docstring); job env
@@ -730,6 +743,11 @@ class TpuSliceBackend(Backend):
         self._last_launch = time.monotonic()
         log.info("launched %s on %s", spec.task_id, host.host_id)
         return st
+
+    def host_of(self, task_id: str) -> Optional[str]:
+        with self._lock:
+            st = self._tasks.get(task_id)
+        return st.host.host_id if st is not None else None
 
     def kill_task(self, handle: object, grace_s: float = 0.0) -> None:
         if isinstance(handle, _SliceTask):

@@ -811,6 +811,19 @@ FAULT_DISK_TORN = _key(
     "writes a partial record then fails EIO, and atomic_write drops "
     "the rename (the old bytes survive) — the power-cut-mid-write "
     "shape the replay-of-prefix readers must absorb.")
+FAULT_HOST_FLAKY = _key(
+    "tony.fault.host-flaky", "", str,
+    "Make one pool host flaky (fleet daemon health tick): each firing "
+    "attributes an INFRA_TRANSIENT failure to the host and kills the "
+    "job running on it — the recurring-bad-hardware shape. Pin the "
+    "host with 'task:<host>' (e.g. 'prob:0.4,task:s0h2'); the health "
+    "ledger must quarantine it and retries must route around it.")
+FAULT_HEALTH_PROBE = _key(
+    "tony.fault.health-probe", "", str,
+    "Fail a preflight host probe (fleet/health.preflight_probe), "
+    "filtered per host via 'task:<host>'. The grant must self-repair: "
+    "cordon the failing host and substitute a spare before anything "
+    "spawns on it.")
 
 # --- warm executor pool (tony_tpu/pool.py) --------------------------------
 POOL_DIR = _key(
@@ -904,6 +917,52 @@ FLEET_LEDGER_INTERVAL_S = _key(
     "jobs' span trees / perf artifacts into queued/startup/train/stall "
     "phase accounting — too hot for every scheduler tick at 50 jobs, "
     "cheap at this interval.")
+
+# --- fleet host health (tony_tpu/fleet/health.py) -------------------------
+HEALTH_ENABLED = _key(
+    "tony.health.enabled", True, bool,
+    "Master switch for the fleet host-health subsystem: the "
+    "failure-attribution ledger, quarantine state machine, preflight "
+    "probes and slice blast-radius detection. Off = every host is "
+    "always placeable (the pre-health fleet).")
+HEALTH_HALF_LIFE_S = _key(
+    "tony.health.score-half-life-s", 300.0, float,
+    "Half-life of a host's failure-attribution score: each attributed "
+    "infra failure adds its kind weight, and the total decays by half "
+    "every this-many seconds — a burst quarantines, ancient history "
+    "does not.")
+HEALTH_SUSPECT_THRESHOLD = _key(
+    "tony.health.suspect-threshold", 1.0, float,
+    "Decayed score at which a host turns SUSPECT — still placeable, "
+    "but counted toward the slice blast-radius correlation window.")
+HEALTH_QUARANTINE_THRESHOLD = _key(
+    "tony.health.quarantine-threshold", 3.0, float,
+    "Decayed score at which a host is QUARANTINED: removed from the "
+    "placement pool (journaled as REC_FLEET_HEALTH so --recover "
+    "resumes the same cordon set) until its cooldown expires into "
+    "probation.")
+HEALTH_QUARANTINE_S = _key(
+    "tony.health.quarantine-s", 120.0, float,
+    "Base quarantine cooldown. After it expires the host enters "
+    "PROBATION and must run one clean canary lease to rejoin the "
+    "pool; a failed canary re-quarantines with this cooldown doubled "
+    "(exponential backoff).")
+HEALTH_PROBATION_PRIORITY = _key(
+    "tony.health.probation-canary-priority", 0, int,
+    "Maximum job priority allowed to carry a probation canary host: "
+    "only jobs at or below it may have one cordoned-but-recovering "
+    "host substituted into their placement (at most one per slice), "
+    "so re-admission risk lands on preemptible work.")
+HEALTH_BLAST_N = _key(
+    "tony.health.slice-blast-n", 2, int,
+    "Correlated-failure threshold: this many distinct hosts of one "
+    "slice going suspect-or-worse inside tony.health.slice-blast-"
+    "window-s marks the whole slice sick — it is cordoned and its "
+    "jobs are evacuated by live migration.")
+HEALTH_BLAST_WINDOW_S = _key(
+    "tony.health.slice-blast-window-s", 120.0, float,
+    "Sliding window (seconds of attributed-failure evidence age) for "
+    "the slice blast-radius correlation above.")
 
 # --- portal ---------------------------------------------------------------
 PORTAL_PORT = _key(
@@ -1014,7 +1073,7 @@ _RESERVED_NON_JOB_SEGMENTS = {
     "application", "task", "coordinator", "client", "history", "tpu", "portal",
     "keep-failed-task-dirs", "internal", "fault", "rpc", "trace", "metrics",
     "diagnosis", "pool", "elastic", "profile", "train", "coord", "scale",
-    "fleet",
+    "fleet", "health",
 }
 
 

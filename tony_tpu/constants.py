@@ -185,6 +185,12 @@ FLEET_EVENTS_FILE = "fleet.events.jsonl"
 # export and recomputed on demand by `tony-tpu fleet diagnose`. Readers
 # treat a torn/absent file as "recompute from the fleet dir".
 FLEET_INCIDENT_FILE = "fleet.incident.json"
+# Host-health cordon set (tony_tpu/fleet/health.py): {"hosts": {host ->
+# state}} atomically replaced by the fleet daemon on every export, in
+# BOTH the fleet dir and the warm-pool dir — the pool daemon refuses
+# leases for (and discards) workers on listed hosts, and offline tools
+# read the live cordon set without dialing the daemon.
+FLEET_CORDON_FILE = "health.cordon.json"
 # Per-task exit report a POOLED executor writes into its task workdir at
 # exit ({"exit_code": N}): the leased process is the pool daemon's child,
 # not the backend's, so poll_completions reads this instead of waitpid.
@@ -193,6 +199,11 @@ EVENTS_SUFFIX = ".jhist.jsonl"
 INPROGRESS_SUFFIX = ".jhist.jsonl.inprogress"
 HISTORY_INTERMEDIATE = "intermediate"
 HISTORY_FINISHED = "finished"
+
+# Env var naming which slice host a task/worker runs on (cluster
+# backends set it at exec; pool workers echo it into ready.json so the
+# pool daemon can refuse leases on health-cordoned hosts).
+HOST_ID_ENV = "TONY_HOST_ID"
 
 # Chief-only XLA trace destination (tony_tpu/profiler.py contract).
 PROFILE_DIR = "TONY_PROFILE_DIR"

@@ -342,6 +342,13 @@ class Coordinator:
         self._epoch_span = tracing.NULL_SPAN
         self._rendezvous_span: Optional[object] = None
         self._task_spans: Dict[str, object] = {}
+        # task_id → hosts that already failed it with an INFRA domain
+        # this run (exclude-on-retry: a relaunch of the task is steered
+        # off those hosts via TaskLaunchSpec.exclude_hosts — a retry
+        # that lands back on the hardware that just killed it is a
+        # burned epoch). USER_ERROR never records a host: the code
+        # would fail anywhere.
+        self._failed_hosts: Dict[str, List[str]] = {}
 
         # --- live metrics (tony_tpu/metrics.py): beacon-fed registry,
         # rendered as Prometheus exposition into <job_dir>/metrics.prom
@@ -1086,7 +1093,9 @@ class Coordinator:
             task_id=task.task_id, job_name=task.job_name, index=task.index,
             command=job.command, env=self._task_env(task),
             vcores=job.vcores, memory=job.memory, chips=job.chips,
-            node_pool=job.node_pool, docker_image=job.docker_image)
+            node_pool=job.node_pool, docker_image=job.docker_image,
+            exclude_hosts=tuple(
+                self._failed_hosts.get(task.task_id, ())))
         try:
             task.handle = self.backend.launch_task(spec)
         except Exception as e:  # noqa: BLE001 — e.g. SliceProvisionError
@@ -1390,6 +1399,22 @@ class Coordinator:
     # ------------------------------------------------------------------
     # Completion plumbing
     # ------------------------------------------------------------------
+    def _record_failed_host(self, task_id: str,
+                            domain: Optional[FailureDomain]) -> None:
+        """Exclude-on-retry bookkeeping: remember which host an INFRA
+        failure happened on, BEFORE the backend forgets the task. The
+        next launch of this task id carries the list in
+        TaskLaunchSpec.exclude_hosts. USER_ERROR records nothing —
+        blacklisting hardware for a code bug just shrinks the pool."""
+        if domain is None or domain == FailureDomain.USER_ERROR:
+            return
+        host = self.backend.host_of(task_id)
+        if not host:
+            return
+        hosts = self._failed_hosts.setdefault(task_id, [])
+        if host not in hosts:
+            hosts.append(host)
+
     def _process_completion(self, task_id: str, exit_code: int) -> None:
         """Reference ``processFinishedContainer`` :1187-1220: apply failure
         policy, notify scheduler, emit TASK_FINISHED with last metrics."""
@@ -1410,6 +1435,8 @@ class Coordinator:
             return
         self.session.on_task_completed(task_id, exit_code,
                                        domain_hint=domain_hint)
+        if exit_code != 0:
+            self._record_failed_host(task_id, t.failure_domain)
         self._end_task_span(task_id, exit_code=exit_code,
                             status=t.status.value)
         self.journal.task(
@@ -1492,6 +1519,7 @@ class Coordinator:
                     else TaskStatus.FAILED)
         t.exit_code = exit_code
         t.failure_domain = domain
+        self._record_failed_host(task_id, domain)
         with self._hb_lock:
             self._last_hb.pop(task_id, None)
         self.progress.forget(task_id)
@@ -1876,6 +1904,8 @@ class Coordinator:
             self.session.on_task_completed(
                 task_id, constants.EXIT_KILLED,
                 domain_hint=FailureDomain.INFRA_TRANSIENT.value)
+            self._record_failed_host(task_id,
+                                     FailureDomain.INFRA_TRANSIENT)
             self.journal.task(
                 task_id, t.status.value, self.session.session_id,
                 exit_code=constants.EXIT_KILLED,

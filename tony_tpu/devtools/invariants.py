@@ -63,6 +63,16 @@ fleet-trace-stitch       every granted job's span tree carries the
                          fleet's trace id (the grant's injected
                          tony.internal.fleet-trace-id reached the
                          client) so one --fleet export stitches
+health-quarantine-evidence
+                         every non-manual REC_FLEET_HEALTH quarantine
+                         carries attributed-failure evidence (the
+                         score/probe/slice trail that justified the
+                         cordon) — a quarantine the journal cannot
+                         explain is an unauditable cordon
+health-dangling-cordon   every manual (operator) cordon is closed by
+                         an uncordon before the journal ends — manual
+                         cordons never auto-expire, so a dangling one
+                         is capacity silently lost
 =======================  ==================================================
 
 Surfaces: ``tony-tpu check <app|job_dir>`` (and the no-deps module CLI
@@ -460,10 +470,33 @@ def _check_fleet_journal(path: str, rel: str, rep: Report) -> None:
     # the fleet-decision dedup fence (reset at fgen: a recovered daemon
     # legitimately re-records the holds it re-derives).
     last_decision: Dict[str, Tuple[str, str]] = {}
+    # host → record index of a still-open manual cordon (fhealth
+    # records replay across daemon lives — last-wins per host — so the
+    # fold deliberately survives fgen bumps).
+    open_manual: Dict[str, int] = {}
     for idx, rec in records:
         t = rec.get("t")
         ev = json.dumps(rec, sort_keys=True)
         job = str(rec.get("job", "") or "")
+        if t == fj.REC_FLEET_HEALTH:
+            host = str(rec.get("host", "") or "")
+            state = str(rec.get("state", "") or "")
+            if state == "quarantined":
+                if rec.get("manual"):
+                    open_manual[host] = idx
+                else:
+                    open_manual.pop(host, None)
+                    if not rec.get("evidence"):
+                        rep.violations.append(Violation(
+                            "health-quarantine-evidence", rel, idx,
+                            f"quarantine of host {host} carries no "
+                            f"attributed-failure evidence — the cordon "
+                            f"cannot be audited", ev))
+            else:
+                # healthy (uncordon / clean canary) or probation both
+                # close a manual-cordon episode.
+                open_manual.pop(host, None)
+            continue
         if t == fj.REC_FLEET_GEN:
             gen = int(rec.get("generation", 0) or 0)
             if last_gen is not None and gen <= last_gen:
@@ -569,6 +602,12 @@ def _check_fleet_journal(path: str, rel: str, rep: Report) -> None:
                 f"granted hosts total {in_use} exceeds the journaled "
                 f"pool of {capacity} — the scheduler over-committed",
                 ev))
+    for host, idx in sorted(open_manual.items()):
+        rep.violations.append(Violation(
+            "health-dangling-cordon", rel, idx,
+            f"manual cordon of host {host} is never closed by an "
+            f"uncordon — manual cordons do not auto-expire, so this "
+            f"host is capacity silently lost"))
 
 
 def _check_fleet_ledger(fleet_dir: str, rep: Report) -> None:

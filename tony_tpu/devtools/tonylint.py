@@ -120,13 +120,13 @@ _ARTIFACTS = (
     "metrics.counters", "tony-manifest", ".tony-localized",
     "perf.json", "profile-request.json",
     "fleet.addr", "fleet.journal", "fleet.status", "fleet.counters",
-    "fleet.incident",
+    "fleet.incident", "health.cordon",
     "READY_FILE", "LEASE_FILE", "ADOPTED_FILE", "POOL_EXIT_FILE",
     "POOL_ADDR_FILE", "FINAL_CONFIG_FILE", "JOURNAL_FILE",
     "INCIDENT_FILE", "METRICS_COUNTERS_FILE", "MANIFEST_NAME",
     "MANIFEST_FILE", "addr_file", "PERF_FILE", "PROFILE_REQUEST_FILE",
     "FLEET_ADDR_FILE", "FLEET_JOURNAL_FILE", "FLEET_STATUS_FILE",
-    "FLEET_COUNTERS_FILE", "FLEET_INCIDENT_FILE",
+    "FLEET_COUNTERS_FILE", "FLEET_INCIDENT_FILE", "FLEET_CORDON_FILE",
 )
 
 #: attribute names whose call blocks (or can block) the calling thread —

@@ -111,6 +111,21 @@ class EventType(str, enum.Enum):
     # A fleet job reached a terminal state (finished/failed/cancelled);
     # payload: job, state, exit, app_id.
     FLEET_JOB_FINISHED = "FLEET_JOB_FINISHED"
+    # Host health (tony_tpu/fleet/health.py): the failure-attribution
+    # ledger pushed a host over the quarantine threshold (or an operator
+    # / preflight probe cordoned it) — the host leaves the placement
+    # pool until probation clears it; payload: host, slice, state,
+    # score, reason, manual.
+    FLEET_HOST_QUARANTINED = "FLEET_HOST_QUARANTINED"
+    # A cordoned host returned to the healthy pool — probation canary
+    # ran clean, quarantine cooldown expired into a clean canary, or an
+    # operator uncordoned it; payload: host, slice, state, reason.
+    FLEET_HOST_RESTORED = "FLEET_HOST_RESTORED"
+    # Correlated failure detection: >= blast-n hosts on one slice went
+    # suspect inside the blast window, so the whole slice is treated as
+    # sick — cordoned and queued for evacuation migration; payload:
+    # slice, hosts.
+    FLEET_SLICE_CORDONED = "FLEET_SLICE_CORDONED"
 
 
 @dataclasses.dataclass

@@ -134,6 +134,18 @@ site                      where it fires
                           atomic_write drops the rename (old bytes
                           survive) — the power-cut-mid-write shape the
                           replay-of-prefix readers must absorb
+``host.flaky``            fleet daemon health tick, per running job and
+                          assigned host (``task:<host>`` pins it, e.g.
+                          ``task:s0h2``) — a firing attributes an
+                          INFRA_TRANSIENT failure to that host and
+                          kills the job, the recurring-bad-hardware
+                          shape the quarantine ledger must cordon
+``health.probe``          fleet preflight probe (health.preflight_probe),
+                          per probed host before a grant books it —
+                          a firing simulates a host failing its port
+                          bind / durable-write check; the grant must
+                          self-repair by cordoning the host and
+                          substituting a spare, never spawn on it
 ========================  =====================================================
 
 Spec grammar (the value of ``tony.fault.<site>`` conf keys, or one
@@ -210,7 +222,8 @@ SITES = ("rpc.connect", "rpc.send", "rpc.slow", "heartbeat",
          "profile.capture", "quant.probe", "coord.slow-tick",
          "fleet.grant", "fleet.preempt", "fleet.ledger", "fleet.explain",
          "ckpt.async-write", "migrate.snapshot", "migrate.adopt",
-         "slice.preempt", "rpc.partition", "disk.full", "disk.torn")
+         "slice.preempt", "rpc.partition", "disk.full", "disk.torn",
+         "host.flaky", "health.probe")
 
 
 class InjectedFault(ConnectionError):

@@ -14,6 +14,7 @@ import sys
 from typing import List, Optional
 
 from tony_tpu.fleet.daemon import FleetDaemon, FleetError
+from tony_tpu.fleet.health import HealthConfig
 
 
 def main(argv: Optional[List[str]] = None) -> int:
@@ -39,10 +40,30 @@ def main(argv: Optional[List[str]] = None) -> int:
     s.add_argument("--recover", action="store_true",
                    help="replay the fleet journal and resume the queue "
                         "(required when the dir holds non-terminal jobs)")
+    s.add_argument("--health-enabled", type=int, default=1,
+                   help="host-health subsystem switch "
+                        "(tony.health.enabled)")
+    s.add_argument("--health-half-life-s", type=float, default=300.0)
+    s.add_argument("--health-suspect-threshold", type=float, default=1.0)
+    s.add_argument("--health-quarantine-threshold", type=float,
+                   default=3.0)
+    s.add_argument("--health-quarantine-s", type=float, default=120.0)
+    s.add_argument("--health-probation-priority", type=int, default=0)
+    s.add_argument("--health-blast-n", type=int, default=2)
+    s.add_argument("--health-blast-window-s", type=float, default=120.0)
     args = p.parse_args(argv)
     logging.basicConfig(
         level=logging.INFO,
         format="%(asctime)s %(levelname)s %(name)s: %(message)s")
+    health_conf = HealthConfig(
+        enabled=bool(args.health_enabled),
+        half_life_s=args.health_half_life_s,
+        suspect_threshold=args.health_suspect_threshold,
+        quarantine_threshold=args.health_quarantine_threshold,
+        quarantine_s=args.health_quarantine_s,
+        probation_priority=args.health_probation_priority,
+        blast_n=args.health_blast_n,
+        blast_window_s=args.health_blast_window_s)
     try:
         daemon = FleetDaemon(args.dir, slices=args.slices,
                              hosts_per_slice=args.hosts_per_slice,
@@ -50,7 +71,8 @@ def main(argv: Optional[List[str]] = None) -> int:
                              cache_root=args.cache_root,
                              tick_s=args.tick_s, recover=args.recover,
                              decision_ring=args.decision_ring,
-                             ledger_interval_s=args.ledger_interval_s)
+                             ledger_interval_s=args.ledger_interval_s,
+                             health_conf=health_conf)
     except (FleetError, ValueError) as e:
         print(f"fleet: {e}", file=sys.stderr)
         return 1

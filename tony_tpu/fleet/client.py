@@ -107,6 +107,32 @@ class FleetClient:
                 f"malformed migrate response: {res!r}")
         return res
 
+    def cordon(self, host: str, reason: str = "") -> dict:
+        """Operator cordon: pull one host out of the placement pool
+        (pre-maintenance, suspected hardware). Manual cordons never
+        auto-expire — close them with uncordon."""
+        res = self.call("fleet.cordon", host=host, reason=reason)
+        if not isinstance(res, dict):
+            raise FleetClientError(
+                f"malformed cordon response: {res!r}")
+        return res
+
+    def uncordon(self, host: str) -> dict:
+        res = self.call("fleet.uncordon", host=host)
+        if not isinstance(res, dict):
+            raise FleetClientError(
+                f"malformed uncordon response: {res!r}")
+        return res
+
+    def health(self) -> dict:
+        """The host-health ledger: per-host state/score/evidence rows,
+        the current cordon set and any sick slices."""
+        res = self.call("fleet.health")
+        if not isinstance(res, dict):
+            raise FleetClientError(
+                f"malformed health response: {res!r}")
+        return res
+
     def stop(self) -> None:
         self.call("fleet.stop")
 

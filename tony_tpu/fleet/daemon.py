@@ -56,6 +56,7 @@ from tony_tpu import constants, faults, tracing
 from tony_tpu.conf import keys as K
 from tony_tpu.devtools.race import guarded
 from tony_tpu.events.events import Event, EventHandler, EventType
+from tony_tpu.fleet import health as fhealth
 from tony_tpu.fleet import journal as fjournal
 from tony_tpu.fleet import ledger as fledger
 from tony_tpu.fleet.policy import (GRANT, HOLD_ACTIONS, MIGRATE,
@@ -103,6 +104,9 @@ class _FleetJob:
         self.state = QUEUED
         self.hosts = 0
         self.placement: Dict[int, int] = {}
+        #: concrete host identities the grant landed on (fleet/health.py
+        #: names, task-index order) — the failure-attribution target map
+        self.host_ids: List[str] = []
         self.app_id = ""
         self.pid = 0
         self.exit_code: Optional[int] = None
@@ -303,6 +307,15 @@ class _FleetService:
     def fleet__migrate(self, job: str, target: int) -> dict:
         return self._d.migrate(str(job), int(target))
 
+    def fleet__cordon(self, host: str, reason: str = "") -> dict:
+        return self._d.cordon(str(host), reason=str(reason or ""))
+
+    def fleet__uncordon(self, host: str) -> dict:
+        return self._d.uncordon(str(host))
+
+    def fleet__health(self) -> dict:
+        return self._d.health_status()
+
     def fleet__stop(self) -> bool:
         self._d.request_stop()
         return True
@@ -324,6 +337,8 @@ class FleetDaemon:
         "_grant_waits": "_lock",
         "_preempts_per_job": "_lock",
         "_dying_slices": "_lock",
+        "book": "_lock",
+        "_health_offsets": "_lock",
         "_ledger_degraded": None,
         "_ledger_next_mono": None,
         "_explain_warned": None,
@@ -338,7 +353,9 @@ class FleetDaemon:
                  reclaim_probe: Optional[Any] = None,
                  python: str = sys.executable,
                  decision_ring: int = 64,
-                 ledger_interval_s: float = 5.0) -> None:
+                 ledger_interval_s: float = 5.0,
+                 health_conf: Optional[fhealth.HealthConfig] = None
+                 ) -> None:
         self.fleet_dir = os.path.abspath(os.path.expanduser(fleet_dir))
         os.makedirs(self.fleet_dir, exist_ok=True)
         self.slices = max(1, int(slices))
@@ -376,6 +393,14 @@ class FleetDaemon:
         # reclaim feed, cluster/gcloud.py reclaim_notices).
         self.reclaim_probe = reclaim_probe
         self._dying_slices: set = set()
+        # Host health (fleet/health.py): the per-host failure-attribution
+        # ledger + quarantine state machine, kept in lockstep with the
+        # policy engine's count accounting. Per-job event-stream tail
+        # offsets feed the attribution loop incrementally.
+        self.health_cfg = health_conf or fhealth.HealthConfig()
+        self.book = fhealth.HostBook(self.slices, self.hosts_per_slice,
+                                     self.health_cfg)
+        self._health_offsets: Dict[str, int] = {}
 
         journal_path = os.path.join(self.fleet_dir,
                                     constants.FLEET_JOURNAL_FILE)
@@ -461,6 +486,13 @@ class FleetDaemon:
         guarded-by discipline has no single-threaded carve-outs."""
         with self._lock:
             self._seq = st.seq
+            # Health fold FIRST (last-wins per host): states land before
+            # adoption re-books hosts, so a cordoned-while-assigned host
+            # is re-booked to its job and stays cordoned-pending. Free-
+            # list membership is resynced after the job loop below.
+            now = time.monotonic()
+            for rec in st.health.values():
+                self.book.apply_record(rec, now)
         for fold in sorted(st.jobs.values(), key=lambda f: f.seq):
             req = JobRequest(fold.job_id, fold.tenant,
                              priority=fold.priority,
@@ -506,6 +538,9 @@ class FleetDaemon:
                 with self._lock:
                     self.engine.force_grant(req, fold.hosts,
                                             fold.placement)
+                    job.host_ids = self.book.adopt(
+                        fold.job_id, dict(fold.placement),
+                        fold.host_ids)
                 job.state = RUNNING
                 job.hosts = fold.hosts
                 job.placement = dict(fold.placement)
@@ -558,6 +593,19 @@ class FleetDaemon:
                            "regrant": True})
                 log.info("fleet recover: re-queued granted-but-never-"
                          "started job %s", fold.job_id)
+        # Resume the identical cordon set: drop cordoned hosts out of
+        # the free identity lists and mirror the delta into the pool's
+        # count accounting (hosts re-booked to adopted jobs are in-use,
+        # not free — they cordon at release, the deferred sweep).
+        with self._lock:
+            for i, n in self.book.resync_free().items():
+                for _ in range(n):
+                    self.engine.pool.cordon_free(i)
+            self._refresh_cordoned_names_locked()
+            cordoned = self.book.cordoned_names()
+        if cordoned:
+            log.warning("fleet recover: resumed health cordon set %s",
+                        cordoned)
 
     def _victim_gang_size(self, job: "_FleetJob",
                           app_id: Optional[str]) -> Optional[int]:
@@ -606,6 +654,7 @@ class FleetDaemon:
                 job.hosts = actual
                 job.placement = placement
                 job.host_events.append((int(time.time() * 1000), actual))
+                self._reconcile_hosts_locked(job, placement)
             self.journal.preempt(job_id, fold.hosts, actual, "",
                                  placement)
             log.warning(
@@ -631,6 +680,7 @@ class FleetDaemon:
                 job.hosts = actual
                 job.placement = placement
                 job.host_events.append((int(time.time() * 1000), actual))
+                self._reconcile_hosts_locked(job, placement)
             self.journal.state(job_id, fjournal.STATE_RESTORED,
                                hosts=actual, placement=placement)
             log.warning(
@@ -868,7 +918,11 @@ class FleetDaemon:
                     "held": held})
             queue_depth = self.engine.queue_depth
             free = self.engine.pool.free_total
+            cordoned_n = self.engine.pool.cordoned_total
             dying = sorted(self._dying_slices)
+            health = {"enabled": self.health_cfg.enabled,
+                      "cordoned": self.book.cordoned_names(),
+                      "sick_slices": self.book.sick_slices}
         hist = self.metrics.histogram(
             "tony_fleet_queue_wait_seconds",
             buckets=QUEUE_WAIT_BUCKETS_S,
@@ -892,8 +946,10 @@ class FleetDaemon:
             "fleet_dir": self.fleet_dir, "generation": self.generation,
             "pool": {"slices": self.slices,
                      "hosts_per_slice": self.hosts_per_slice,
-                     "total": total, "used": total - free, "free": free,
-                     "dying": dying},
+                     "total": total,
+                     "used": total - free - cordoned_n, "free": free,
+                     "cordoned": cordoned_n, "dying": dying},
+            "health": health,
             "tenants": tenants,
             "queue_depth": queue_depth,
             "jobs": rows,
@@ -910,6 +966,10 @@ class FleetDaemon:
     def tick(self) -> None:
         self._poll_jobs()
         self._discover_apps()
+        # Health before the plan: this tick's cordons shape this tick's
+        # placements, and a sick slice joins _dying_slices in time for
+        # _evacuate below.
+        self._health_tick()
         self._poll_reclaim()
         self._apply_plan()
         self._evacuate()
@@ -959,9 +1019,24 @@ class FleetDaemon:
             job.handle = None
             job.finished_ms = int(time.time() * 1000)
             self.engine.release(job_id)
+            # Deferred cordon sweep + canary resolution: hosts
+            # quarantined while this job held them leave service NOW
+            # (free -> cordoned), a probation canary resolves on the
+            # job's verdict (clean run restores it, a failure
+            # re-quarantines with doubled cooldown).
+            newly_cordoned, health_recs = self.book.release(
+                job_id, time.monotonic(),
+                failed=state == fjournal.STATE_FAILED)
+            for i, n in newly_cordoned.items():
+                for _ in range(n):
+                    self.engine.pool.cordon_free(i)
+            self._refresh_cordoned_names_locked()
+            self._health_offsets.pop(job_id, None)
             app_id = job.app_id
         self.journal.state(job_id, state, app_id=app_id,
                            exit_code=exit_code)
+        if health_recs:
+            self._apply_health_records(health_recs)
         job.queue_span.end(state=state)        # cancelled while queued
         job.queue_span = tracing.NULL_SPAN
         job.job_span.end(state=state, exit=exit_code)
@@ -1095,16 +1170,32 @@ class FleetDaemon:
             if job is None or job.state != QUEUED:
                 return True         # cancelled mid-plan: skip
         hosts = sum(placement.values())
+        # Concrete host identities + preflight probes (fleet/health.py):
+        # a probe failure cordons the bad host and substitutes a spare
+        # (the self-repairing grant); an uncoverable placement stays
+        # queued and the next tick re-plans around the cordons.
+        host_ids: List[str] = []
+        canary_recs: List[Dict[str, Any]] = []
+        if self.health_cfg.enabled:
+            assigned = self._assign_with_probe(job, placement)
+            if assigned is None:
+                return False
+            host_ids, canary_recs = assigned
         # Write-ahead: the grant record lands before the spawn, so a
         # crash in between recovers into "re-carry the grant out", never
         # a lost grant.
-        self.journal.grant(job_id, hosts, placement)
+        self.journal.grant(job_id, hosts, placement,
+                           host_ids=host_ids or None)
         with self._lock:
             try:
                 self.engine.grant(job_id, placement)
             except KeyError:
-                return True         # withdrawn between plan and apply
+                # Withdrawn between plan and apply: give the picked
+                # identities back (canaries keep their probation state).
+                self.book.unassign(job_id)
+                return True
             job.state = GRANTED
+            job.host_ids = host_ids
             job.hosts = hosts
             job.placement = dict(placement)
             job.wait_s = time.monotonic() - job.submitted_mono
@@ -1120,6 +1211,14 @@ class FleetDaemon:
                           f"{sorted(placement)} after "
                           f"{job.wait_s:.2f}s", "blocking": [],
                 "free": 0})
+        if canary_recs:
+            # The probation canary took one of the granted slots: the
+            # pool slot it vacated returns to accounting (uncordon) now
+            # that the grant's own booking has landed.
+            self._apply_health_records(canary_recs)
+            log.info("fleet grant %s: probation canary %s riding "
+                     "along", job_id,
+                     [r.get("host") for r in canary_recs])
         job.queue_span.end(wait_s=round(job.wait_s, 3), granted=True)
         job.queue_span = tracing.NULL_SPAN
         job.job_span = self.tracer.start_span(
@@ -1187,6 +1286,7 @@ class FleetDaemon:
                                        to_hosts))
             self._preempts_per_job[victim_id] = \
                 self._preempts_per_job.get(victim_id, 0) + 1
+            self._reconcile_hosts_locked(victim, new_placement)
         self.journal.preempt(victim_id, from_hosts, to_hosts, for_job,
                              new_placement)
         self.tracer.instant("fleet.preempt", parent=victim.job_span,
@@ -1280,6 +1380,7 @@ class FleetDaemon:
             placement = self.engine.migrate_applied(d.job_id,
                                                     d.placement)
             job.placement = placement
+            self._reconcile_hosts_locked(job, placement)
         self.journal.migrate(d.job_id, d.source, d.target, placement,
                              reason=d.reason)
         self.tracer.instant("fleet.migrate", parent=job.job_span,
@@ -1367,6 +1468,7 @@ class FleetDaemon:
                 job.placement = placement
                 job.host_events.append((int(time.time() * 1000),
                                         new_hosts))
+                self._reconcile_hosts_locked(job, placement)
             self.journal.state(job_id, fjournal.STATE_RESTORED,
                                hosts=new_hosts, placement=placement)
             self.tracer.instant("fleet.restore", parent=job.job_span,
@@ -1374,6 +1476,314 @@ class FleetDaemon:
                                 attrs={"hosts": new_hosts})
             log.info("fleet restore: %s grown back to %d host(s)",
                      job_id, new_hosts)
+
+    # -- host health (tony_tpu/fleet/health.py) ---------------------------
+    def _refresh_cordoned_names_locked(self) -> None:
+        """Caller holds the lock. The CAPACITY_DENIED explainer names
+        cordoned hosts that are actually out of the pool — a probation
+        canary currently leased to a job is in-use, not a hold cause."""
+        leased = {h for hs in self.book.assigned.values() for h in hs}
+        self.engine.cordoned_names = [
+            n for n in self.book.cordoned_names() if n not in leased]
+
+    def _reconcile_hosts_locked(self, job: _FleetJob,
+                         placement: Dict[int, int]) -> None:
+        """Caller holds the lock. A resize/migration changed the job's
+        per-slice counts: trim/extend its concrete host set to match,
+        moving any freed cordon-pending slot out of the pool's free
+        accounting (a shrink is the fastest way to get a sick slot out
+        of a live gang — the book frees those first)."""
+        for i, n in self.book.reconcile(job.req.job_id,
+                                        placement).items():
+            for _ in range(n):
+                self.engine.pool.cordon_free(i)
+        job.host_ids = list(self.book.assigned.get(job.req.job_id)
+                            or [])
+        self._refresh_cordoned_names_locked()
+
+    def _apply_health_records(
+            self, records: List[Dict[str, Any]]) -> None:
+        """Land a batch of host-health transitions: write-ahead journal
+        each record, mirror the free/cordoned delta into the pool's
+        count accounting, and emit the operator-facing events. Journal
+        appends run OUTSIDE the lock (they fsync)."""
+        for rec in records:
+            self.journal.health(rec)
+            i = int(rec.get("slice", -1))
+            with self._lock:
+                if rec.get("canary") or rec.get("now_free"):
+                    self.engine.pool.uncordon(i)
+                elif rec.get("was_free"):
+                    self.engine.pool.cordon_free(i)
+                self._refresh_cordoned_names_locked()
+            state = str(rec.get("state", ""))
+            if state == fhealth.QUARANTINED:
+                self.metrics.counter(
+                    "tony_fleet_quarantines_total",
+                    help="host quarantines applied (score, probe, "
+                         "manual, sick-slice)").inc()
+                self.events.emit(Event(EventType.FLEET_HOST_QUARANTINED, {
+                    "host": rec.get("host", ""), "slice": i,
+                    "score": rec.get("score", 0.0),
+                    "manual": bool(rec.get("manual")),
+                    "reason": rec.get("reason", "")}))
+                log.warning("fleet health: %s quarantined (%s)",
+                            rec.get("host"), rec.get("reason"))
+            elif state == fhealth.HEALTHY and (
+                    rec.get("now_free") is not None
+                    or "canary" in str(rec.get("reason", ""))):
+                self.events.emit(Event(EventType.FLEET_HOST_RESTORED, {
+                    "host": rec.get("host", ""), "slice": i,
+                    "reason": rec.get("reason", "")}))
+                log.info("fleet health: %s restored (%s)",
+                         rec.get("host"), rec.get("reason"))
+
+    def _tail_job_events(self, job: _FleetJob,
+                         path: str) -> List[Dict[str, Any]]:
+        """Incremental tail of one job's event stream from the last
+        byte offset: complete JSON lines only (a torn tail stays unread
+        until its newline lands), offsets survive file finalization via
+        monotonic-size heuristics (the rename keeps the content)."""
+        job_id = job.req.job_id
+        with self._lock:
+            offset = self._health_offsets.get(job_id, 0)
+        try:
+            size = os.path.getsize(path)
+            if size <= offset:
+                return []
+            with open(path, "rb") as f:
+                f.seek(offset)
+                chunk = f.read()
+        except OSError:
+            return []
+        # Only complete lines advance the offset.
+        end = chunk.rfind(b"\n")
+        if end < 0:
+            return []
+        with self._lock:
+            self._health_offsets[job_id] = offset + end + 1
+        out: List[Dict[str, Any]] = []
+        for raw in chunk[:end].split(b"\n"):
+            raw = raw.strip()
+            if not raw:
+                continue
+            try:
+                out.append(json.loads(raw.decode("utf-8")))
+            except (ValueError, UnicodeDecodeError):
+                continue
+        return out
+
+    #: event-type -> evidence kind for non-TASK_FINISHED feeders
+    _HEALTH_EVENT_KINDS = {"TASK_HUNG": "hang",
+                           "TASK_STRAGGLER": "straggler"}
+
+    def _attribute_failures(self) -> List[Any]:
+        """(host, kind, job_id, ts_ms) attributions tailed from running
+        jobs' event streams: TASK_FINISHED with an infra failure domain
+        (heartbeat expiries and host.loss absorbs arrive this way,
+        domain INFRA_TRANSIENT), hang kills, straggler flags.
+        USER_ERROR never counts — a user bug says nothing about the
+        machine."""
+        with self._lock:
+            running = [(j, list(j.host_ids)) for j in self.jobs.values()
+                       if j.state == RUNNING and j.app_id and j.host_ids]
+        if not running:
+            return []
+        dirs = fledger.job_history_dirs(self.fleet_dir)
+        out: List[Any] = []
+        for job, host_ids in running:
+            job_dir = dirs.get(job.app_id)
+            if not job_dir:
+                continue
+            path = None
+            try:
+                for name in sorted(os.listdir(job_dir)):
+                    if name.endswith(constants.EVENTS_SUFFIX) \
+                            or name.endswith(constants.INPROGRESS_SUFFIX):
+                        path = os.path.join(job_dir, name)
+                        break
+            except OSError:
+                continue
+            if path is None:
+                continue
+            for rec in self._tail_job_events(job, path):
+                etype = str(rec.get("type", ""))
+                payload = rec.get("event") or {}
+                ts_ms = int(rec.get("timestamp", 0) or 0)
+                task = str(payload.get("task", "") or "")
+                kind = ""
+                if etype == "TASK_FINISHED":
+                    kind = str(payload.get("failure_domain", "") or "")
+                    if kind not in ("INFRA_TRANSIENT", "PREEMPTION"):
+                        continue    # success or USER_ERROR: no evidence
+                else:
+                    kind = self._HEALTH_EVENT_KINDS.get(etype, "")
+                    if not kind:
+                        continue
+                try:
+                    idx = int(task.rsplit(":", 1)[-1])
+                except ValueError:
+                    continue
+                host = host_ids[idx % len(host_ids)]
+                out.append((host, kind, job.req.job_id, ts_ms))
+        return out
+
+    def _health_tick(self) -> None:
+        """The attribution + state-machine pass, before the scheduler
+        plan so fresh cordons shape this tick's placements. Also the
+        ``host.flaky`` drill feed: a fired site kills the pinned host's
+        job (the real-world analogue is the task dying there) and
+        attributes the failure."""
+        if not self.health_cfg.enabled:
+            return
+        now = time.monotonic()
+        attributions = self._attribute_failures()
+        with self._lock:
+            running = [(j, list(j.host_ids)) for j in self.jobs.values()
+                       if j.state == RUNNING and j.host_ids]
+        for job, host_ids in running:
+            for host in host_ids:
+                if faults.fire("host.flaky", task_id=host):
+                    attributions.append(
+                        (host, "INFRA_TRANSIENT", job.req.job_id,
+                         int(time.time() * 1000)))
+                    log.warning(
+                        "fleet health: host.flaky fired on %s — "
+                        "killing %s (drill)", host, job.req.job_id)
+                    self.runner.kill(job.workdir)
+                    with self._lock:
+                        # The fake runners used in drills have no
+                        # process to reap; mark the exit so _poll_jobs
+                        # terminalizes the job this tick. Real Popen
+                        # handles reap through poll() as usual.
+                        if job.handle is not None \
+                                and not isinstance(job.handle,
+                                                   subprocess.Popen) \
+                                and getattr(job.handle, "returncode",
+                                            137) is None:
+                            job.handle.returncode = 137
+        records: List[Dict[str, Any]] = []
+        with self._lock:
+            for host, kind, job_id, ts_ms in attributions:
+                records.extend(self.book.record_failure(
+                    host, kind, job_id, now, ts_ms=ts_ms))
+            tick_recs, sick = self.book.tick(now)
+            records.extend(tick_recs)
+        if records:
+            self._apply_health_records(records)
+        for i in sick:
+            self.metrics.counter(
+                "tony_fleet_sick_slices_total",
+                help="whole-slice cordons from correlated host "
+                     "failures").inc()
+            self.events.emit(Event(EventType.FLEET_SLICE_CORDONED, {
+                "slice": i, "blast_n": self.health_cfg.blast_n,
+                "window_s": self.health_cfg.blast_window_s}))
+            log.warning("fleet health: slice %d is SICK (>= %d "
+                        "correlated suspects) — cordoned, evacuating "
+                        "its jobs", i, self.health_cfg.blast_n)
+            with self._lock:
+                self._dying_slices.add(i)
+
+    def _assign_with_probe(
+            self, job: _FleetJob, placement: Dict[int, int]
+    ) -> Optional[Any]:
+        """Pick concrete hosts for a grant and preflight-probe each.
+        A probe failure cordons the host and the loop re-picks with a
+        spare substituted — the grant self-repairs instead of failing
+        the job. Returns (host_ids, canary records), or None when the
+        placement can no longer be covered (the job stays queued; the
+        next tick re-plans around the new cordons)."""
+        job_id = job.req.job_id
+        probe_dir = os.path.join(self.fleet_dir, "probe")
+        for _ in range(self.slices * self.hosts_per_slice + 1):
+            now = time.monotonic()
+            with self._lock:
+                try:
+                    host_ids, canaries = self.book.assign(
+                        job_id, placement, job.req.priority, now)
+                except ValueError as e:
+                    log.warning("fleet health: grant of %s cannot be "
+                                "covered (%s); job stays queued",
+                                job_id, e)
+                    return None
+            failed = []
+            for h in host_ids:
+                why = fhealth.preflight_probe(h, probe_dir)
+                if why is not None:
+                    failed.append((h, why))
+            if not failed:
+                return host_ids, canaries
+            recs: List[Dict[str, Any]] = []
+            with self._lock:
+                self.book.unassign(job_id)
+                for h, why in failed:
+                    rec = self.book.cordon(
+                        h, reason=f"preflight probe failed: {why}",
+                        now=now, kind="probe",
+                        ts_ms=int(time.time() * 1000))
+                    if rec is not None:
+                        recs.append(rec)
+            self._apply_health_records(recs)
+            log.warning("fleet grant %s: preflight probe cordoned "
+                        "%s — substituting spare(s)", job_id,
+                        [h for h, _ in failed])
+        return None
+
+    # -- operator verbs (fleet cordon|uncordon|health) --------------------
+    def cordon(self, host: str, reason: str = "") -> dict:
+        if self.journal.dead is not None:
+            return {"ok": False,
+                    "message": f"fleet journal is dead "
+                               f"({self.journal.dead}); restart with "
+                               f"`fleet start --recover`"}
+        why = f"operator cordon: {reason}" if reason \
+            else "operator cordon"
+        with self._lock:
+            rec = self.book.cordon(host, reason=why,
+                                   now=time.monotonic(), manual=True)
+        if rec is None:
+            return {"ok": False, "message": f"unknown host {host!r} "
+                    f"(hosts are s<slice>h<index>)"}
+        try:
+            self._apply_health_records([rec])
+        except DurableWriteError as e:
+            return {"ok": False,
+                    "message": f"fleet journal is dead ({e}); restart "
+                               f"with `fleet start --recover`"}
+        return {"ok": True, "host": host, "state": rec["state"],
+                "was_free": bool(rec.get("was_free"))}
+
+    def uncordon(self, host: str) -> dict:
+        if self.journal.dead is not None:
+            return {"ok": False,
+                    "message": f"fleet journal is dead "
+                               f"({self.journal.dead}); restart with "
+                               f"`fleet start --recover`"}
+        with self._lock:
+            rec = self.book.uncordon(host, now=time.monotonic())
+        if rec is None:
+            return {"ok": False,
+                    "message": f"host {host!r} is unknown or not "
+                               f"cordoned"}
+        try:
+            self._apply_health_records([rec])
+        except DurableWriteError as e:
+            return {"ok": False,
+                    "message": f"fleet journal is dead ({e}); restart "
+                               f"with `fleet start --recover`"}
+        return {"ok": True, "host": host, "state": rec["state"],
+                "leased": not bool(rec.get("now_free"))}
+
+    def health_status(self) -> dict:
+        """`tony-tpu fleet health`: the per-host ledger, worst first."""
+        with self._lock:
+            rows = self.book.snapshot(time.monotonic())
+            cordoned = self.book.cordoned_names()
+            sick = self.book.sick_slices
+        return {"ok": True, "enabled": self.health_cfg.enabled,
+                "hosts": rows, "cordoned": cordoned,
+                "sick_slices": sick}
 
     # -- goodput ledger (tony_tpu/fleet/ledger.py) ------------------------
     def _ledger_fold_input(self, job: _FleetJob) -> fjournal.JobFold:
@@ -1495,6 +1905,15 @@ class FleetDaemon:
             used = self.engine.tenant_used()
             waits = sorted(self._grant_waits)
             per_job = dict(self._preempts_per_job)
+            health = {
+                "enabled": self.health_cfg.enabled,
+                "cordoned": [dict(host=h.host, state=h.state,
+                                  score=round(h.score, 3),
+                                  manual=h.manual,
+                                  evidence=list(h.evidence[-4:]))
+                             for h in self.book.cordoned_hosts()],
+                "sick_slices": self.book.sick_slices,
+            }
         return {
             "fleet_dir": self.fleet_dir,
             "quotas": dict(self.quotas), "tenants_used": used,
@@ -1507,6 +1926,7 @@ class FleetDaemon:
                 "tony_fleet_preemptions_total").value),
             "preempts_per_job": per_job,
             "ledger": self._ledger_snapshot() or {},
+            "health": health,
             "pool_dir": self.pool_dir,
         }
 
@@ -1515,10 +1935,37 @@ class FleetDaemon:
         self._refresh_ledger()
         snap = self.status()
         pool = snap["pool"]
-        for state in ("total", "used", "free"):
+        for state in ("total", "used", "free", "cordoned"):
             self.metrics.gauge("tony_fleet_hosts", {"state": state},
                                help="pool hosts by state").set(
                 pool[state])
+        # Host-health families + the cordon handshake file the warm
+        # pool reads (fleet/health.py): snapshot under the lock, write
+        # outside it.
+        rank = {fhealth.HEALTHY: 0, fhealth.SUSPECT: 1,
+                fhealth.PROBATION: 2, fhealth.QUARANTINED: 3}
+        with self._lock:
+            host_states = [(h.host, h.state)
+                           for h in self.book.hosts.values()]
+            cordons = {h.host: h.state
+                       for h in self.book.cordoned_hosts()}
+        for host, state in host_states:
+            self.metrics.gauge(
+                "tony_fleet_host_health", {"host": host},
+                help="per-host health state (0 healthy, 1 suspect, "
+                     "2 probation, 3 quarantined)").set(
+                rank.get(state, 0))
+        self.metrics.gauge(
+            "tony_fleet_quarantined_hosts",
+            help="hosts currently cordoned by health quarantine or "
+                 "probation").set(len(cordons))
+        for root in filter(None, (self.fleet_dir, self.pool_dir)):
+            try:
+                fhealth.write_cordon_file(
+                    os.path.join(root, constants.FLEET_CORDON_FILE),
+                    cordons)
+            except OSError:
+                log.debug("cordon-file export to %s failed", root)
         by_state = {s: 0 for s in (QUEUED, GRANTED, RUNNING)
                     + fjournal.TERMINAL_STATES}
         for row in snap["jobs"]:

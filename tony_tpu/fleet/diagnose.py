@@ -11,6 +11,10 @@ treated as worse than none.
 
 Verdicts (precedence order)::
 
+    SICK_SLICE       correlated host failures cordoned a whole slice —
+                     a hardware incident, evacuation in progress
+    FLAKY_HOST       the failure-attribution ledger quarantined a host;
+                     placements already route around it
     STARVATION       a non-quota-held job has waited far beyond the
                      median grant wait — priority/quota tuning needed
     QUOTA_SATURATED  a tenant sits at its quota with work queued behind
@@ -44,6 +48,8 @@ from tony_tpu import constants
 
 log = logging.getLogger(__name__)
 
+SICK_SLICE = "SICK_SLICE"
+FLAKY_HOST = "FLAKY_HOST"
 STARVATION = "STARVATION"
 QUOTA_SATURATED = "QUOTA_SATURATED"
 FRAGMENTATION = "FRAGMENTATION"
@@ -52,8 +58,11 @@ POOL_COLD = "POOL_COLD"
 FLEET_HEALTHY = "FLEET_HEALTHY"
 
 #: every category the engine can return (golden-matrix test anchor) in
-#: precedence order, most urgent first.
-CATEGORY_PRECEDENCE = (STARVATION, QUOTA_SATURATED, FRAGMENTATION,
+#: precedence order, most urgent first. Hardware verdicts outrank
+#: scheduling ones: a starving queue behind a cordoned slice is a
+#: hardware incident, not a priority-tuning problem.
+CATEGORY_PRECEDENCE = (SICK_SLICE, FLAKY_HOST, STARVATION,
+                       QUOTA_SATURATED, FRAGMENTATION,
                        PREEMPT_STORM, POOL_COLD, FLEET_HEALTHY)
 
 #: schema version stamped into fleet.incident.json.
@@ -71,6 +80,14 @@ POOL_COLD_WARM_FRACTION = 0.5    # below this with a pool = cold
 #: verdict → the knob to spend it on (rendered by the CLI/portal; the
 #: full table with context is the Fleet triage runbook).
 _ADVICE = {
+    SICK_SLICE: "correlated failures cordoned a whole slice — file the "
+                "hardware ticket, let the evacuation migrations drain "
+                "it, and uncordon after repair (docs/operations.md "
+                "'Host health')",
+    FLAKY_HOST: "the failure-attribution ledger quarantined the host — "
+                "jobs already route around it; replace or repair the "
+                "hardware, then let probation's canary re-admit it "
+                "(or `fleet uncordon` after a manual fix)",
     STARVATION: "a job is starving behind the queue — raise its "
                 "priority, lower the blocker's, or widen the "
                 "blocking tenant's quota headroom",
@@ -116,6 +133,50 @@ def _rule(fn: Callable[[Dict[str, Any]], Optional[Finding]]):
 
 def _queued(bundle: Dict[str, Any]) -> List[Dict[str, Any]]:
     return [r for r in bundle.get("queue", []) if isinstance(r, dict)]
+
+
+@_rule
+def _sick_slice(b: Dict[str, Any]) -> Optional[Finding]:
+    health = b.get("health") or {}
+    sick = list(health.get("sick_slices") or [])
+    if not sick:
+        return None
+    members = [r for r in health.get("cordoned", [])
+               if isinstance(r, dict) and r.get("slice") in sick]
+    ev = [f"health: slice(s) {sick} cordoned by correlated-failure "
+          f"detection (tony.health.slice-blast-n hosts suspect inside "
+          f"the blast window)"]
+    for r in members[:4]:
+        ev.append(f"  {r.get('host')}: {r.get('state')} "
+                  f"score={r.get('score')} ({r.get('reason', '?')})")
+    return Finding(SICK_SLICE, "sick-slice",
+                   f"slice(s) {sick} are sick — correlated host "
+                   f"failures triggered a blast-radius cordon",
+                   confidence=0.95, evidence=ev,
+                   details={"slices": sick,
+                            "hosts": [r.get("host") for r in members]})
+
+
+@_rule
+def _flaky_host(b: Dict[str, Any]) -> Optional[Finding]:
+    health = b.get("health") or {}
+    auto = [r for r in health.get("cordoned", [])
+            if isinstance(r, dict) and not r.get("manual")]
+    if not auto:
+        return None
+    worst = auto[0]
+    ev = [f"health: {len(auto)} host(s) cordoned by the "
+          f"failure-attribution ledger: "
+          f"{[r.get('host') for r in auto]}"]
+    for e in (worst.get("evidence") or [])[-4:]:
+        ev.append(f"  {worst.get('host')}: {e.get('kind', '?')} "
+                  f"in {e.get('job') or '?'}")
+    return Finding(FLAKY_HOST, "flaky-host",
+                   f"host {worst.get('host')} is quarantined with "
+                   f"attributed failures (score {worst.get('score')})",
+                   confidence=0.9, evidence=ev,
+                   details={"hosts": [r.get("host") for r in auto],
+                            "worst": worst.get("host")})
 
 
 @_rule
@@ -381,6 +442,22 @@ def bundle_from_dir(fleet_dir: str,
     pool_dir = ""
     for fold in st.jobs.values():
         pool_dir = pool_dir or fold.conf.get("tony.pool.dir", "")
+    # health fold: st.health is last-wins per host, so a host whose
+    # final record is "healthy" has already been re-admitted.
+    cordoned: List[Dict[str, Any]] = []
+    for host in sorted(st.health):
+        rec = st.health[host]
+        if rec.get("state") not in ("quarantined", "probation"):
+            continue
+        cordoned.append({
+            "host": host, "slice": rec.get("slice"),
+            "state": rec.get("state"), "score": rec.get("score"),
+            "manual": bool(rec.get("manual")),
+            "reason": rec.get("reason", ""),
+            "evidence": list(rec.get("evidence") or [])})
+    sick = sorted({r["slice"] for r in cordoned
+                   if str(r.get("reason", "")).startswith("sick slice")
+                   and r.get("slice") is not None})
     return {
         "fleet_dir": fleet_dir,
         "quotas": dict(st.quotas), "tenants_used": used, "queue": queue,
@@ -390,6 +467,8 @@ def bundle_from_dir(fleet_dir: str,
         "ledger": {"tenants": led.get("tenants", {}),
                    "fleet": led.get("fleet", {})},
         "pool_dir": pool_dir,
+        "health": {"enabled": bool(st.health),
+                   "cordoned": cordoned, "sick_slices": sick},
     }
 
 

@@ -52,6 +52,14 @@ REC_FLEET_DECISION = "fdecision"
 # re-accounts the pool exactly (host COUNT is unchanged — migration
 # moves capacity, it never shrinks it).
 REC_FLEET_MIGRATE = "fmigrate"
+# A host's health state TRANSITIONED (fleet/health.py: healthy /
+# suspect / quarantined / probation), write-ahead of the cordon or
+# restore taking effect. Each record is self-contained — it carries
+# the host's attributed-failure evidence — so `fleet start --recover`
+# resumes the identical cordon set (the fold persists across fgen
+# records: cordons outlive daemon lives) and `tony-tpu check` audits
+# that no quarantine lacks evidence.
+REC_FLEET_HEALTH = "fhealth"
 
 #: in-fold cap on per-job decision history (the journal keeps all of it
 #: on disk; the replayed fold only needs enough to seed the explain
@@ -88,6 +96,9 @@ class JobFold:
     state: str = "QUEUED"
     hosts: int = 0                 # currently granted
     placement: Dict[int, int] = dataclasses.field(default_factory=dict)
+    #: concrete host identities the grant landed on (fleet/health.py
+    #: names), when the grant record carried them
+    host_ids: List[str] = dataclasses.field(default_factory=list)
     app_id: str = ""
     pid: int = 0
     exit_code: Optional[int] = None
@@ -119,6 +130,11 @@ class FleetReplayState:
     jobs: Dict[str, JobFold] = dataclasses.field(default_factory=dict)
     records: int = 0
     torn_tail: bool = False
+    #: last-wins per-host health fold (host -> the latest fhealth
+    #: record). NOT reset on fgen: a cordon survives daemon restarts
+    #: until a journaled transition closes it.
+    health: Dict[str, Dict[str, Any]] = dataclasses.field(
+        default_factory=dict)
 
 
 class FleetJournal:
@@ -178,12 +194,19 @@ class FleetJournal:
                      "model": model, "seq": int(seq),
                      "conf": dict(conf)})
 
-    def grant(self, job_id: str, hosts: int,
-              placement: Dict[int, int]) -> None:
-        self.append({"t": REC_FLEET_GRANT, "job": job_id,
-                     "hosts": int(hosts),
-                     "placement": {str(i): int(n)
-                                   for i, n in placement.items()}})
+    def grant(self, job_id: str, hosts: int, placement: Dict[int, int],
+              host_ids: Optional[List[str]] = None) -> None:
+        rec: Dict[str, Any] = {
+            "t": REC_FLEET_GRANT, "job": job_id, "hosts": int(hosts),
+            "placement": {str(i): int(n)
+                          for i, n in placement.items()}}
+        if host_ids:
+            # Concrete host identities (fleet/health.py names) so a
+            # recovering daemon re-books the SAME slots — a cordoned
+            # host must stay cordoned even while an adopted job runs
+            # beside it. Optional: pre-health journals replay fine.
+            rec["host_ids"] = [str(h) for h in host_ids]
+        self.append(rec)
 
     def preempt(self, job_id: str, from_hosts: int, to_hosts: int,
                 for_job: str, placement: Dict[int, int]) -> None:
@@ -205,6 +228,18 @@ class FleetJournal:
                      "placement": {str(i): int(n)
                                    for i, n in placement.items()},
                      "reason": str(reason)})
+
+    def health(self, record: Dict[str, Any]) -> None:
+        """One host-health transition (fleet/health.py builds the
+        payload: host, slice, state, score, reason, manual, cooldown_s,
+        evidence). Write-ahead: appended BEFORE the cordon/restore is
+        applied to the pool."""
+        rec = {"t": REC_FLEET_HEALTH}
+        for k in ("host", "slice", "state", "score", "reason", "manual",
+                  "cooldown_s", "evidence"):
+            if k in record:
+                rec[k] = record[k]
+        self.append(rec)
 
     def decision(self, job_id: str, action: str, reason: str,
                  blocking: Optional[List[str]] = None,
@@ -314,6 +349,7 @@ def replay(path: str) -> FleetReplayState:
             fold.state = "GRANTED"
             fold.hosts = int(rec.get("hosts", 0) or 0)
             fold.placement = _placement(rec)
+            fold.host_ids = [str(h) for h in (rec.get("host_ids") or [])]
             fold.granted_ms = ts_ms
             fold.host_events = [(ts_ms, fold.hosts)]
         elif t == REC_FLEET_PREEMPT:
@@ -329,6 +365,10 @@ def replay(path: str) -> FleetReplayState:
                 continue
             # Host count is unchanged by a move — only the slice map.
             fold.placement = _placement(rec)
+        elif t == REC_FLEET_HEALTH:
+            host = str(rec.get("host", "") or "")
+            if host:
+                state.health[host] = rec
         elif t == REC_FLEET_DECISION:
             fold = state.jobs.get(str(rec.get("job", "") or ""))
             if fold is None:

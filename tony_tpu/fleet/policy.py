@@ -121,6 +121,11 @@ class SlicePool:
         self.slices = max(1, int(slices))
         self.hosts_per_slice = max(1, int(hosts_per_slice))
         self._free: List[int] = [self.hosts_per_slice] * self.slices
+        #: hosts cordoned out of service by health quarantine (fleet/
+        #: health.py) or operator cordon: per slice, free + in-use +
+        #: cordoned == hosts_per_slice. Cordoned hosts are invisible to
+        #: place() because they are simply not free.
+        self._cordoned: List[int] = [0] * self.slices
 
     @property
     def total(self) -> int:
@@ -130,13 +135,36 @@ class SlicePool:
     def free_total(self) -> int:
         return sum(self._free)
 
+    @property
+    def cordoned_total(self) -> int:
+        return sum(self._cordoned)
+
     def free_on(self, i: int) -> int:
         """Free hosts on one slice (the operator-migrate room check)."""
         return self._free[int(i)]
 
+    def cordon_free(self, i: int) -> None:
+        """Move one FREE host on slice ``i`` out of service. Occupied
+        hosts are cordoned at release time instead (the daemon defers
+        the sweep until the holding job frees them)."""
+        i = int(i)
+        if self._free[i] <= 0:
+            raise ValueError(f"slice {i} has no free host to cordon")
+        self._free[i] -= 1
+        self._cordoned[i] += 1
+
+    def uncordon(self, i: int) -> None:
+        """Return one cordoned host on slice ``i`` to the free pool."""
+        i = int(i)
+        if self._cordoned[i] <= 0:
+            raise ValueError(f"slice {i} has no cordoned host")
+        self._cordoned[i] -= 1
+        self._free[i] += 1
+
     def clone(self) -> "SlicePool":
         c = SlicePool(self.slices, self.hosts_per_slice)
         c._free = list(self._free)
+        c._cordoned = list(self._cordoned)
         return c
 
     def place(self, hosts: int) -> Optional[Dict[int, int]]:
@@ -186,7 +214,8 @@ class SlicePool:
 
     def release(self, placement: Dict[int, int]) -> None:
         for i, n in placement.items():
-            self._free[i] = min(self.hosts_per_slice, self._free[i] + n)
+            self._free[i] = min(self.hosts_per_slice - self._cordoned[i],
+                                self._free[i] + n)
 
     def shrink(self, placement: Dict[int, int],
                by: int) -> Dict[int, int]:
@@ -202,8 +231,9 @@ class SlicePool:
                 break
             best = min(sorted(placement), key=lambda i: -self._free[i])
             placement[best] -= 1
-            self._free[best] = min(self.hosts_per_slice,
-                                   self._free[best] + 1)
+            self._free[best] = min(
+                self.hosts_per_slice - self._cordoned[best],
+                self._free[best] + 1)
             if placement[best] == 0:
                 del placement[best]
         return placement
@@ -220,6 +250,11 @@ class PolicyEngine:
         self.quotas: Dict[str, int] = dict(quotas or {})
         self._queued: Dict[str, JobRequest] = {}
         self._running: Dict[str, _Running] = {}
+        #: host ids currently cordoned by health quarantine (set by the
+        #: daemon, read by the CAPACITY_DENIED explainer: a hold caused
+        #: by sick hardware must NAME the sick hardware, or the
+        #: operator debugs a phantom capacity shortage).
+        self.cordoned_names: List[str] = []
 
     # -- queries ---------------------------------------------------------
     @property
@@ -353,6 +388,10 @@ class PolicyEngine:
                     why = (f"{req.hosts} hosts do not fit ({free} free) "
                            f"and no lower-priority elastic capacity "
                            f"exists")
+                if self.cordoned_names:
+                    why += (f"; {len(self.cordoned_names)} host(s) "
+                            f"cordoned by health quarantine: "
+                            f"{self.cordoned_names}")
                 plan.append(Decision(
                     CAPACITY_DENIED, req.job_id, hosts=req.hosts,
                     free=free, blocking=holders, reason=why))
@@ -500,8 +539,10 @@ class PolicyEngine:
             # its healthy hosts free up for the placement too.
             for i, n in r.placement.items():
                 if i not in dying_set:
-                    tentative._free[i] = min(self.pool.hosts_per_slice,
-                                             tentative._free[i] + n)
+                    tentative._free[i] = min(
+                        self.pool.hosts_per_slice
+                        - tentative._cordoned[i],
+                        tentative._free[i] + n)
             dest = tentative.place(r.hosts)
             if dest is None:
                 for i, n in r.placement.items():
@@ -644,6 +685,26 @@ def _self_check() -> None:
     assert (plan[0].source, plan[0].target) == (0, 1), plan[0]
     eng.migrate_applied("ev", plan[0].placement)
     assert eng.running("ev") == (2, {1: 2})
+    # Health cordon: a cordoned host is simply not free — placements
+    # route around it, releases never resurrect it, and a capacity hold
+    # caused by the cordon NAMES the sick host.
+    eng = PolicyEngine(1, 4)
+    eng.pool.cordon_free(0)
+    assert (eng.pool.free_total, eng.pool.cordoned_total) == (3, 1)
+    eng.cordoned_names = ["s0h3"]
+    eng.submit(JobRequest("w", "t1", hosts=4, seq=1))
+    plan = eng.schedule()
+    assert [d.action for d in plan] == [CAPACITY_DENIED], plan
+    assert "s0h3" in plan[0].reason, plan[0].reason
+    eng._queued.pop("w")
+    eng.submit(JobRequest("x", "t1", hosts=3, seq=2))
+    plan = eng.schedule()
+    assert [d.action for d in plan] == [GRANT], plan
+    eng.grant("x", plan[0].placement)
+    eng.release("x")
+    assert eng.pool.free_total == 3   # release never refills the cordon
+    eng.pool.uncordon(0)
+    assert (eng.pool.free_total, eng.pool.cordoned_total) == (4, 0)
     print("fleet policy self-check OK")
 
 

@@ -74,7 +74,7 @@ SERIES: Dict[str, str] = {
     "tony_rpc_requests_total": "RPC requests dispatched",
     "tony_events_total": "job-history events emitted, by type",
     # -- fleet: multi-job gang scheduler (tony_tpu/fleet/daemon.py) ------
-    "tony_fleet_hosts": "pool hosts by state (total/used/free)",
+    "tony_fleet_hosts": "pool hosts by state (total/used/free/cordoned)",
     "tony_fleet_jobs": "fleet jobs by state",
     "tony_fleet_queue_depth": "submissions waiting for a grant",
     "tony_fleet_tenant_hosts": "granted hosts per tenant",
@@ -86,6 +86,14 @@ SERIES: Dict[str, str] = {
                                         "received from the reclaim feed",
     "tony_fleet_quota_denials_total": "grants deferred by tenant quota",
     "tony_fleet_queue_wait_seconds": "submit-to-grant wait latency",
+    # -- fleet host health (tony_tpu/fleet/health.py) ---------------------
+    "tony_fleet_host_health": "per-host health state (0 healthy, "
+                              "1 suspect, 2 probation, 3 quarantined)",
+    "tony_fleet_quarantined_hosts": "hosts currently cordoned by "
+                                    "health quarantine or probation",
+    "tony_fleet_quarantines_total": "host quarantine transitions applied",
+    "tony_fleet_sick_slices_total": "correlated slice cordons "
+                                    "(blast-radius evacuations)",
     # -- fleet goodput ledger (tony_tpu/fleet/ledger.py) ------------------
     "tony_fleet_goodput_fraction": "chip-seconds doing useful train "
                                    "steps / chip-seconds held, per "

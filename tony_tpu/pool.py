@@ -57,7 +57,7 @@ import sys
 import threading
 import time
 import uuid
-from typing import Dict, List, Optional
+from typing import Dict, List, Optional, Tuple
 
 from tony_tpu import constants
 from tony_tpu.devtools.race import guarded
@@ -150,6 +150,10 @@ def _worker_main(worker_dir: str, preload: str) -> int:
     _atomic_json(os.path.join(worker_dir, READY_FILE), {
         "pid": os.getpid(), "started_ts": started_ts,
         "warm_after_s": round(time.monotonic() - t0, 3),
+        # Which physical host this worker warmed up on (the slice
+        # backend exports it into the environment) — the lease path
+        # refuses workers whose host the fleet health ledger cordoned.
+        "host": os.environ.get(constants.HOST_ID_ENV, ""),
         "preloaded": loaded})
     lease_path = os.path.join(worker_dir, LEASE_FILE)
     shutdown_path = os.path.join(worker_dir, SHUTDOWN_FILE)
@@ -394,13 +398,28 @@ class PoolDaemon:
         for _ in range(max(0, deficit)):
             self._spawn_worker()
 
+    def _cordoned_hosts(self) -> Dict[str, str]:
+        """The fleet daemon's health-cordon handshake: it atomically
+        replaces health.cordon.json in this pool dir on every export
+        (fleet/health.py write_cordon_file). Absent/garbled = no fleet
+        or health off — nothing cordoned."""
+        from tony_tpu.fleet.health import read_cordoned
+
+        return read_cordoned(os.path.join(self.pool_dir,
+                                          constants.FLEET_CORDON_FILE))
+
     # -- RPC behaviour ---------------------------------------------------
     def lease(self, task_id: str, env: dict, workdir: str,
               app_id: str = "", generation: int = 0) -> dict:
         """Grant one warm worker to a task, or raise PoolError (the caller
         cold-spawns). The worker is marked leased BEFORE the lease file
-        lands, so two concurrent submits can never adopt the same pid."""
+        lands, so two concurrent submits can never adopt the same pid.
+        Workers warmed on a health-cordoned host are never leased — and
+        are discarded on sight (a warm import cache on bad hardware is
+        worth less than the retry it would burn)."""
         now = time.monotonic()
+        cordoned = self._cordoned_hosts()
+        sick: List[Tuple[_Worker, str]] = []
         with self._lock:
             if generation and app_id:
                 last = self._gen_by_app.get(app_id, 0)
@@ -415,14 +434,28 @@ class PoolDaemon:
                     continue
                 if now - w.created > self.max_lease_age_s:
                     continue          # recycled by the next replenish pass
-                if w.ready() is None:
+                ready = w.ready()
+                if ready is None:
                     continue          # still warming up
+                if cordoned and ready.get("host") in cordoned:
+                    sick.append((w, str(ready.get("host"))))
+                    continue
                 candidate = w
                 break
-            if candidate is None:
-                raise PoolError("pool has no warm executor available")
-            candidate.leased_to = task_id
-            candidate.lease_app = app_id
+            if candidate is not None:
+                candidate.leased_to = task_id
+                candidate.lease_app = app_id
+        for w, host in sick:
+            log.warning("discarding warm worker %s: its host %s is "
+                        "health-cordoned", w.id, host)
+            self._kill_worker(w)
+        if candidate is None:
+            if sick:
+                raise PoolError(
+                    "pool has no warm executor available (workers on "
+                    "health-cordoned hosts discarded: "
+                    + ", ".join(sorted(h for _, h in sick)) + ")")
+            raise PoolError("pool has no warm executor available")
         lease_env = dict(env)
         lease_env[constants.POOL_WORKER_ID] = candidate.id
         _atomic_json(os.path.join(candidate.dir, LEASE_FILE),

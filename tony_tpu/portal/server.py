@@ -292,6 +292,19 @@ class PortalServer:
                 f"{html.escape(str(v.get('category', '?')))}</b> — "
                 f"{html.escape(str(v.get('summary', '')))}<br>"
                 f"advice: {html.escape(str(v.get('advice', '')))}</p>")
+        # Host-health cordon banner (fleet/health.py): quiet when the
+        # fleet is clean — operators should only see it on an incident.
+        health = snap.get("health") or {}
+        if health.get("cordoned") or health.get("sick_slices"):
+            parts = []
+            if health.get("cordoned"):
+                parts.append("cordoned hosts: " + html.escape(
+                    ", ".join(str(h) for h in health["cordoned"])))
+            if health.get("sick_slices"):
+                parts.append("sick slices: " + html.escape(
+                    ", ".join(str(i) for i in health["sick_slices"])))
+            body.append("<p><b>host health</b> — " + "; ".join(parts)
+                        + " (see `tony-tpu fleet health`)</p>")
         # Per-tenant goodput ledger table (fleet/ledger.py rollup).
         ledger = snap.get("ledger") or {}
         tenants = snap.get("tenants") or {}
