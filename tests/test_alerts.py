@@ -1,0 +1,652 @@
+"""Watchtower — the SLO/alerting engine (tony_tpu/alerts/).
+
+Units: rule grammar validation, the pending→firing→resolved state
+machine under an injected clock (for-duration hysteresis), the
+multi-window burn-rate golden matrix, worst-offender label selection,
+the windowed evaluator APIs on MetricsRegistry (rate ring boundaries,
+counter resets, counter-reset-across-``--recover``, quantile_over),
+PromSource against the checked-in CI fixtures, REC_ALERT journal
+round-trip + torn tail + recover seeding (the dedup fence), the
+``alerts.eval`` degrade fault site on the fleet daemon tick, and the
+``alert-journal`` invariant's SUCCEEDED-strictness.
+
+Plus the slow e2e drill: a ``user.slow_step`` stall drags the step rate
+below an armed floor so the step-time SLO transitions to firing BEFORE
+a composed ``user.hang`` kills the job — and ``diagnose`` cites the
+alert as corroborating evidence on the HANG verdict.
+"""
+
+import json
+import os
+
+import pytest
+
+from tony_tpu import constants, faults, metrics
+from tony_tpu.alerts import rules as AR
+from tony_tpu.alerts.rules import (AlertEngine, PromSource, Rule, Slo,
+                                   bucket_quantile)
+from tony_tpu.conf import keys as K
+from tony_tpu.coordinator import journal as cjournal
+from tony_tpu.devtools import invariants
+
+pytestmark = pytest.mark.faults
+
+FIXTURES = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "fixtures")
+
+
+# ---------------------------------------------------------------------------
+# fakes: injected clock + sources
+# ---------------------------------------------------------------------------
+class _Clock:
+    def __init__(self, t=0.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+
+class _FakeSource:
+    """One family, explicit samples: ``vals`` is a list of
+    (labels, value) pairs; ``pts`` the gauge-ring points burn walks."""
+
+    def __init__(self, vals=(), pts=None, now=0.0):
+        self.vals = list(vals)
+        self.pts = list(pts) if pts is not None else None
+        self.now = now
+
+    def label_sets(self, series):
+        return [dict(ls) for ls, _ in self.vals]
+
+    def sample(self, series, labels):
+        for ls, v in self.vals:
+            if ls == labels:
+                return v
+        return None
+
+    def rate(self, series, labels, window_s):
+        return None
+
+    def quantile(self, series, labels, window_s, q):
+        return None
+
+    def points(self, series, labels):
+        if self.pts is not None:
+            return list(self.pts)
+        v = self.sample(series, labels)
+        return [(self.now, v)] if v is not None else []
+
+
+def _gauge_src(value, task="worker:0"):
+    vals = [({"task": task}, value)] if value is not None else \
+        [({"task": task}, None)]
+    return _FakeSource(vals)
+
+
+_GAUGE_RULE = Rule(name="hb", kind="gauge",
+                   series="tony_task_heartbeat_age_seconds", op=">",
+                   threshold=10.0, for_s=5.0, severity="page",
+                   summary="heartbeat stale")
+
+
+def _replace(rule, **kw):
+    import dataclasses
+
+    return dataclasses.replace(rule, **kw)
+
+
+# ---------------------------------------------------------------------------
+# rule grammar
+# ---------------------------------------------------------------------------
+def test_rule_grammar_rejects_unknown_kind_op_severity():
+    with pytest.raises(ValueError, match="unknown rule kind"):
+        Rule(name="x", kind="delta", series="s")
+    with pytest.raises(ValueError, match="unknown rule op"):
+        Rule(name="x", kind="gauge", series="s", op="!=")
+    with pytest.raises(ValueError, match="unknown severity"):
+        Rule(name="x", kind="gauge", series="s", severity="info")
+
+
+def test_slo_objective_must_be_a_real_fraction():
+    for bad in (0.0, 1.0, -0.5, 2.0):
+        with pytest.raises(ValueError, match="objective"):
+            Slo(name="s", series="f", op="<", threshold=1.0,
+                objective=bad).compile()
+    r = Slo(name="s", series="f", op="<", threshold=1.0,
+            objective=0.9, factor=3.0).compile()
+    assert r.kind == "burn" and r.factor == 3.0
+    assert r.summary == "SLO s burn-rate breach"
+
+
+def test_engine_rejects_duplicate_rule_names():
+    with pytest.raises(ValueError, match="duplicate rule name"):
+        AlertEngine([_GAUGE_RULE, _GAUGE_RULE])
+
+
+def test_default_packs_cover_the_shipped_rule_set():
+    """The shipped paging policy, by name — the alert-registry lint
+    holds both directions of this contract."""
+    job = AR.default_job_pack()
+    fleet = AR.default_fleet_pack()
+    assert {r.name for r in job} == {
+        "heartbeat-age", "input-bound", "journal-fsync-p99",
+        "step-time-slo"}
+    assert {r.name for r in fleet} == {
+        "goodput-slo", "quarantine-spike", "queue-wait-p99"}
+    # every referenced family resolves in the metrics registry
+    for fam in AR.pack_series(list(job) + list(fleet)):
+        assert fam in metrics.SERIES, fam
+
+
+def test_default_pack_thresholds_are_conf_driven():
+    from tony_tpu.conf.config import TonyTpuConfig
+
+    conf = TonyTpuConfig()
+    conf.set(K.ALERTS_HEARTBEAT_AGE_S, 5.0)
+    conf.set(K.ALERTS_MIN_STEPS_PER_SEC, 2.5)
+    conf.set(K.ALERTS_FOR_S, 1.0)
+    by_name = {r.name: r for r in AR.default_job_pack(conf)}
+    assert by_name["heartbeat-age"].threshold == 5.0
+    assert by_name["heartbeat-age"].for_s == 1.0
+    assert by_name["step-time-slo"].threshold == 2.5
+    # unset keys keep the shipped defaults
+    assert by_name["journal-fsync-p99"].threshold == 0.05
+
+
+# ---------------------------------------------------------------------------
+# hysteresis: the pending→firing→resolved state machine
+# ---------------------------------------------------------------------------
+def test_hysteresis_breach_must_persist_for_s_before_firing():
+    clk = _Clock()
+    eng = AlertEngine([_GAUGE_RULE], clock=clk)
+    trs = eng.evaluate(_gauge_src(20.0))
+    assert [(t.rule, t.state, t.journal) for t in trs] == \
+        [("hb", "pending", True)]
+    clk.t = 3.0
+    assert eng.evaluate(_gauge_src(25.0)) == []     # 3s < for_s: holds
+    clk.t = 5.0
+    trs = eng.evaluate(_gauge_src(25.0))
+    assert [(t.rule, t.state) for t in trs] == [("hb", "firing")]
+    assert trs[0].severity == "page" and trs[0].value == 25.0
+    assert eng.firing_count() == {"page": 1, "warn": 0}
+    row = eng.snapshot()[0]
+    assert row["state"] == "firing" and row["since_s"] == 0.0
+    # steady breach: no transition spam
+    clk.t = 9.0
+    assert eng.evaluate(_gauge_src(30.0)) == []
+    clk.t = 10.0
+    trs = eng.evaluate(_gauge_src(2.0))
+    assert [(t.rule, t.state) for t in trs] == [("hb", "resolved")]
+    assert eng.firing_count() == {"page": 0, "warn": 0}
+
+
+def test_unevaluable_tick_holds_the_current_state():
+    """Absent data neither pages nor resolves: a firing alert survives
+    a tick with no samples (dead telemetry is not an all-clear)."""
+    clk = _Clock()
+    eng = AlertEngine([_GAUGE_RULE], clock=clk)
+    eng.evaluate(_gauge_src(20.0))
+    clk.t = 5.0
+    eng.evaluate(_gauge_src(20.0))
+    clk.t = 6.0
+    assert eng.evaluate(_gauge_src(None)) == []
+    assert eng.snapshot()[0]["state"] == "firing"
+    assert eng.evaluate(_FakeSource(vals=[])) == []
+    assert eng.snapshot()[0]["state"] == "firing"
+
+
+def test_pending_breach_that_clears_resolves_without_paging():
+    clk = _Clock()
+    eng = AlertEngine([_GAUGE_RULE], clock=clk)
+    eng.evaluate(_gauge_src(20.0))
+    clk.t = 2.0
+    trs = eng.evaluate(_gauge_src(1.0))
+    assert [(t.rule, t.state) for t in trs] == [("hb", "resolved")]
+    assert eng.snapshot()[0]["state"] == "ok"
+
+
+def test_immediate_and_zero_for_s_skip_the_pending_stage():
+    eng = AlertEngine([_GAUGE_RULE], immediate=True)
+    assert [t.state for t in eng.evaluate(_gauge_src(20.0))] == \
+        ["firing"]
+    zero = _replace(_GAUGE_RULE, for_s=0.0)
+    eng2 = AlertEngine([zero])
+    assert [t.state for t in eng2.evaluate(_gauge_src(20.0))] == \
+        ["firing"]
+
+
+def test_worst_offender_labels_ride_the_transition():
+    src = _FakeSource(vals=[({"task": "worker:0"}, 45.0),
+                            ({"task": "worker:1"}, 60.0)])
+    eng = AlertEngine([_replace(_GAUGE_RULE, for_s=0.0)])
+    trs = eng.evaluate(src)
+    assert trs[0].labels == {"task": "worker:1"}
+    assert trs[0].value == 60.0
+    # a match filter restricts the candidate label sets
+    matched = _replace(_GAUGE_RULE, for_s=0.0,
+                       match=(("task", "worker:0"),))
+    trs = AlertEngine([matched]).evaluate(src)
+    assert trs[0].labels == {"task": "worker:0"}
+    assert trs[0].value == 45.0
+
+
+def test_absent_rule_fires_on_dead_telemetry():
+    rule = Rule(name="dead", kind="absent",
+                series="tony_task_heartbeat_age_seconds", for_s=0.0)
+    eng = AlertEngine([rule])
+    assert [t.state for t in eng.evaluate(_FakeSource(vals=[]))] == \
+        ["firing"]
+    trs = eng.evaluate(_gauge_src(1.0))
+    assert [t.state for t in trs] == ["resolved"]
+
+
+def test_resolve_all_closes_every_open_episode():
+    clk = _Clock()
+    pend = _replace(_GAUGE_RULE, name="hb2")
+    eng = AlertEngine([_GAUGE_RULE, pend], clock=clk)
+    src = _gauge_src(20.0)
+    eng.evaluate(src)                   # both pending
+    clk.t = 5.0
+    eng.evaluate(src)                   # both firing
+    trs = eng.resolve_all()
+    assert sorted((t.rule, t.state) for t in trs) == \
+        [("hb", "resolved"), ("hb2", "resolved")]
+    assert all(r["state"] == "ok" for r in eng.snapshot())
+    assert eng.resolve_all() == []      # idempotent
+
+
+# ---------------------------------------------------------------------------
+# burn-rate golden matrix (the two-window AND)
+# ---------------------------------------------------------------------------
+_BURN_RULE = Slo(name="burn", series="tony_task_steps_per_sec", op="<",
+                 threshold=1.0, objective=0.9, long_s=100.0,
+                 short_s=10.0, factor=2.0).compile()
+
+
+def _burn(points, now=100.0):
+    src = _FakeSource(vals=[({}, points[-1][1])] if points else [],
+                      pts=points, now=now)
+    return AR._burn_rate(_BURN_RULE, src, {})
+
+
+def test_burn_matrix_healthy_series_burns_nothing():
+    pts = [(t, 5.0) for t in range(0, 101, 10)]
+    assert _burn(pts) == 0.0
+
+
+def test_burn_matrix_old_breach_alone_does_not_page():
+    """Long window saturated by an OLD episode, short window clean —
+    the classic stale-breach immunity of the two-window discipline."""
+    pts = [(t, 0.2) for t in (0, 10, 20, 30, 40, 50)] + \
+        [(t, 5.0) for t in (60, 70, 80, 90, 100)]
+    assert _burn(pts) == 0.0            # short window burns nothing
+
+
+def test_burn_matrix_fast_blip_alone_does_not_page():
+    """Short window 100% bad but the long window barely dented — a
+    blip, not a budget burn."""
+    pts = [(t, 5.0) for t in range(0, 90, 10)] + \
+        [(95.0, 0.2), (100.0, 0.2)]
+    v = _burn(pts)
+    assert v == pytest.approx((2 / 11) / 0.1)       # ≈1.82 < factor 2
+    assert v < _BURN_RULE.factor
+
+
+def test_burn_matrix_sustained_burn_pages_and_factor_is_inclusive():
+    pts = [(t, 0.2) for t in range(0, 101, 10)]
+    assert _burn(pts) == pytest.approx(10.0)        # both windows 100%
+    # exactly factor on the long window: >= fires
+    boundary = [(t, 5.0) for t in range(10, 90, 10)] + \
+        [(95.0, 0.2), (100.0, 0.2)]
+    assert _burn(boundary) == pytest.approx(2.0)
+    src = _FakeSource(vals=[({}, 0.2)], pts=boundary, now=100.0)
+    eng = AlertEngine([_BURN_RULE], immediate=True)
+    assert [t.state for t in eng.evaluate(src)] == ["firing"]
+
+
+def test_burn_matrix_stale_series_anchors_short_window_on_newest():
+    pts = [(0.0, 5.0), (50.0, 0.2)]     # nothing inside [90, 100]
+    assert _burn(pts) == pytest.approx((1 / 2) / 0.1)   # long wins min
+    assert _burn([]) is None            # no points at all: unevaluable
+
+
+# ---------------------------------------------------------------------------
+# MetricsRegistry evaluator APIs: rate / quantile_over
+# ---------------------------------------------------------------------------
+def test_rate_windowed_increase_and_ring_boundary():
+    reg = metrics.MetricsRegistry()
+    g = reg.gauge("tony_step_phase_seconds", {"phase": "data_wait"})
+    g.set(0.0, ts=0.0)
+    g.set(5.0, ts=10.0)
+    g.set(12.0, ts=20.0)
+    labels = {"phase": "data_wait"}
+    # the base is the newest point BEFORE the cutoff, not a re-count
+    assert reg.rate("tony_step_phase_seconds", labels, 10.0,
+                    now=20.0) == pytest.approx(1.2)
+    assert reg.rate("tony_step_phase_seconds", labels, 5.0,
+                    now=20.0) == pytest.approx(1.4)
+    # window past the ring: family exists, nothing in-window → 0.0
+    assert reg.rate("tony_step_phase_seconds", labels, 5.0,
+                    now=40.0) == 0.0
+    # unknown family/labels → None (unevaluable, not zero)
+    assert reg.rate("tony_step_phase_seconds", {"phase": "x"},
+                    10.0, now=20.0) is None
+    assert reg.rate("no_such_family", None, 10.0, now=20.0) is None
+
+
+def test_rate_counter_reset_contributes_post_reset_value():
+    reg = metrics.MetricsRegistry()
+    g = reg.gauge("tony_step_phase_seconds", {"phase": "compute"})
+    for ts, v in ((0.0, 0.0), (10.0, 100.0), (20.0, 3.0), (30.0, 8.0)):
+        g.set(v, ts=ts)
+    # 0→100 (+100), 100→3 reset (+3, Prometheus-style), 3→8 (+5)
+    assert reg.rate("tony_step_phase_seconds", {"phase": "compute"},
+                    100.0, now=30.0) == pytest.approx(108.0 / 100.0)
+
+
+def test_rate_counter_reset_across_recover_reload(tmp_path):
+    """The --recover edge: a reloaded counter's base value must anchor
+    the ring, not read as a fresh in-window increase."""
+    path = str(tmp_path / "counters.json")
+    reg1 = metrics.MetricsRegistry()
+    reg1.counter("tony_fleet_grants_total").inc(5)
+    reg1.save_counters(path)
+
+    reg2 = metrics.MetricsRegistry()
+    assert reg2.load_counters(path) is True
+    c = reg2.counter("tony_fleet_grants_total")
+    assert c.value == 5.0               # recovered base
+    import time as _time
+    now = _time.monotonic()
+    # the seed point anchors the window: zero increase so far
+    assert reg2.rate("tony_fleet_grants_total", None, 60.0,
+                     now=now) == 0.0
+    c.inc(2)
+    # only the post-recover increase counts toward the rate
+    assert reg2.rate("tony_fleet_grants_total", None, 60.0,
+                     now=_time.monotonic()) == \
+        pytest.approx(2.0 / 60.0, rel=0.01)
+    assert reg2.sample("tony_fleet_grants_total", None) == 7.0
+
+
+def test_quantile_over_exact_rank_and_window_boundary():
+    import time as _time
+
+    reg = metrics.MetricsRegistry()
+    h = reg.histogram("tony_journal_fsync_seconds")
+    for v in range(1, 11):
+        h.observe(float(v))
+    now = _time.monotonic()
+    assert reg.quantile_over("tony_journal_fsync_seconds", None,
+                             60.0, 0.5, now=now) == pytest.approx(5.5)
+    assert reg.quantile_over("tony_journal_fsync_seconds", None,
+                             60.0, 1.0, now=now) == pytest.approx(10.0)
+    # every observation aged out of the window → None, not 0
+    assert reg.quantile_over("tony_journal_fsync_seconds", None,
+                             60.0, 0.5, now=now + 120.0) is None
+    assert reg.quantile_over("no_such_family", None, 60.0,
+                             0.5) is None
+
+
+def test_quantile_over_beacon_snapshot_ring():
+    reg = metrics.MetricsRegistry()
+    reg.set_histogram_snapshot(
+        "tony_fleet_queue_wait_seconds", None,
+        {"buckets": [1.0, 2.0], "counts": [5, 5, 0], "count": 10})
+    assert reg.quantile_over("tony_fleet_queue_wait_seconds", None,
+                             60.0, 0.5) == pytest.approx(1.0)
+
+
+def test_bucket_quantile_interpolates_inside_owning_bucket():
+    # the breaching fixture's fsync shape: p99 lands deep in [0.01, 0.5]
+    assert bucket_quantile([0.01, 0.5], [10, 90, 0], 0.99) == \
+        pytest.approx(0.01 + 0.49 * 89 / 90)
+    assert bucket_quantile([], [], 0.5) == 0.0
+    assert bucket_quantile([1.0], [0, 5], 0.5) == 1.0   # overflow clamps
+
+
+# ---------------------------------------------------------------------------
+# PromSource over the checked-in CI fixtures
+# ---------------------------------------------------------------------------
+def _pack():
+    return list(AR.default_job_pack()) + list(AR.default_fleet_pack())
+
+
+def test_prom_fixture_healthy_is_quiet():
+    with open(os.path.join(FIXTURES, "alerts_healthy.prom")) as f:
+        src = PromSource(f.read())
+    eng = AlertEngine(_pack(), immediate=True)
+    assert eng.evaluate(src) == []
+    assert eng.firing() == []
+
+
+def test_prom_fixture_breaching_fires_exactly_the_expected_set():
+    with open(os.path.join(FIXTURES, "alerts_breaching.prom")) as f:
+        src = PromSource(f.read())
+    eng = AlertEngine(_pack(), immediate=True)
+    trs = eng.evaluate(src)
+    assert {t.rule for t in trs if t.state == "firing"} == {
+        "heartbeat-age", "journal-fsync-p99", "goodput-slo",
+        "queue-wait-p99"}
+    by_rule = {r["rule"]: r for r in eng.snapshot()}
+    # rate kinds are honestly unevaluable from a snapshot: held ok, not
+    # fired on garbage
+    assert by_rule["quarantine-spike"]["state"] == "ok"
+    assert by_rule["input-bound"]["state"] == "ok"
+    # the step-time SLO ships disarmed (floor 0.0 — op "<" never holds)
+    assert by_rule["step-time-slo"]["state"] == "ok"
+    # the worst offender's labels rode the gauge transition
+    assert by_rule["heartbeat-age"]["labels"] == {"task": "worker:0"}
+    assert by_rule["heartbeat-age"]["value"] == 121.5
+
+
+# ---------------------------------------------------------------------------
+# REC_ALERT journal: round-trip, torn tail, recover seeding
+# ---------------------------------------------------------------------------
+def test_rec_alert_roundtrip_last_wins_and_torn_tail(tmp_path):
+    path = str(tmp_path / constants.JOURNAL_FILE)
+    j = cjournal.SessionJournal(path)
+    j.alert("heartbeat-age", "pending", "page", 45.0,
+            {"task": "worker:0"}, "stale")
+    j.alert("heartbeat-age", "firing", "page", 47.0,
+            {"task": "worker:0"}, "stale")
+    j.alert("journal-fsync-p99", "firing", "warn", 0.09, {}, "fsync")
+    j.alert("journal-fsync-p99", "resolved", "warn", None, {}, "fsync")
+    j.close()
+    st = cjournal.replay(path)
+    assert st.alerts == {"heartbeat-age": "firing",
+                         "journal-fsync-p99": "resolved"}
+    # torn tail: the partial record is dropped, the prefix survives
+    with open(path, "ab") as f:
+        f.write(b'{"t": "alert", "rule": "heartbeat-age", "state": "res')
+    st2 = cjournal.replay(path)
+    assert st2.torn_tail is True
+    assert st2.alerts == st.alerts
+
+
+def test_seed_rearms_firing_without_duplicate_journal_records():
+    """The recover dedup fence: a seeded-firing engine re-entering the
+    same breach emits NOTHING (the journal already holds firing), and
+    the eventual resolve journals exactly once."""
+    clk = _Clock()
+    eng = AlertEngine([_GAUGE_RULE], clock=clk)
+    eng.seed({"hb": "firing"})
+    assert eng.snapshot()[0]["state"] == "firing"
+    assert eng.evaluate(_gauge_src(50.0)) == []     # still breaching
+    clk.t = 1.0
+    trs = eng.evaluate(_gauge_src(1.0))
+    assert [(t.state, t.journal) for t in trs] == [("resolved", True)]
+
+
+def test_seed_pending_restarts_hysteresis_then_journals_firing():
+    clk = _Clock(t=100.0)
+    eng = AlertEngine([_GAUGE_RULE], clock=clk)
+    eng.seed({"hb": "pending"})
+    clk.t = 102.0
+    assert eng.evaluate(_gauge_src(50.0)) == []     # fresh for_s clock
+    clk.t = 105.0
+    trs = eng.evaluate(_gauge_src(50.0))
+    assert [(t.state, t.journal) for t in trs] == [("firing", True)]
+
+
+def test_seed_resolved_and_retired_rules():
+    eng = AlertEngine([_GAUGE_RULE])
+    eng.seed({"hb": "resolved", "ghost-rule": "firing"})
+    assert eng.snapshot()[0]["state"] == "ok"
+    # re-breach after a journaled resolve: pending IS journaled again
+    trs = eng.evaluate(_gauge_src(50.0))
+    assert [(t.state, t.journal) for t in trs] == [("pending", True)]
+
+
+def test_recovered_engine_rebuilds_the_identical_firing_set(tmp_path):
+    """The SIGKILL acceptance shape: write-ahead REC_ALERT records →
+    kill (torn tail) → replay → seed a fresh default-pack engine → the
+    firing set is identical to the pre-kill one."""
+    path = str(tmp_path / constants.JOURNAL_FILE)
+    j = cjournal.SessionJournal(path)
+    j.alert("step-time-slo", "pending", "page", 8.0, {}, "slo")
+    j.alert("step-time-slo", "firing", "page", 9.5, {}, "slo")
+    j.alert("heartbeat-age", "pending", "page", 31.0, {}, "hb")
+    j.alert("input-bound", "firing", "warn", 0.7, {}, "input")
+    j.alert("input-bound", "resolved", "warn", None, {}, "input")
+    j.close()
+    with open(path, "ab") as f:
+        f.write(b'{"t": "alert", "rule": "step-')    # SIGKILL mid-write
+    st = cjournal.replay(path)
+    eng = AlertEngine(AR.default_job_pack())
+    eng.seed(st.alerts)
+    assert {r["rule"] for r in eng.firing()} == {"step-time-slo"}
+    by_rule = {r["rule"]: r["state"] for r in eng.snapshot()}
+    assert by_rule["heartbeat-age"] == "pending"
+    assert by_rule["input-bound"] == "ok"
+
+
+# ---------------------------------------------------------------------------
+# degrade contract: the alerts.eval fault site never kills the tick
+# ---------------------------------------------------------------------------
+def test_alerts_eval_fault_degrades_fleet_tick_not_fails_it(tmp_path):
+    from test_fleet import _daemon
+
+    assert "alerts.eval" in faults.SITES
+    faults.install(faults.parse_spec("alerts.eval=every:1"))
+    d = None
+    try:
+        d = _daemon(tmp_path)
+        d.tick()                        # evaluator blows up in-tick
+        assert d._alerts_degraded is True
+        st = d.status()
+        assert st["alerts"]["degraded"] is True
+        assert st["alerts"]["firing"] == []
+        d.tick()                        # sticky, and the tick survives
+        assert d.alerts_status()["degraded"] is True
+    finally:
+        faults.uninstall()
+        if d is not None:
+            d._shutdown()
+
+
+# ---------------------------------------------------------------------------
+# alert-journal invariant: firing-at-end strictness tracks the verdict
+# ---------------------------------------------------------------------------
+def test_check_flags_alert_left_firing_only_on_succeeded_jobs(tmp_path):
+    from test_invariants import _base_journal, _finalize, _write_journal
+
+    job = tmp_path / "job"
+    recs = _base_journal() + [
+        {"t": "alert", "rule": "quarantine-spike", "state": "firing",
+         "severity": "warn", "value": 0.2, "summary": "spike"},
+        {"t": "task", "task": "worker:0", "status": "SUCCEEDED",
+         "session": 0, "exit": 0},
+        {"t": "job_completed", "job": "worker", "session": 0},
+    ]
+    _write_journal(str(job), recs)
+    rep = invariants.check_job_dir(str(job))
+    # unfinished dir: leniency — a note, never a violation
+    assert not [v for v in rep.violations if v.rule == "alert-journal"]
+    _finalize(str(job), status="SUCCEEDED")
+    rep = invariants.check_job_dir(str(job))
+    bad = [v for v in rep.violations if v.rule == "alert-journal"]
+    assert len(bad) == 1
+    assert "quarantine-spike" in bad[0].message
+
+
+# ---------------------------------------------------------------------------
+# the slow e2e drill: SLO fires BEFORE the failure, diagnose cites it
+# ---------------------------------------------------------------------------
+@pytest.mark.slow
+def test_e2e_slow_step_slo_fires_before_hang_and_diagnose_cites_it(
+        tmp_path, capsys):
+    """Watchtower acceptance drill: ``user.slow_step`` drags every step
+    to ~0.42s so the published step rate (~2.4/s) sits under an armed
+    5.0 floor — the step-time SLO burns on both (tightened) windows and
+    transitions to firing while the job is still running. A composed
+    ``user.hang`` then freezes the counter, progress liveness kills the
+    job (no retry budget), and the pipeline must show: ALERT_FIRING
+    before TASK_HUNG, the REC_ALERT firing state surviving in the
+    journal (the --recover seed input), the HANG verdict citing the
+    alert as corroborating evidence, `tony-tpu alerts` replaying the
+    firing set offline, and `tony-tpu check` clean on the artifact."""
+    from test_diagnosis import _job_dir
+    from test_e2e import _dump_task_logs, make_conf, submit
+    from test_e2e_faults import _finished_events
+    from tony_tpu import diagnosis
+
+    conf = make_conf(tmp_path, "steps_for.py", workers=1, extra={
+        K.TASK_HEARTBEAT_INTERVAL_MS: 100,
+        K.TASK_PROGRESS_TIMEOUT_S: 3,
+        K.TASK_PROGRESS_WARMUP_S: 60,
+        K.TASK_HANG_DUMP_GRACE_S: 1,
+        K.APPLICATION_RETRY_COUNT: 0,
+        K.ALERTS_MIN_STEPS_PER_SEC: 5.0,    # arms the step-time SLO
+        K.ALERTS_WINDOW_LONG_S: 2,
+        K.ALERTS_WINDOW_SHORT_S: 1,
+        K.ALERTS_FOR_S: 0.3,
+    })
+    conf.set(K.EXECUTION_ENV,
+             "TONY_TELEMETRY_INTERVAL_S=0.2,TONY_TEST_STEPS=1000")
+    conf.set(K.fault_key("user.slow_step"), "every:1,amt:0.4")
+    conf.set(K.fault_key("user.hang"), "after:6")
+    client, rec, code = submit(conf, tmp_path)
+    assert code == constants.EXIT_FAILURE, _dump_task_logs(client)
+    assert rec.finished[0] == "FAILED"
+
+    # 1. the SLO transitioned to firing BEFORE the terminal verdict
+    evs = _finished_events(tmp_path, rec.app_id)
+    types = [e.type for e in evs]
+    slo_idx = [i for i, e in enumerate(evs)
+               if e.type == "ALERT_FIRING"
+               and e.payload.get("rule") == "step-time-slo"]
+    assert slo_idx, f"step-time-slo never fired; events: {types}"
+    assert evs[slo_idx[0]].payload["severity"] == "page"
+    assert slo_idx[0] < types.index("TASK_HUNG") \
+        < types.index("APPLICATION_FINISHED")
+
+    # 2. the write-ahead REC_ALERT record left the firing state in the
+    #    journal (a FAILED job keeps its alerts as evidence), and a
+    #    fresh engine seeded from the replay re-arms the identical set
+    job_dir = _job_dir(tmp_path, rec.app_id)
+    st = cjournal.replay(os.path.join(job_dir, constants.JOURNAL_FILE))
+    assert st.alerts.get("step-time-slo") == "firing"
+    eng = AlertEngine(AR.default_job_pack())
+    eng.seed(st.alerts)
+    assert "step-time-slo" in {r["rule"] for r in eng.firing()}
+
+    # 3. diagnose: HANG verdict, corroborated by the firing alert
+    inc = diagnosis.load_incident(
+        os.path.join(job_dir, constants.INCIDENT_FILE))
+    assert inc is not None
+    v = inc["verdict"]
+    assert v["category"] == "HANG"
+    assert any("step-time-slo" in e and "firing before the terminal"
+               in e for e in v["evidence"]), v["evidence"]
+
+    # 4. the CLI replays the firing set offline (coordinator is gone)
+    from tony_tpu.cli.main import main
+    assert main(["alerts", rec.app_id,
+                 "--history-root", str(tmp_path / "history")]) == 0
+    out = capsys.readouterr().out
+    assert "step-time-slo" in out and "firing" in out
+
+    # 5. tony-tpu check passes the alert-journal rule on the artifact
+    rep = invariants.check_job_dir(job_dir)
+    assert not [x for x in rep.violations if x.rule == "alert-journal"]

@@ -726,7 +726,7 @@ def test_fleet_fixture_golden_passes_and_bad_fails():
                      "fleet-double-grant", "fleet-terminal",
                      "fleet-capacity", "fleet-decision",
                      "health-quarantine-evidence",
-                     "health-dangling-cordon"}
+                     "health-dangling-cordon", "alert-journal"}
 
 
 def test_daemon_lifecycle_artifacts_pass_invariants(tmp_path):

@@ -309,7 +309,7 @@ def test_rule_engine_categories_cover_the_contract():
     assert set(fdiagnose.CATEGORY_PRECEDENCE) == {
         "SICK_SLICE", "FLAKY_HOST",
         "STARVATION", "QUOTA_SATURATED", "FRAGMENTATION",
-        "PREEMPT_STORM", "POOL_COLD", "FLEET_HEALTHY"}
+        "PREEMPT_STORM", "POOL_COLD", "SLO_BREACH", "FLEET_HEALTHY"}
 
 
 def test_broken_rule_degrades_never_dies(monkeypatch):

@@ -508,6 +508,15 @@ def _render_top(snap: dict) -> str:
     perf = snap.get("perf") or {}
     if perf.get("verdict"):
         lines.append(f"perf: {perf['verdict']} — {perf.get('summary', '')}")
+    al = snap.get("alerts") or {}
+    if al.get("degraded"):
+        lines.append("alerts: DEGRADED — evaluation disabled after a "
+                     "fault")
+    for r in al.get("firing") or []:
+        lines.append(f"ALERT [{r.get('severity', '?')}] "
+                     f"{r.get('rule', '?')} value={r.get('value')}"
+                     + (f" — {r['summary']}" if r.get("summary")
+                        else ""))
     coord = snap.get("coord") or {}
     if coord:
         # Control-plane self row: is the COORDINATOR keeping up — tick
@@ -731,6 +740,83 @@ def _cmd_diagnose(args: argparse.Namespace) -> int:
         print(f"{args.app_id} SUCCEEDED — nothing to diagnose "
               f"(full report follows for the curious)", file=sys.stderr)
     print(diagnosis.render_text(incident))
+    return 0
+
+
+def _render_alert_rows(res: dict) -> str:
+    """Shared `alerts` table for job and fleet scope: one row per rule
+    with its state-machine position, plus firing summaries."""
+    lines = []
+    if res.get("degraded"):
+        lines.append("alerting: DEGRADED — evaluation disabled after a "
+                     "fault (restart the evaluator to re-arm)")
+    rows = res.get("alerts") or []
+    if not rows:
+        lines.append("no alert rules evaluated")
+        return "\n".join(lines)
+    lines.append(f"{'RULE':<22}{'STATE':<9}{'SEV':<6}{'VALUE':>10}  "
+                 f"{'FOR':>7}  SERIES")
+    for r in rows:
+        v = r.get("value")
+        since = r.get("since_s")
+        lines.append(
+            f"{r.get('rule', '?'):<22}{r.get('state', '?'):<9}"
+            f"{r.get('severity', '?'):<6}"
+            f"{(f'{v:.4g}' if v is not None else '-'):>10}  "
+            f"{(f'{since:.0f}s' if since is not None else '-'):>7}  "
+            f"{r.get('series', '')}")
+    for r in rows:
+        if r.get("state") == "firing" and r.get("summary"):
+            lines.append(f"  {r['rule']}: {r['summary']}")
+    return "\n".join(lines)
+
+
+def _cmd_alerts(args: argparse.Namespace) -> int:
+    """SLO/alert state for one job: a RUNNING job answers live from its
+    coordinator's alert engine (the alerts RPC); otherwise the
+    write-ahead REC_ALERT records in the session journal are replayed —
+    the firing set survives the coordinator, by design."""
+    rpc = _coordinator_rpc(args.app_id, args.workdir)
+    if rpc is not None:
+        try:
+            res = rpc.call("alerts")
+            if args.json:
+                print(json.dumps(res, indent=1, sort_keys=True))
+            else:
+                print(_render_alert_rows(res))
+            return 0
+        except Exception as e:  # noqa: BLE001
+            print(f"(coordinator unreachable: {e}; replaying the "
+                  f"journal)", file=sys.stderr)
+    from tony_tpu import constants
+    from tony_tpu.coordinator import journal as cjournal
+    from tony_tpu.events import history
+
+    root = _history_root(args)
+    job_dir = history.list_job_dirs(root).get(args.app_id)
+    if job_dir is None:
+        print(f"unknown application {args.app_id} under {root}",
+              file=sys.stderr)
+        return 1
+    path = os.path.join(job_dir, constants.JOURNAL_FILE)
+    if not os.path.exists(path):
+        print(f"no session journal at {path} — the job ran without "
+              f"tony.coordinator.journal-enabled, so no alert "
+              f"transitions were recorded", file=sys.stderr)
+        return 1
+    st = cjournal.replay(path)
+    doc = {"app_id": args.app_id, "scope": "job", "offline": True,
+           "alerts": [{"rule": rule, "state": state}
+                      for rule, state in sorted(st.alerts.items())]}
+    if args.json:
+        print(json.dumps(doc, indent=1, sort_keys=True))
+        return 0
+    if not st.alerts:
+        print("no alert transitions journaled")
+        return 0
+    print("journal replay (final state per rule):")
+    for rule, state in sorted(st.alerts.items()):
+        print(f"  {rule:<22}{state}")
     return 0
 
 
@@ -1288,6 +1374,15 @@ def _render_fleet_top(snap: dict) -> str:
             + (", ".join(health["cordoned"]) or "-")
             + (f"  sick slices: {health['sick_slices']}"
                if health.get("sick_slices") else ""))
+    fal = snap.get("alerts") or {}
+    if fal.get("degraded"):
+        lines.append("alerts: DEGRADED — evaluation disabled after a "
+                     "fault")
+    for r in fal.get("firing") or []:
+        lines.append(f"ALERT [{r.get('severity', '?')}] "
+                     f"{r.get('rule', '?')} value={r.get('value')}"
+                     + (f" — {r['summary']}" if r.get("summary")
+                        else ""))
     tenants = snap.get("tenants") or {}
     if tenants:
         def _tenant_cell(t, row):
@@ -1434,6 +1529,41 @@ def _cmd_fleet(args: argparse.Namespace) -> int:
             print(json.dumps(doc, indent=1, sort_keys=True))
         else:
             print(fdiagnose.render_text(doc))
+        return 0
+    if args.fleet_cmd == "alerts":
+        # Dual-path like explain: a live daemon answers from its
+        # engine; otherwise the REC_FLEET_ALERT records are replayed.
+        from tony_tpu.fleet import journal as fjournal
+        from tony_tpu.fleet.journal import FleetJournalError
+
+        client = FleetClient(fleet_dir)
+        try:
+            res = client.alerts()
+        except FleetClientError:
+            try:
+                st = fjournal.replay(os.path.join(
+                    fleet_dir, constants.FLEET_JOURNAL_FILE))
+            except FleetJournalError as e:
+                print(f"{e}", file=sys.stderr)
+                return 1
+            res = {"fleet_dir": fleet_dir, "scope": "fleet",
+                   "offline": True,
+                   "alerts": [{"rule": rule, "state": state}
+                              for rule, state
+                              in sorted(st.alerts.items())]}
+        finally:
+            client.close()
+        if args.json:
+            print(json.dumps(res, indent=1, sort_keys=True))
+        elif res.get("offline"):
+            if not res["alerts"]:
+                print("no fleet alert transitions journaled")
+            else:
+                print("journal replay (final state per rule):")
+                for row in res["alerts"]:
+                    print(f"  {row['rule']:<22}{row['state']}")
+        else:
+            print(_render_alert_rows(res))
         return 0
     if args.fleet_cmd == "explain":
         from tony_tpu.fleet import diagnose as fdiagnose
@@ -1760,6 +1890,18 @@ def build_parser() -> argparse.ArgumentParser:
                          "coordinator already wrote incident.json")
     dg.set_defaults(fn=_cmd_diagnose)
 
+    al = sub.add_parser(
+        "alerts",
+        help="SLO/alert state for a job: live rule-engine rows from a "
+             "running coordinator, or the journaled REC_ALERT "
+             "transitions replayed for a finished/dead one")
+    al.add_argument("app_id")
+    al.add_argument("--workdir", help="client workdir the job was "
+                                      "submitted from (default ~/.tony-tpu)")
+    al.add_argument("--history-root")
+    al.add_argument("--json", action="store_true")
+    al.set_defaults(fn=_cmd_alerts)
+
     h = sub.add_parser("history", help="list finished jobs")
     h.add_argument("--history-root")
     h.set_defaults(fn=_cmd_history)
@@ -1979,6 +2121,18 @@ def build_parser() -> argparse.ArgumentParser:
     fh.add_argument("--conf-file")
     fh.add_argument("--conf", action="append", metavar="K=V")
     fh.set_defaults(fn=_cmd_fleet)
+    fa = fl_sub.add_parser(
+        "alerts",
+        help="fleet-scope SLO/alert state: live rule-engine rows from "
+             "a running daemon, or the journaled REC_FLEET_ALERT "
+             "transitions replayed for a dead one")
+    fa.add_argument("--dir")
+    fa.add_argument("--workdir")
+    fa.add_argument("--json", action="store_true",
+                    help="print the raw alerts document")
+    fa.add_argument("--conf-file")
+    fa.add_argument("--conf", action="append", metavar="K=V")
+    fa.set_defaults(fn=_cmd_fleet)
 
     ln = sub.add_parser(
         "lint",

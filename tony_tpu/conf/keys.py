@@ -394,6 +394,86 @@ METRICS_EXPORT_INTERVAL_S = _key(
     "into <job_dir>/metrics.prom (the portal /metrics scrape source) and "
     "snapshots counters for recovery. Control-plane-rate, not per-step.")
 
+# --- alerting & SLOs (tony_tpu/alerts/) ------------------------------------
+ALERTS_ENABLED = _key(
+    "tony.alerts.enabled", True, bool,
+    "Evaluate the default alert packs: job-scope rules on the "
+    "coordinator monitor tick, fleet-scope rules on the fleet daemon "
+    "tick. Both run behind the never-blocks-the-tick degrade contract "
+    "(an evaluator crash disables alerting for that process life with "
+    "one warning, never the tick). See docs/operations.md "
+    "'Alerting & SLOs'.")
+ALERTS_FOR_S = _key(
+    "tony.alerts.for-s", 10.0, float,
+    "Base for-duration (hysteresis) of the job-scope default pack: a "
+    "breach must persist this long in `pending` before the rule fires — "
+    "one bad tick never pages. Slower rules (input-bound, fsync-p99) "
+    "use a multiple of this.")
+ALERTS_FLEET_FOR_S = _key(
+    "tony.alerts.fleet-for-s", 60.0, float,
+    "For-duration of the fleet-scope default pack. Deliberately long: "
+    "a fleet alert is a capacity/goodput story measured in minutes, "
+    "not a single-tick blip.")
+ALERTS_HEARTBEAT_AGE_S = _key(
+    "tony.alerts.heartbeat-age-s", 30.0, float,
+    "heartbeat-age rule threshold: page when any task's "
+    "tony_task_heartbeat_age_seconds exceeds this — the gang is about "
+    "to lose a member (the liveness reaper fires at "
+    "max-missed-heartbeats x interval; this alert leads it).")
+ALERTS_DATA_WAIT_FRACTION = _key(
+    "tony.alerts.data-wait-fraction", 0.5, float,
+    "input-bound rule threshold: warn when the windowed rate of the "
+    "cumulative data_wait step phase (= fraction of wall time spent "
+    "waiting on input) exceeds this — the live form of the post-hoc "
+    "INPUT_BOUND verdict.")
+ALERTS_FSYNC_P99_S = _key(
+    "tony.alerts.fsync-p99-s", 0.05, float,
+    "journal-fsync-p99 rule threshold (seconds): warn when the "
+    "windowed p99 of tony_journal_fsync_seconds breaches it. Default "
+    "aims ROADMAP item 3 by numbers — BENCH_SCALE_r01 measured p99 "
+    "63ms at 512 virtual tasks, the JOURNAL_BOUND regime.")
+ALERTS_MIN_STEPS_PER_SEC = _key(
+    "tony.alerts.min-steps-per-sec", 0.0, float,
+    "step-time-slo floor: a task sample below this steps/s rate is "
+    "'bad' for the SLO's error budget. 0 disarms the SLO (the default "
+    "— a universal floor would misfire across model sizes); set it "
+    "per job from the model's known-good rate.")
+ALERTS_SLO_OBJECTIVE = _key(
+    "tony.alerts.slo-objective", 0.9, float,
+    "SLO objective for the default burn-rate rules: the error budget "
+    "is 1-objective (0.9 → 10% of samples may breach before the "
+    "budget is spent).")
+ALERTS_WINDOW_LONG_S = _key(
+    "tony.alerts.window-long-s", 300.0, float,
+    "Long burn-rate window of the job-scope SLOs (the fleet pack "
+    "scales it up). Both windows must burn past the factor to fire — "
+    "long resists blips, short makes recovery resolve fast.")
+ALERTS_WINDOW_SHORT_S = _key(
+    "tony.alerts.window-short-s", 60.0, float,
+    "Short burn-rate window of the job-scope SLOs (the fleet pack "
+    "scales it up).")
+ALERTS_BURN_FACTOR = _key(
+    "tony.alerts.burn-factor", 2.0, float,
+    "Burn-rate factor: fire when the error budget burns at this "
+    "multiple of the steady-state rate on BOTH windows (2.0 = the "
+    "budget would be gone in half the objective period).")
+ALERTS_GOODPUT_FLOOR = _key(
+    "tony.alerts.goodput-floor", 0.5, float,
+    "goodput-slo floor: a fleet-wide tony_fleet_goodput_fraction "
+    "sample below this is 'bad' for the fleet SLO's budget — "
+    "chip-seconds burning on overhead, not train steps.")
+ALERTS_QUARANTINE_PER_MIN = _key(
+    "tony.alerts.quarantine-rate-per-min", 3.0, float,
+    "quarantine-spike rule threshold: warn when host quarantines are "
+    "applied faster than this per minute (windowed rate of "
+    "tony_fleet_quarantines_total) — a correlated hardware event or a "
+    "flapping health scorer.")
+ALERTS_QUEUE_WAIT_P99_S = _key(
+    "tony.alerts.queue-wait-p99-s", 600.0, float,
+    "queue-wait-p99 rule threshold (seconds): warn when the windowed "
+    "p99 submit-to-grant wait breaches it — the pool is starved or "
+    "fragmented.")
+
 # --- control-plane self-observation (coordinator/coordphases.py) ----------
 COORD_PHASE_RING_TICKS = _key(
     "tony.coord.phase-ring-ticks", 256, int,
@@ -824,6 +904,12 @@ FAULT_HEALTH_PROBE = _key(
     "filtered per host via 'task:<host>'. The grant must self-repair: "
     "cordon the failing host and substitute a spare before anything "
     "spawns on it.")
+FAULT_ALERTS_EVAL = _key(
+    "tony.fault.alerts-eval", "", str,
+    "Fail an alert-pack evaluation (coordinator monitor tick or fleet "
+    "daemon tick, tony_tpu/alerts/) — the broken-evaluator shape. The "
+    "tick must degrade: alerting disables for the rest of that process "
+    "life with one warning; scheduling/monitoring never block.")
 
 # --- warm executor pool (tony_tpu/pool.py) --------------------------------
 POOL_DIR = _key(
@@ -1073,7 +1159,7 @@ _RESERVED_NON_JOB_SEGMENTS = {
     "application", "task", "coordinator", "client", "history", "tpu", "portal",
     "keep-failed-task-dirs", "internal", "fault", "rpc", "trace", "metrics",
     "diagnosis", "pool", "elastic", "profile", "train", "coord", "scale",
-    "fleet", "health",
+    "fleet", "health", "alerts",
 }
 
 

@@ -30,6 +30,7 @@ import time
 from typing import Dict, List, Optional
 
 from tony_tpu import constants, faults, tracing
+from tony_tpu.alerts import AlertEngine, RegistrySource, default_job_pack
 from tony_tpu.cluster.base import Backend, TaskLaunchSpec
 from tony_tpu.metrics import MetricsRegistry
 from tony_tpu.conf.config import TonyTpuConfig
@@ -150,6 +151,10 @@ class _RpcService:
         span log, stitching the cross-process trace tree."""
         return self._c.ingest_trace_records(records)
 
+    def alerts(self) -> dict:
+        """Live alert state (`tony-tpu alerts <app>`, portal banner)."""
+        return self._c.alerts_snapshot()
+
 
 @guarded
 class Coordinator:
@@ -183,6 +188,7 @@ class Coordinator:
         "_schedule_start": None,
         "_worker_termination_done": None,
         "_final_conf_path": None,
+        "_alerts_degraded": None,
         "_prom_last_write": None,
         "_prom_thread": None,
         "_run_span": None,
@@ -381,6 +387,17 @@ class Coordinator:
         self.coordphases = CoordPhases(
             conf.get_int(K.COORD_PHASE_RING_TICKS, 256))
         self._coord_counter_prev: Dict[str, float] = {}
+
+        # --- alerting (tony_tpu/alerts/): the job-scope rule pack,
+        # evaluated on the monitor tick behind the never-blocks-the-tick
+        # degrade contract (fault site "alerts.eval"). Every transition
+        # is journaled write-ahead as REC_ALERT; on --recover the
+        # replayed last-state-per-rule re-arms the engine, so a firing
+        # alert survives a coordinator SIGKILL with no duplicate record.
+        self._alerts_degraded = not conf.get_bool(K.ALERTS_ENABLED, True)
+        self.alerts = AlertEngine(default_job_pack(conf))
+        if st is not None and st.alerts:
+            self.alerts.seed(st.alerts)
 
         if rpc_token is None and conf.get_bool(K.APPLICATION_SECURITY_ENABLED):
             import secrets
@@ -758,7 +775,68 @@ class Coordinator:
             # section): control-plane health must be visible DURING an
             # incident, not only in post-hoc metrics.
             snap["coord"] = coord
+        firing = self.alerts.firing()
+        if firing or self._alerts_degraded:
+            # Firing alerts ride the top feed (alert rows in `tony-tpu
+            # top`): a page-worthy breach must be on the screen the
+            # operator is already watching.
+            snap["alerts"] = {"degraded": self._alerts_degraded,
+                              "firing": firing}
         return snap
+
+    # ------------------------------------------------------------------
+    # Alerting (tony_tpu/alerts/)
+    # ------------------------------------------------------------------
+    def _alerts_tick(self) -> None:
+        """Evaluate the job-scope alert pack against the live registry.
+        Degrade contract (the fleet.ledger shape): any evaluator failure
+        disables alerting for the rest of this coordinator life with one
+        warning — the monitor tick never blocks or fails on its own
+        observability."""
+        if self._alerts_degraded:
+            return
+        try:
+            faults.check("alerts.eval")
+            for tr in self.alerts.evaluate(RegistrySource(self.metrics)):
+                self._apply_alert_transition(tr)
+        except Exception as e:  # noqa: BLE001 — observability, not duty
+            self._alerts_degraded = True
+            log.warning(
+                "alert evaluation failed (%s) — degrading: alerting "
+                "disabled for the rest of this coordinator life", e)
+
+    def _apply_alert_transition(self, tr) -> None:
+        """Surface one state-machine step: REC_ALERT write-ahead (dedup-
+        fenced by the engine), then the transition counter, the firing
+        gauge, and the ALERT_FIRING/ALERT_RESOLVED event (pending stays
+        journal-and-counter only — one bad tick never pages, and it
+        never spams the event stream either)."""
+        if tr.journal:
+            self.journal.alert(tr.rule, tr.state, tr.severity, tr.value,
+                               tr.labels, tr.summary)
+        self.metrics.counter(
+            "tony_alert_transitions_total", {"state": tr.state},
+            help="alert state-machine transitions journaled").inc()
+        for sev, n in self.alerts.firing_count().items():
+            self.metrics.gauge(
+                "tony_alerts_firing", {"severity": sev},
+                help="alerts currently firing, by severity").set(n)
+        payload = {"rule": tr.rule, "severity": tr.severity,
+                   "value": tr.value, "labels": tr.labels,
+                   "summary": tr.summary, "scope": "job"}
+        if tr.state == "firing":
+            log.warning("ALERT firing [%s]: %s (value=%s %s)",
+                        tr.severity, tr.rule, tr.value, tr.labels)
+            self.events.emit(Event(EventType.ALERT_FIRING, payload))
+        elif tr.state == "resolved":
+            log.info("alert resolved: %s", tr.rule)
+            self.events.emit(Event(EventType.ALERT_RESOLVED, payload))
+
+    def alerts_snapshot(self) -> dict:
+        """The `alerts` RPC: full per-rule state for the CLI/portal."""
+        return {"app_id": self.app_id, "scope": "job",
+                "degraded": self._alerts_degraded,
+                "alerts": self.alerts.snapshot()}
 
     def metrics_push(self, task_id: str, metrics: dict) -> bool:
         """metrics.push intake (reference ``rpc/MetricsRpc.java``):
@@ -2527,6 +2605,7 @@ class Coordinator:
             with self.coordphases.phase("hb_scan"):
                 self._check_heartbeats()
             self._check_progress()
+            self._alerts_tick()
             self._elastic_tick()
             if self.session.status != SessionStatus.RUNNING:
                 return self.session.status
@@ -2664,6 +2743,18 @@ class Coordinator:
                                                      "profile"))
             except Exception as e:  # noqa: BLE001 — teardown best-effort
                 log.warning("profile trace localization failed: %s", e)
+        if self.final_status == SessionStatus.SUCCEEDED \
+                and not self._alerts_degraded:
+            # A SUCCEEDED job's journal must not end with an alert
+            # firing (the alert-journal invariant): force-resolve every
+            # open rule while the journal and event stream are still
+            # writable. Failed jobs deliberately KEEP their firing
+            # alerts — they are the diagnosis engine's evidence.
+            try:
+                for tr in self.alerts.resolve_all():
+                    self._apply_alert_transition(tr)
+            except Exception:  # noqa: BLE001 — teardown best-effort
+                log.exception("alert teardown resolve failed")
         # Step-time attribution report BEFORE diagnosis: the incident
         # bundle attaches perf.json as its perf advisory section.
         self._write_perf_report()

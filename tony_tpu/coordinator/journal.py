@@ -49,6 +49,8 @@ REC_PROGRESS = "progress"         # throttled task step-counter checkpoint
 REC_RESIZE = "resize"             # elastic membership change (start/applied)
 REC_MIGRATE = "migrate"           # live slice migration (start/applied/
                                   # superseded) — coordinator/migrate.py
+REC_ALERT = "alert"               # alert state transition (pending/
+                                  # firing/resolved) — tony_tpu/alerts/
 
 
 class JournalError(RuntimeError):
@@ -118,6 +120,13 @@ class ReplayState:
         default_factory=list)
     inflight_migrate_target: str = ""
     inflight_migrate_reason: str = ""
+    # --- alerting (tony_tpu/alerts/) -----------------------------------
+    # Last journaled state per alert rule (last-wins fold). NOT cleared
+    # on REC_EPOCH: an alert watches the job across retry epochs — a
+    # heartbeat alert that fired in epoch 2 is still firing while epoch
+    # 3's gang launches. Seeds AlertEngine.seed() on --recover so a
+    # firing alert survives a coordinator SIGKILL.
+    alerts: Dict[str, str] = dataclasses.field(default_factory=dict)
 
 
 class SessionJournal:
@@ -242,6 +251,22 @@ class SessionJournal:
                      "members": sorted(int(m) for m in members),
                      "phase": phase, "target": target,
                      "session": session_id, "reason": reason})
+
+    def alert(self, rule: str, state: str, severity: str,
+              value: Optional[float], labels: Dict[str, str],
+              summary: str) -> None:
+        """Alert state-machine transition (tony_tpu/alerts/). Write-ahead
+        like everything else: the record lands BEFORE the ALERT_FIRING/
+        ALERT_RESOLVED event or gauge update, so a recovered coordinator
+        re-arms the exact firing set. The engine's dedup fence guarantees
+        consecutive records for a rule never repeat a state."""
+        rec = {"t": REC_ALERT, "rule": rule, "state": state,
+               "severity": severity, "summary": summary}
+        if value is not None:
+            rec["value"] = float(value)
+        if labels:
+            rec["labels"] = dict(labels)
+        self.append(rec)
 
     def close(self) -> None:
         if self._log is not None:
@@ -439,6 +464,12 @@ def replay(path: str) -> ReplayState:
                 state.inflight_migrate_target = target
                 state.inflight_migrate_reason = str(
                     rec.get("reason", "") or "")
+        elif t == REC_ALERT:
+            # Last-wins per rule; deliberately NOT epoch-scoped (see the
+            # ReplayState field comment).
+            rule = str(rec.get("rule", "") or "")
+            if rule:
+                state.alerts[rule] = str(rec.get("state", "") or "")
         elif t == REC_VERDICT:
             pass                   # forensic record; no folded state
         else:

@@ -73,6 +73,13 @@ health-dangling-cordon   every manual (operator) cordon is closed by
                          an uncordon before the journal ends — manual
                          cordons never auto-expire, so a dangling one
                          is capacity silently lost
+alert-journal            REC_ALERT / REC_FLEET_ALERT transitions carry
+                         valid states and are dedup-fenced per (rule,
+                         state) — never re-journaled per tick — and a
+                         SUCCEEDED job's journal never ends with a
+                         rule still firing (the teardown resolve);
+                         failure paths keep the firing record as
+                         diagnosis evidence (note, not violation)
 =======================  ==================================================
 
 Surfaces: ``tony-tpu check <app|job_dir>`` (and the no-deps module CLI
@@ -198,6 +205,10 @@ def _check_journal(path: str, rel: str, rep: Report,
     open_migrate: Dict[str, Tuple[int, int, str]] = {}
     # task → folded status for the current epoch
     tasks: Dict[str, str] = {}
+    # alert rule → (record_idx, last journaled state). Deliberately NOT
+    # cleared on REC_EPOCH: alerts watch the job across retry epochs
+    # (mirror replay()).
+    alert_state: Dict[str, Tuple[int, str]] = {}
     for idx, rec in records:
         t = rec.get("t")
         ev = json.dumps(rec, sort_keys=True)
@@ -294,6 +305,23 @@ def _check_journal(path: str, rel: str, rep: Report,
                 open_migrate.pop(job, None)
             else:
                 open_migrate[job] = (idx, mgen, target)
+        elif t == journal_mod.REC_ALERT:
+            rule = str(rec.get("rule", "") or "")
+            state = str(rec.get("state", "") or "")
+            if state not in ("pending", "firing", "resolved"):
+                rep.violations.append(Violation(
+                    "alert-journal", rel, idx,
+                    f"alert record for rule {rule!r} carries unknown "
+                    f"state {state!r} — only pending/firing/resolved "
+                    f"are journaled transitions", ev))
+            elif alert_state.get(rule, (0, ""))[1] == state:
+                rep.violations.append(Violation(
+                    "alert-journal", rel, idx,
+                    f"consecutive identical alert state {state!r} for "
+                    f"rule {rule!r} — transitions must be dedup-fenced "
+                    f"per (rule, state), never re-journaled per tick "
+                    f"(the bounded-journal contract)", ev))
+            alert_state[rule] = (idx, state)
         elif t in (journal_mod.REC_REGISTER, journal_mod.REC_TASK,
                    journal_mod.REC_PROGRESS, journal_mod.REC_VERDICT,
                    journal_mod.REC_JOB_SCHEDULED,
@@ -346,6 +374,18 @@ def _check_journal(path: str, rel: str, rep: Report,
         else:
             # A coordinator killed mid-migration legitimately leaves
             # the start open — that IS the recover re-entry record.
+            rep.notes.append(f"{rel}:{idx}: {msg}")
+    for rule, (idx, state) in sorted(alert_state.items()):
+        if state != "firing":
+            continue
+        msg = (f"alert rule {rule!r} is still firing when the journal "
+               f"ends — a SUCCEEDED teardown resolves every alert "
+               f"(resolve_all); on a failure path the firing record is "
+               f"the diagnosis evidence")
+        if strict:
+            rep.violations.append(Violation(
+                "alert-journal", rel, idx, msg))
+        else:
             rep.notes.append(f"{rel}:{idx}: {msg}")
     return n_gens, clean and n_gens <= 1
 
@@ -474,10 +514,33 @@ def _check_fleet_journal(path: str, rel: str, rep: Report) -> None:
     # records replay across daemon lives — last-wins per host — so the
     # fold deliberately survives fgen bumps).
     open_manual: Dict[str, int] = {}
+    # alert rule → last journaled state. Survives fgen bumps like the
+    # health fold (a recovered daemon seeds its engine from the replay,
+    # so the dedup fence carries across lives); a fleet journal MAY end
+    # firing — the daemon is long-lived, there is no SUCCEEDED teardown.
+    falert_state: Dict[str, str] = {}
     for idx, rec in records:
         t = rec.get("t")
         ev = json.dumps(rec, sort_keys=True)
         job = str(rec.get("job", "") or "")
+        if t == fj.REC_FLEET_ALERT:
+            rule = str(rec.get("rule", "") or "")
+            state = str(rec.get("state", "") or "")
+            if state not in ("pending", "firing", "resolved"):
+                rep.violations.append(Violation(
+                    "alert-journal", rel, idx,
+                    f"fleet alert record for rule {rule!r} carries "
+                    f"unknown state {state!r} — only pending/firing/"
+                    f"resolved are journaled transitions", ev))
+            elif falert_state.get(rule) == state:
+                rep.violations.append(Violation(
+                    "alert-journal", rel, idx,
+                    f"consecutive identical alert state {state!r} for "
+                    f"fleet rule {rule!r} — transitions must be "
+                    f"dedup-fenced per (rule, state), never "
+                    f"re-journaled per tick", ev))
+            falert_state[rule] = state
+            continue
         if t == fj.REC_FLEET_HEALTH:
             host = str(rec.get("host", "") or "")
             state = str(rec.get("state", "") or "")

@@ -92,6 +92,9 @@ RULES: Dict[str, str] = {
                      "locks",
     "bare-except": "no bare except:",
     "defaults-md": "conf/defaults.md matches the key registry",
+    "alert-registry": "default alert-pack series resolve in "
+                      "metrics.SERIES and every shipped rule is "
+                      "exercised by a test",
 }
 # v2 protocol rules (devtools/protocol.py): the coordinator↔executor
 # directive/journal/fence/beacon/terminal/metrics contracts, both sides.
@@ -272,6 +275,8 @@ class Linter:
                     fn(src)
         if "fault-site" in active:
             self._check_fault_sites(pkg_srcs)
+        if "alert-registry" in active:
+            self._check_alert_registry(pkg_srcs)
         if "rpc-parity" in active:
             self._check_rpc_parity(pkg_srcs)
         if "defaults-md" in active:
@@ -356,6 +361,66 @@ class Linter:
                 f"fault site {site!r} is listed in faults.SITES but has "
                 f"no fire/check call site — dead site or missed wiring",
                 faults_rel)
+
+    # -- alert-registry --------------------------------------------------
+    def _check_alert_registry(self, srcs: List[_Src]) -> None:
+        """Both directions of the default alert-pack contract: every
+        metric family a shipped rule evaluates must be a registered
+        ``metrics.SERIES`` entry (an alert over a family nobody emits
+        can never fire), and every shipped rule name must appear as a
+        string literal in some test (a rule nobody exercises is a
+        paging policy with no proof)."""
+        from tony_tpu import metrics as M
+        from tony_tpu.alerts import rules as AR
+
+        pack = list(AR.default_job_pack()) + list(AR.default_fleet_pack())
+        rules_src = None
+        for src in srcs:
+            if src.rel.endswith(os.path.join("alerts", "rules.py")):
+                rules_src = src
+                break
+        rules_rel = (rules_src.rel if rules_src
+                     else os.path.join("tony_tpu", "alerts", "rules.py"))
+
+        def _literal_line(text: str) -> int:
+            if rules_src is not None:
+                for node in ast.walk(rules_src.tree):
+                    if _const_str(node) == text:
+                        return node.lineno
+            return 1
+
+        for rule in pack:
+            if rule.series not in M.SERIES:
+                self._emit(
+                    "alert-registry", rules_rel,
+                    _literal_line(rule.series),
+                    f"default alert rule {rule.name!r} evaluates metric "
+                    f"family {rule.series!r}, which is not registered in "
+                    f"metrics.SERIES — it can never fire", rules_src)
+        tests_dir = os.path.join(self.root, "tests")
+        if not os.path.isdir(tests_dir):
+            self._emit(
+                "alert-registry", rules_rel, 1,
+                "tests/ directory not found — cannot prove the default "
+                "alert pack is exercised by tests", rules_src)
+            return
+        names = {r.name for r in pack}
+        referenced: Set[str] = set()
+        for src in self._sources(tests_dir):
+            for node in ast.walk(src.tree):
+                text = _const_str(node)
+                if text is not None and text in names:
+                    referenced.add(text)
+            if referenced == names:
+                break
+        for rule in pack:
+            if rule.name not in referenced:
+                self._emit(
+                    "alert-registry", rules_rel,
+                    _literal_line(rule.name),
+                    f"default alert rule {rule.name!r} is not referenced "
+                    f"by any test under tests/ — every shipped rule must "
+                    f"be exercised", rules_src)
 
     # -- event-type ------------------------------------------------------
     def _check_event_types(self, src: _Src) -> None:

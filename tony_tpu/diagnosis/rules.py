@@ -34,15 +34,19 @@ INFRA_STORM = "INFRA_STORM"
 COORDINATOR_LOSS = "COORDINATOR_LOSS"
 PORT_RENDEZVOUS = "PORT_RENDEZVOUS"
 GANG_RESIZE = "GANG_RESIZE"
+SLO_BREACH = "SLO_BREACH"
 UNKNOWN = "UNKNOWN"
 
 #: verdict precedence, most specific first: explicit verdicts the
 #: control plane already made, then backend attribution, then log-shape
-#: heuristics, then the fallback.
+#: heuristics, then the fallback. SLO_BREACH sits just above UNKNOWN:
+#: "an alert was firing" is real evidence but every structural verdict
+#: explains MORE — the alert instead boosts whichever structural
+#: finding it corroborates (see ``_ALERT_CATEGORY`` / ``run_rules``).
 CATEGORY_PRECEDENCE = (
     COORDINATOR_LOSS, GANG_RESIZE, HANG, STRAGGLER_CASCADE, PREEMPTION,
     OOM_HBM, OOM_RSS, PORT_RENDEZVOUS, INFRA_STORM, USER_TRACEBACK,
-    UNKNOWN)
+    SLO_BREACH, UNKNOWN)
 
 
 @dataclasses.dataclass
@@ -456,6 +460,47 @@ def _user_traceback(b: IncidentBundle) -> Optional[Finding]:
         details={"exception": last})
 
 
+def _alerts_still_firing(b: IncidentBundle) -> Dict[str, dict]:
+    """Alert rules whose final journaled state in the event stream is
+    firing: more ALERT_FIRING than ALERT_RESOLVED emissions (the state
+    machine strictly alternates them per rule), payload of the last
+    firing kept as the evidence."""
+    fired: Dict[str, List[dict]] = {}
+    for e in b.events_of("ALERT_FIRING"):
+        fired.setdefault(str(e.payload.get("rule", "")),
+                         []).append(e.payload)
+    for e in b.events_of("ALERT_RESOLVED"):
+        rule = str(e.payload.get("rule", ""))
+        if fired.get(rule):
+            fired[rule].pop(0)
+    return {rule: payloads[-1]
+            for rule, payloads in fired.items() if payloads}
+
+
+@_rule("slo-breach", SLO_BREACH, ("ALERT_FIRING", "ALERT_RESOLVED"))
+def _slo_breach(b: IncidentBundle) -> Optional[Finding]:
+    """The alert engine saw the job breach an SLO before the terminal
+    verdict and the alert never resolved. Structural rules outrank
+    this; it carries the diagnosis alone only when nothing else
+    matched (e.g. the job was killed by the operator mid-breach)."""
+    firing = _alerts_still_firing(b)
+    if not firing:
+        return None
+    worst = sorted(firing.items(), key=lambda kv: (
+        0 if kv[1].get("severity") == "page" else 1, kv[0]))[0]
+    ev = [f"events: ALERT_FIRING {rule} [{p.get('severity', '?')}] "
+          f"value={p.get('value')} — never resolved"
+          for rule, p in sorted(firing.items())]
+    if worst[1].get("summary"):
+        ev.append(f"alert summary: {worst[1]['summary']}")
+    return Finding(
+        SLO_BREACH, "slo-breach",
+        f"alert {worst[0]!r} was firing when the job ended and never "
+        f"resolved — the SLO broke before the terminal verdict",
+        blamed_task=_blame(b), confidence=0.6, evidence=ev,
+        details={"rules": sorted(firing)})
+
+
 @_rule("unknown", UNKNOWN, ("APPLICATION_FINISHED",))
 def _unknown(b: IncidentBundle) -> Optional[Finding]:
     """Fallback: a non-SUCCEEDED job always gets at least this."""
@@ -482,10 +527,26 @@ def _final_exception_line(traceback_text: str) -> str:
 
 
 # -- engine ----------------------------------------------------------------
+#: default-pack alert rule → the failure category it corroborates. An
+#: alert left firing at job end is a precedence-boosted input: the
+#: matching structural finding gains confidence and cites the alert.
+_ALERT_CATEGORY = {
+    "heartbeat-age": INFRA_STORM,    # executor silence precedes vanish
+    "step-time-slo": HANG,           # step rate collapsed first
+    "input-bound": STRAGGLER_CASCADE,
+    "journal-fsync-p99": INFRA_STORM,
+}
+
+
 def run_rules(bundle: IncidentBundle) -> List[Finding]:
     """All findings, verdict-candidate first (category precedence, then
     confidence). Rules never raise out of the engine — a broken rule
-    downgrades to absent, it cannot take the whole diagnosis down."""
+    downgrades to absent, it cannot take the whole diagnosis down.
+
+    Post-pass: alerts left firing at job end (``_alerts_still_firing``)
+    boost the confidence of findings in the category the alert
+    corroborates — the live SLO engine saw the breach develop BEFORE
+    the terminal verdict, which is stronger than post-hoc log shape."""
     import logging
 
     findings: List[Finding] = []
@@ -498,6 +559,21 @@ def run_rules(bundle: IncidentBundle) -> List[Finding]:
             continue
         if f is not None:
             findings.append(f)
+    try:
+        firing = _alerts_still_firing(bundle)
+    except Exception:  # noqa: BLE001 — same degrade contract as rules
+        logging.getLogger(__name__).exception(
+            "alert-evidence post-pass failed")
+        firing = {}
+    for f in findings:
+        corroborating = sorted(
+            rule for rule in firing
+            if _ALERT_CATEGORY.get(rule) == f.category)
+        if corroborating and f.category != SLO_BREACH:
+            f.confidence = min(0.99, f.confidence + 0.1)
+            f.evidence.append(
+                f"alerts: {corroborating} firing before the terminal "
+                f"verdict (corroborating — see `tony-tpu alerts`)")
     prec = {c: i for i, c in enumerate(CATEGORY_PRECEDENCE)}
     findings.sort(key=lambda f: (prec.get(f.category, len(prec)),
                                  -f.confidence))

@@ -126,6 +126,20 @@ class EventType(str, enum.Enum):
     # sick — cordoned and queued for evacuation migration; payload:
     # slice, hosts.
     FLEET_SLICE_CORDONED = "FLEET_SLICE_CORDONED"
+    # Alerting (tony_tpu/alerts/): a rule completed its for-duration and
+    # transitioned to FIRING — the breach is real, not a blip. Emitted
+    # by the coordinator monitor tick (job-scope rules, into the job's
+    # event stream) or the fleet daemon tick (fleet-scope rules, into
+    # the fleet stream), AFTER the REC_ALERT/REC_FLEET_ALERT record is
+    # journaled write-ahead; payload: rule, severity, value, labels,
+    # summary, scope ("job"|"fleet"). An alert firing before a failure
+    # becomes precedence-boosted diagnosis evidence.
+    ALERT_FIRING = "ALERT_FIRING"
+    # The firing (or pending) rule returned below threshold — one good
+    # evaluation resolves; payload mirrors ALERT_FIRING. A SUCCEEDED
+    # job's teardown force-resolves every open alert, so its journal
+    # never ends with an alert firing (the alert-journal invariant).
+    ALERT_RESOLVED = "ALERT_RESOLVED"
 
 
 @dataclasses.dataclass

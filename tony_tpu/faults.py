@@ -223,7 +223,7 @@ SITES = ("rpc.connect", "rpc.send", "rpc.slow", "heartbeat",
          "fleet.grant", "fleet.preempt", "fleet.ledger", "fleet.explain",
          "ckpt.async-write", "migrate.snapshot", "migrate.adopt",
          "slice.preempt", "rpc.partition", "disk.full", "disk.torn",
-         "host.flaky", "health.probe")
+         "host.flaky", "health.probe", "alerts.eval")
 
 
 class InjectedFault(ConnectionError):

@@ -133,6 +133,25 @@ class FleetClient:
                 f"malformed health response: {res!r}")
         return res
 
+    def alerts(self) -> dict:
+        """The fleet-scope alert engine: per-rule state machine rows
+        plus the degrade flag (evaluation disabled after a fault)."""
+        res = self.call("fleet.alerts")
+        if not isinstance(res, dict):
+            raise FleetClientError(
+                f"malformed alerts response: {res!r}")
+        return res
+
+    def prom(self) -> str:
+        """Live Prometheus exposition from the daemon's own registry
+        (the file under the fleet dir refreshes only on the export
+        cadence)."""
+        res = self.call("fleet.prom")
+        if not isinstance(res, dict) or "text" not in res:
+            raise FleetClientError(
+                f"malformed prom response: {res!r}")
+        return str(res["text"])
+
     def stop(self) -> None:
         self.call("fleet.stop")
 

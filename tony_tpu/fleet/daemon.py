@@ -53,6 +53,7 @@ import time
 from typing import Any, Deque, Dict, List, Optional
 
 from tony_tpu import constants, faults, tracing
+from tony_tpu import alerts as falerts
 from tony_tpu.conf import keys as K
 from tony_tpu.devtools.race import guarded
 from tony_tpu.events.events import Event, EventHandler, EventType
@@ -316,6 +317,15 @@ class _FleetService:
     def fleet__health(self) -> dict:
         return self._d.health_status()
 
+    def fleet__alerts(self) -> dict:
+        return self._d.alerts_status()
+
+    def fleet__prom(self) -> dict:
+        # Live tony_fleet_* exposition for the portal's /fleet view —
+        # the file twin (fleet.prom) only refreshes on the export
+        # cadence, so a running daemon answers from the registry.
+        return {"text": self._d.metrics.render()}
+
     def fleet__stop(self) -> bool:
         self._d.request_stop()
         return True
@@ -342,6 +352,7 @@ class FleetDaemon:
         "_ledger_degraded": None,
         "_ledger_next_mono": None,
         "_explain_warned": None,
+        "_alerts_degraded": None,
         "_started": None,
     }
 
@@ -401,6 +412,13 @@ class FleetDaemon:
         self.book = fhealth.HostBook(self.slices, self.hosts_per_slice,
                                      self.health_cfg)
         self._health_offsets: Dict[str, int] = {}
+        # Alerting (tony_tpu/alerts/): the fleet-scope pack, evaluated
+        # each scheduler tick behind the fleet.ledger-style degrade
+        # contract (fault site "alerts.eval"); transitions journal
+        # write-ahead as REC_FLEET_ALERT and, like cordons, a firing
+        # alert survives daemon lives via `fleet start --recover`.
+        self.alerts = falerts.AlertEngine(falerts.default_fleet_pack())
+        self._alerts_degraded = False
 
         journal_path = os.path.join(self.fleet_dir,
                                     constants.FLEET_JOURNAL_FILE)
@@ -455,6 +473,8 @@ class FleetDaemon:
                              generation=self.generation)
         if replayed is not None and recover:
             self._recover(replayed)
+            if replayed.alerts:
+                self.alerts.seed(replayed.alerts)
 
     def _close_stale_spans(self, trace_path: str) -> None:
         """A SIGKILLed daemon life leaves its queue/job spans open (B
@@ -958,6 +978,8 @@ class FleetDaemon:
                 "p99_s": round(histogram_quantile(hist, 0.99), 4),
                 "count": hist.get("count", 0)},
             "ledger": ledger,
+            "alerts": {"degraded": self._alerts_degraded,
+                       "firing": self.alerts.firing()},
             "pool_dir": self.pool_dir,
             "trace_id": self.tracer.trace_id,
         }
@@ -974,6 +996,9 @@ class FleetDaemon:
         self._apply_plan()
         self._evacuate()
         self._restore()
+        # Alerts before the export so a transition's gauge/counter
+        # updates land in this tick's exposition.
+        self._alerts_tick()
         self._export()
 
     def _poll_jobs(self) -> None:
@@ -1891,6 +1916,55 @@ class FleetDaemon:
                     "tenant": job.req.tenant, "app_id": job.app_id,
                     "decisions": decisions, "milestones": milestones}
 
+    # -- alerting ---------------------------------------------------------
+    def _alerts_tick(self) -> None:
+        """Evaluate the fleet-scope alert pack against the daemon's own
+        registry. Degrade contract (the fleet.ledger shape): any
+        evaluator failure disables alerting for the rest of this daemon
+        life with one warning — the scheduler tick never blocks."""
+        if self._alerts_degraded:
+            return
+        try:
+            faults.check("alerts.eval")
+            for tr in self.alerts.evaluate(
+                    falerts.RegistrySource(self.metrics)):
+                self._apply_alert_transition(tr)
+        except Exception as e:  # noqa: BLE001 — observability, not duty
+            self._alerts_degraded = True
+            log.warning(
+                "fleet: alert evaluation failed (%s) — degrading: "
+                "alerting disabled for the rest of this daemon life", e)
+
+    def _apply_alert_transition(self, tr: falerts.Transition) -> None:
+        """REC_FLEET_ALERT write-ahead (dedup-fenced by the engine),
+        then counter + firing gauge + the fleet-scope ALERT event."""
+        if tr.journal:
+            self.journal.alert(tr.rule, tr.state, tr.severity, tr.value,
+                               tr.labels, tr.summary)
+        self.metrics.counter(
+            "tony_alert_transitions_total", {"state": tr.state},
+            help="alert state-machine transitions journaled").inc()
+        for sev, n in self.alerts.firing_count().items():
+            self.metrics.gauge(
+                "tony_alerts_firing", {"severity": sev},
+                help="alerts currently firing, by severity").set(n)
+        payload = {"rule": tr.rule, "severity": tr.severity,
+                   "value": tr.value, "labels": tr.labels,
+                   "summary": tr.summary, "scope": "fleet"}
+        if tr.state == "firing":
+            log.warning("fleet ALERT firing [%s]: %s (value=%s)",
+                        tr.severity, tr.rule, tr.value)
+            self.events.emit(Event(EventType.ALERT_FIRING, payload))
+        elif tr.state == "resolved":
+            log.info("fleet alert resolved: %s", tr.rule)
+            self.events.emit(Event(EventType.ALERT_RESOLVED, payload))
+
+    def alerts_status(self) -> dict:
+        """The `fleet.alerts` RPC: full per-rule state."""
+        return {"fleet_dir": self.fleet_dir, "scope": "fleet",
+                "degraded": self._alerts_degraded,
+                "alerts": self.alerts.snapshot()}
+
     def _diagnosis_bundle(self) -> Dict[str, Any]:
         """The in-memory twin of diagnose.bundle_from_dir — same keys,
         no file reads, cheap enough for every export."""
@@ -1927,6 +2001,10 @@ class FleetDaemon:
             "preempts_per_job": per_job,
             "ledger": self._ledger_snapshot() or {},
             "health": health,
+            # Firing alerts as rule evidence: an alert that was firing
+            # when the incident was built is a precedence-boosted input
+            # to the fleet diagnosis rules.
+            "alerts": self.alerts.firing(),
             "pool_dir": self.pool_dir,
         }
 

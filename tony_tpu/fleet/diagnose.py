@@ -25,7 +25,15 @@ Verdicts (precedence order)::
                      shrunk over and over — priority bands too close
     POOL_COLD        a warm pool is configured but starts keep going
                      cold — the pool is under-sized or mis-mounted
+    SLO_BREACH       no structural pathology matched, but the alert
+                     engine (``tony_tpu/alerts/``) has fleet-scope
+                     rules firing — the SLO numbers are the verdict
     FLEET_HEALTHY    none of the above; goodput evidence attached
+
+A firing alert is also *evidence*: when a structural verdict wins, any
+alerts that were firing ride along in its incident as corroboration
+(the ``alerts`` bundle key, fed live from the engine or offline from
+replayed ``REC_FLEET_ALERT`` records).
 
 The daemon recomputes this from its in-memory state every export and
 atomically replaces ``fleet.incident.json`` (fault-gated: a rule-engine
@@ -55,6 +63,7 @@ QUOTA_SATURATED = "QUOTA_SATURATED"
 FRAGMENTATION = "FRAGMENTATION"
 PREEMPT_STORM = "PREEMPT_STORM"
 POOL_COLD = "POOL_COLD"
+SLO_BREACH = "SLO_BREACH"
 FLEET_HEALTHY = "FLEET_HEALTHY"
 
 #: every category the engine can return (golden-matrix test anchor) in
@@ -63,7 +72,8 @@ FLEET_HEALTHY = "FLEET_HEALTHY"
 #: hardware incident, not a priority-tuning problem.
 CATEGORY_PRECEDENCE = (SICK_SLICE, FLAKY_HOST, STARVATION,
                        QUOTA_SATURATED, FRAGMENTATION,
-                       PREEMPT_STORM, POOL_COLD, FLEET_HEALTHY)
+                       PREEMPT_STORM, POOL_COLD, SLO_BREACH,
+                       FLEET_HEALTHY)
 
 #: schema version stamped into fleet.incident.json.
 INCIDENT_SCHEMA = 1
@@ -104,6 +114,10 @@ _ADVICE = {
     POOL_COLD: "starts keep going cold despite a warm pool — raise "
                "tony.pool.size (and check tony.fleet.pool-dir reaches "
                "every grant)",
+    SLO_BREACH: "a fleet SLO alert is firing with no structural "
+                "pathology matched — read the rule's series and the "
+                "burn-rate windows (docs/operations.md 'Alerting & "
+                "SLOs') before turning any scheduler knob",
     FLEET_HEALTHY: "the pool keeps up — no scheduler knob indicated",
 }
 
@@ -319,6 +333,35 @@ def _pool_cold(b: Dict[str, Any]) -> Optional[Finding]:
         details={"warm_start_fraction": frac, "starts": starts})
 
 
+def _firing_alerts(b: Dict[str, Any]) -> List[Dict[str, Any]]:
+    return [r for r in (b.get("alerts") or [])
+            if isinstance(r, dict) and r.get("state") == "firing"]
+
+
+@_rule
+def _slo_breach(b: Dict[str, Any]) -> Optional[Finding]:
+    firing = _firing_alerts(b)
+    if not firing:
+        return None
+    # Page-severity rules outrank warns when picking the headline.
+    firing = sorted(firing, key=lambda r: (
+        0 if r.get("severity") == "page" else 1, str(r.get("rule"))))
+    worst = firing[0]
+    ev = [f"alerts: {len(firing)} fleet rule(s) firing: "
+          f"{[r.get('rule') for r in firing]}"]
+    for r in firing[:4]:
+        ev.append(f"  {r.get('rule')} [{r.get('severity', '?')}] "
+                  f"value={r.get('value')} — "
+                  f"{r.get('summary') or r.get('series', '')}")
+    return Finding(SLO_BREACH, "slo-breach",
+                   f"fleet alert {worst.get('rule')!r} is firing "
+                   f"({worst.get('severity', '?')}) with no structural "
+                   f"pathology matched",
+                   confidence=0.7, evidence=ev,
+                   details={"rules": [r.get("rule") for r in firing],
+                            "worst": worst.get("rule")})
+
+
 @_rule
 def _healthy(b: Dict[str, Any]) -> Optional[Finding]:
     fleet = (b.get("ledger") or {}).get("fleet") or {}
@@ -359,6 +402,16 @@ def build_incident(bundle: Dict[str, Any]) -> Dict[str, Any]:
     findings = run_rules(bundle)
     verdict = findings[0] if findings else Finding(
         FLEET_HEALTHY, "none", "no findings", confidence=0.0)
+    # An alert firing at verdict time is corroborating evidence for a
+    # structural verdict: boost its confidence and fold the rule names
+    # in, so "the health ledger cordoned the slice AND goodput-slo was
+    # firing" reads as one story, not two.
+    firing = _firing_alerts(bundle)
+    if firing and verdict.category not in (SLO_BREACH, FLEET_HEALTHY):
+        verdict.confidence = min(0.99, verdict.confidence + 0.1)
+        verdict.evidence.append(
+            f"alerts: {[r.get('rule') for r in firing]} firing at "
+            f"verdict time (corroborating)")
     fleet = (bundle.get("ledger") or {}).get("fleet") or {}
     return {
         "schema": INCIDENT_SCHEMA,
@@ -367,6 +420,7 @@ def build_incident(bundle: Dict[str, Any]) -> Dict[str, Any]:
         "verdict": verdict.to_dict(),
         "findings": [f.to_dict() for f in findings],
         "goodput_fraction": fleet.get("goodput_fraction"),
+        "alerts_firing": [r.get("rule") for r in firing],
         "queue_depth": len(_queued(bundle)),
         "grants_total": int(bundle.get("grants_total", 0) or 0),
         "preemptions_total": int(bundle.get("preemptions_total", 0)
@@ -432,11 +486,14 @@ def bundle_from_dir(fleet_dir: str,
     # preemption counts come from the raw records (the fold keeps only
     # the final placement)
     records, _ = _raw_records(path)
+    alert_last: Dict[str, Dict[str, Any]] = {}
     for rec in records:
         if rec.get("t") == fjournal.REC_FLEET_PREEMPT:
             job = str(rec.get("job", "") or "")
             preempts += 1
             preempts_per_job[job] = preempts_per_job.get(job, 0) + 1
+        elif rec.get("t") == fjournal.REC_FLEET_ALERT:
+            alert_last[str(rec.get("rule", "") or "")] = rec
     grant_waits.sort()
     median = grant_waits[len(grant_waits) // 2] if grant_waits else 0.0
     pool_dir = ""
@@ -469,6 +526,17 @@ def bundle_from_dir(fleet_dir: str,
         "pool_dir": pool_dir,
         "health": {"enabled": bool(st.health),
                    "cordoned": cordoned, "sick_slices": sick},
+        # Replayed REC_FLEET_ALERT fold: last-wins state per rule, so
+        # the offline verdict sees exactly what was firing when the
+        # daemon last wrote (severity/value from the raw record).
+        "alerts": [{"rule": rule, "state": state,
+                    "severity": alert_last.get(rule, {}).get(
+                        "severity", "?"),
+                    "value": alert_last.get(rule, {}).get("value"),
+                    "summary": alert_last.get(rule, {}).get(
+                        "summary", "")}
+                   for rule, state in sorted(st.alerts.items())
+                   if state == "firing"],
     }
 
 
@@ -564,4 +632,7 @@ def render_text(doc: Dict[str, Any]) -> str:
     gp = doc.get("goodput_fraction")
     if gp is not None:
         lines.append(f"  fleet goodput: {float(gp):.1%}")
+    if doc.get("alerts_firing"):
+        lines.append(f"  alerts firing: "
+                     f"{', '.join(doc['alerts_firing'])}")
     return "\n".join(lines)

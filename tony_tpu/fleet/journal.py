@@ -60,6 +60,13 @@ REC_FLEET_MIGRATE = "fmigrate"
 # records: cordons outlive daemon lives) and `tony-tpu check` audits
 # that no quarantine lacks evidence.
 REC_FLEET_HEALTH = "fhealth"
+# A fleet-scope alert rule TRANSITIONED (tony_tpu/alerts/: pending /
+# firing / resolved), write-ahead of the FLEET event and gauge update.
+# The fold is last-wins per rule and persists across fgen records —
+# like cordons, a firing alert outlives daemon lives until a journaled
+# resolve closes it; `fleet start --recover` re-arms the identical
+# firing set via AlertEngine.seed().
+REC_FLEET_ALERT = "falert"
 
 #: in-fold cap on per-job decision history (the journal keeps all of it
 #: on disk; the replayed fold only needs enough to seed the explain
@@ -135,6 +142,9 @@ class FleetReplayState:
     #: until a journaled transition closes it.
     health: Dict[str, Dict[str, Any]] = dataclasses.field(
         default_factory=dict)
+    #: last-wins per-rule alert fold (rule -> latest journaled state:
+    #: pending/firing/resolved). NOT reset on fgen, like health.
+    alerts: Dict[str, str] = dataclasses.field(default_factory=dict)
 
 
 class FleetJournal:
@@ -239,6 +249,22 @@ class FleetJournal:
                   "cooldown_s", "evidence"):
             if k in record:
                 rec[k] = record[k]
+        self.append(rec)
+
+    def alert(self, rule: str, state: str, severity: str,
+              value: Optional[float], labels: Dict[str, str],
+              summary: str) -> None:
+        """One fleet-alert state transition (tony_tpu/alerts/), appended
+        BEFORE the event/gauge surfaces it. The engine's dedup fence
+        guarantees consecutive records for a rule never repeat a state
+        (the alert-journal invariant audits this)."""
+        rec: Dict[str, Any] = {"t": REC_FLEET_ALERT, "rule": rule,
+                               "state": state, "severity": severity,
+                               "summary": summary}
+        if value is not None:
+            rec["value"] = float(value)
+        if labels:
+            rec["labels"] = dict(labels)
         self.append(rec)
 
     def decision(self, job_id: str, action: str, reason: str,
@@ -369,6 +395,10 @@ def replay(path: str) -> FleetReplayState:
             host = str(rec.get("host", "") or "")
             if host:
                 state.health[host] = rec
+        elif t == REC_FLEET_ALERT:
+            rule = str(rec.get("rule", "") or "")
+            if rule:
+                state.alerts[rule] = str(rec.get("state", "") or "")
         elif t == REC_FLEET_DECISION:
             fold = state.jobs.get(str(rec.get("job", "") or ""))
             if fold is None:

@@ -108,6 +108,10 @@ SERIES: Dict[str, str] = {
     "tony_journal_records_total": "write-ahead journal records appended",
     "tony_journal_bytes_total": "write-ahead journal bytes appended",
     "tony_journal_fsync_seconds": "journal append latency (fsync incl.)",
+    # -- alerting (tony_tpu/alerts/) --------------------------------------
+    "tony_alerts_firing": "alerts currently firing, by severity",
+    "tony_alert_transitions_total": "alert state-machine transitions "
+                                    "journaled, by state",
 }
 
 _LabelsKey = Tuple[Tuple[str, str], ...]
@@ -143,15 +147,18 @@ def _fmt_value(v: float) -> str:
 
 
 class Series:
-    """Gauge with bounded history: the ring buffer behind sparklines and
-    the `latest` sample the exposition renders."""
+    """Gauge with bounded history: the ring buffer behind sparklines,
+    windowed evaluators (``MetricsRegistry.rate`` over cumulative
+    gauges, burn-rate windows) and the `latest` sample the exposition
+    renders. Ring timestamps are ``time.monotonic()`` — they only ever
+    feed window arithmetic, never wall-clock display."""
 
     def __init__(self, maxlen: int = 512):
         self.points: Deque[Tuple[float, float]] = collections.deque(
             maxlen=max(2, int(maxlen)))
 
     def set(self, value: float, ts: Optional[float] = None) -> None:
-        self.points.append((ts if ts is not None else time.time(),
+        self.points.append((ts if ts is not None else time.monotonic(),
                             float(value)))
 
     @property
@@ -165,15 +172,22 @@ class Series:
 class Counter:
     """Monotonic counter; ``inc`` with a negative amount is a programming
     error and raises (monotonicity is the contract Prometheus rate()
-    depends on)."""
+    depends on). Keeps a bounded ring of (monotonic ts, value-after-inc)
+    points so ``MetricsRegistry.rate`` can window it; the seed point
+    anchors the recover base, so a rate window spanning a ``--recover``
+    sees the reloaded value as history, not as a fresh increase."""
 
-    def __init__(self, base: float = 0.0):
+    def __init__(self, base: float = 0.0, maxlen: int = 512):
         self.value = float(base)
+        self.points: Deque[Tuple[float, float]] = collections.deque(
+            maxlen=max(2, int(maxlen)))
+        self.points.append((time.monotonic(), self.value))
 
     def inc(self, amount: float = 1.0) -> None:
         if amount < 0:
             raise ValueError(f"counter decrement ({amount}) is not allowed")
         self.value += amount
+        self.points.append((time.monotonic(), self.value))
 
 
 class Histogram:
@@ -181,11 +195,16 @@ class Histogram:
     exposition format wants). ``snapshot()`` is the wire form executors
     put on the heartbeat beacon."""
 
-    def __init__(self, buckets: Tuple[float, ...] = DEFAULT_LATENCY_BUCKETS_S):
+    def __init__(self, buckets: Tuple[float, ...] = DEFAULT_LATENCY_BUCKETS_S,
+                 raw_points: int = 1024):
         self.buckets = tuple(sorted(float(b) for b in buckets))
         self.counts = [0] * (len(self.buckets) + 1)  # last = overflow
         self.sum = 0.0
         self.count = 0
+        #: bounded (monotonic ts, value) ring behind quantile_over —
+        #: exact windowed quantiles for local histograms, no bucket error
+        self.raw: Deque[Tuple[float, float]] = collections.deque(
+            maxlen=max(2, int(raw_points)))
         self._lock = threading.Lock()
 
     def observe(self, value: float) -> None:
@@ -195,6 +214,7 @@ class Histogram:
             self.counts[idx] += 1
             self.sum += v
             self.count += 1
+            self.raw.append((time.monotonic(), v))
 
     def snapshot(self) -> Dict[str, Any]:
         with self._lock:
@@ -224,6 +244,50 @@ def render_histogram_lines(name: str, key: _LabelsKey,
     return lines
 
 
+def _window_increase(pts: List[Tuple[float, float]],
+                     cutoff: float) -> float:
+    """Increase of a cumulative series over [cutoff, now]: last in-window
+    value minus the value as of the window's start (the newest point at
+    or before the cutoff — so a window spanning a quiet stretch, or a
+    ``--recover`` reload, reads zero increase instead of re-counting the
+    whole base). A backwards step (counter reset) contributes its
+    post-reset value, Prometheus-style."""
+    base: Optional[float] = None
+    in_win: List[float] = []
+    for ts, v in pts:
+        if ts < cutoff:
+            base = v
+        else:
+            in_win.append(v)
+    if not in_win:
+        return 0.0
+    prev = base if base is not None else in_win[0]
+    inc = 0.0
+    for v in in_win:
+        d = v - prev
+        inc += d if d >= 0 else v
+        prev = v
+    return inc
+
+
+def _bucket_quantile(bounds: List[float], counts: List[float],
+                     q: float) -> float:
+    """Quantile from per-bucket counts (+overflow last) by linear
+    interpolation inside the owning bucket; overflow clamps to the top
+    bound (same convention as coordphases.histogram_quantile)."""
+    total = sum(counts)
+    if total <= 0 or not bounds:
+        return 0.0
+    rank = max(0.0, min(1.0, float(q))) * total
+    cum, lo = 0.0, 0.0
+    for bound, c in zip(bounds, counts):
+        if cum + c >= rank and c > 0:
+            return lo + (bound - lo) * (rank - cum) / c
+        cum += c
+        lo = bound
+    return float(bounds[-1])
+
+
 @guarded
 class MetricsRegistry:
     """The coordinator's in-memory metrics store: gauges (ring-buffer
@@ -241,6 +305,7 @@ class MetricsRegistry:
         "_counters": "_lock",
         "_hists": "_lock",
         "_hist_snaps": "_lock",
+        "_hist_snap_rings": "_lock",
         "_help": "_lock",
         "_saved_counters": "_lock",
     }
@@ -251,6 +316,12 @@ class MetricsRegistry:
         self._counters: Dict[str, Dict[_LabelsKey, Counter]] = {}
         self._hists: Dict[str, Dict[_LabelsKey, Histogram]] = {}
         self._hist_snaps: Dict[str, Dict[_LabelsKey, Dict[str, Any]]] = {}
+        # (monotonic ts, snapshot) rings behind quantile_over for
+        # beacon-shipped histograms: windowed quantile = bucket diff of
+        # the newest snapshot against the last one older than the window
+        self._hist_snap_rings: Dict[
+            str, Dict[_LabelsKey,
+                      Deque[Tuple[float, Dict[str, Any]]]]] = {}
         self._help: Dict[str, str] = {}
         self._saved_counters: Dict[str, Dict[str, float]] = {}
         self._lock = threading.Lock()
@@ -279,7 +350,7 @@ class MetricsRegistry:
             if c is None:
                 base = self._saved_counters.get(name, {}).get(
                     json.dumps(key), 0.0)
-                c = fam[key] = Counter(base)
+                c = fam[key] = Counter(base, maxlen=self._ring_points)
         return c
 
     def histogram(self, name: str,
@@ -304,10 +375,16 @@ class MetricsRegistry:
         histograms ride the beacon as cumulative snapshots)."""
         if not isinstance(snap, dict) or "buckets" not in snap:
             return
+        key = _labels_key(labels)
         with self._lock:
             if help and name not in self._help:
                 self._help[name] = help
-            self._hist_snaps.setdefault(name, {})[_labels_key(labels)] = snap
+            self._hist_snaps.setdefault(name, {})[key] = snap
+            ring = self._hist_snap_rings.setdefault(name, {}).get(key)
+            if ring is None:
+                ring = self._hist_snap_rings[name][key] = \
+                    collections.deque(maxlen=64)
+            ring.append((time.monotonic(), snap))
 
     # -- reads -----------------------------------------------------------
     def gauge_value(self, name: str,
@@ -324,6 +401,109 @@ class MetricsRegistry:
             series = self._gauges.get(name, {}).get(_labels_key(labels))
         return series.values() if series is not None else []
 
+    # -- windowed evaluator APIs (tony_tpu/alerts rides these) -----------
+    def label_sets(self, name: str) -> List[Dict[str, str]]:
+        """Every label set the family currently carries, across all
+        instrument kinds."""
+        with self._lock:
+            keys: set = set()
+            for store in (self._gauges, self._counters, self._hists,
+                          self._hist_snaps):
+                keys.update(store.get(name, {}).keys())
+        return [dict(k) for k in sorted(keys)]
+
+    def sample(self, name: str,
+               labels: Optional[Dict[str, Any]] = None
+               ) -> Optional[float]:
+        """Latest instantaneous value: gauge latest, else counter value."""
+        key = _labels_key(labels)
+        with self._lock:
+            series = self._gauges.get(name, {}).get(key)
+            if series is not None and series.latest is not None:
+                return series.latest
+            c = self._counters.get(name, {}).get(key)
+        return c.value if c is not None else None
+
+    def gauge_points(self, name: str,
+                     labels: Optional[Dict[str, Any]] = None
+                     ) -> List[Tuple[float, float]]:
+        """The (monotonic ts, value) ring of a gauge (or a counter's
+        value-after-inc ring) — burn-rate windows walk this."""
+        key = _labels_key(labels)
+        with self._lock:
+            series = self._gauges.get(name, {}).get(key)
+            if series is not None:
+                return list(series.points)
+            c = self._counters.get(name, {}).get(key)
+        return list(c.points) if c is not None else []
+
+    def rate(self, name: str, labels: Optional[Dict[str, Any]] = None,
+             window_s: float = 60.0,
+             now: Optional[float] = None) -> Optional[float]:
+        """Windowed increase/second over a counter ring — or over a
+        cumulative gauge (e.g. ``tony_step_phase_seconds``, where the
+        rate of cumulative seconds is a fraction of wall time). Counter
+        resets (a value stepping backwards, e.g. a replaced executor)
+        contribute their post-reset value, Prometheus-style. Returns
+        0.0 when the family exists but has no in-window points, None
+        when the family/labels are unknown (unevaluable)."""
+        key = _labels_key(labels)
+        with self._lock:
+            c = self._counters.get(name, {}).get(key)
+            if c is not None:
+                pts = list(c.points)
+            else:
+                series = self._gauges.get(name, {}).get(key)
+                if series is None:
+                    return None
+                pts = list(series.points)
+        now = now if now is not None else time.monotonic()
+        window_s = max(1e-9, float(window_s))
+        return _window_increase(pts, now - window_s) / window_s
+
+    def quantile_over(self, name: str,
+                      labels: Optional[Dict[str, Any]] = None,
+                      window_s: float = 60.0, q: float = 0.99,
+                      now: Optional[float] = None) -> Optional[float]:
+        """Windowed quantile: exact (interpolated rank over the raw
+        observation ring) for local histograms; bucket-interpolated over
+        a snapshot diff for beacon-shipped histograms. None when there
+        are no in-window observations (unevaluable, not zero)."""
+        key = _labels_key(labels)
+        now = now if now is not None else time.monotonic()
+        cutoff = now - max(0.0, float(window_s))
+        with self._lock:
+            h = self._hists.get(name, {}).get(key)
+            raw = list(h.raw) if h is not None else None
+            ring = self._hist_snap_rings.get(name, {}).get(key)
+            snaps = list(ring) if ring is not None else []
+        if raw is not None:
+            vals = sorted(v for ts, v in raw if ts >= cutoff)
+            if not vals:
+                return None
+            rank = max(0.0, min(1.0, float(q))) * (len(vals) - 1)
+            lo = int(rank)
+            hi = min(lo + 1, len(vals) - 1)
+            return vals[lo] + (vals[hi] - vals[lo]) * (rank - lo)
+        if not snaps or snaps[-1][0] < cutoff:
+            return None
+        newest = snaps[-1][1]
+        base: Optional[Dict[str, Any]] = None
+        for ts, snap in snaps:
+            if ts < cutoff:
+                base = snap
+        bounds = [float(b) for b in newest.get("buckets", [])]
+        counts = [float(c) for c in newest.get("counts", [])]
+        counts += [0.0] * (len(bounds) + 1 - len(counts))
+        if base is not None and \
+                [float(b) for b in base.get("buckets", [])] == bounds:
+            bcounts = [float(c) for c in base.get("counts", [])]
+            bcounts += [0.0] * (len(bounds) + 1 - len(bcounts))
+            counts = [max(0.0, c - b) for c, b in zip(counts, bcounts)]
+        if sum(counts) <= 0 or not bounds:
+            return None
+        return _bucket_quantile(bounds, counts, q)
+
     def drop_labels(self, match: Dict[str, Any]) -> None:
         """Drop every series/counter/histogram whose labels contain all of
         ``match`` (a finished retry epoch's task series must not linger as
@@ -331,7 +511,7 @@ class MetricsRegistry:
         want = set(_labels_key(match))
         with self._lock:
             for store in (self._gauges, self._counters, self._hists,
-                          self._hist_snaps):
+                          self._hist_snaps, self._hist_snap_rings):
                 for fam in store.values():
                     for key in [k for k in fam if want <= set(k)]:
                         del fam[key]

@@ -179,10 +179,14 @@ class PortalServer:
                 # /metrics/<job>).
                 return self._prom_view(req)
             if parts == ["fleet"]:
-                # Fleet scheduler row (tony_tpu/fleet/): the daemon's
-                # atomically replaced status snapshot + tony_fleet_*
-                # exposition — never cached, the fleet is always live.
+                # Fleet scheduler row (tony_tpu/fleet/): live from a
+                # running daemon's RPC, exported artifacts otherwise —
+                # never the TTL cache, the fleet is always live.
                 return self._fleet_view(req, as_json)
+            if parts == ["alerts"]:
+                # SLO/alert rollup (tony_tpu/alerts/): fleet-scope rule
+                # state + every job's journaled alert fold.
+                return self._alerts_view(req, as_json)
             view, *rest = parts
             if view in ("config", "jobs", "logs", "logfile",
                         "profiles", "profile", "metrics", "trace",
@@ -224,6 +228,8 @@ class PortalServer:
         if self.fleet_dir:
             body.append("<p><a href='/fleet'>fleet scheduler</a> — "
                         "queue, tenants, grants</p>")
+        body.append("<p><a href='/alerts'>alerts</a> — SLO rule "
+                    "state, fleet + per job</p>")
         body += ["<table border=1 cellpadding=4>",
                  "<tr><th>job</th><th>status</th><th>user</th>"
                  "<th>started</th><th></th></tr>"]
@@ -245,14 +251,32 @@ class PortalServer:
     def _job_dir(self, job_id: str) -> Optional[str]:
         return history.list_job_dirs(self.history_root).get(job_id)
 
-    def _fleet_view(self, req, as_json: bool) -> None:
-        """Scheduler snapshot + tony_fleet_* families from the fleet
-        dir's atomically replaced artifacts (no RPC: the portal reads
-        what the daemon exports, same as /metrics reads metrics.prom)."""
-        if not self.fleet_dir:
-            return self._send(req, 404, "text/plain",
-                              b"no fleet dir configured or discovered")
-        snap = None
+    def _fleet_client(self):
+        """FleetClient for a RUNNING daemon (addr file present), else
+        None. The live-object bypass for the fleet views: the exported
+        fleet.status.json/fleet.prom only refresh on the daemon's
+        export cadence — the same staleness the per-job views fixed by
+        skipping the TTL cache for in-progress jobs — so a live daemon
+        is asked directly and the files stay the dead-daemon fallback."""
+        if not self.fleet_dir or not os.path.exists(
+                os.path.join(self.fleet_dir, constants.FLEET_ADDR_FILE)):
+            return None
+        from tony_tpu.fleet.client import FleetClient
+        return FleetClient(self.fleet_dir)
+
+    def _fleet_snapshot(self) -> Tuple[Optional[dict], Optional[str]]:
+        """(status snapshot, prom text): live from the daemon's RPC
+        when it is up, else the exported artifacts."""
+        client = self._fleet_client()
+        if client is not None:
+            try:
+                return client.status(), client.prom()
+            except Exception as e:  # noqa: BLE001 — stale addr, dying daemon
+                log.debug("fleet live bypass failed (%s); serving the "
+                          "exported artifacts", e)
+            finally:
+                client.close()
+        snap = prom = None
         try:
             with open(os.path.join(self.fleet_dir,
                                    constants.FLEET_STATUS_FILE),
@@ -260,6 +284,23 @@ class PortalServer:
                 snap = json.load(f)
         except (OSError, ValueError):
             pass
+        try:
+            with open(os.path.join(self.fleet_dir,
+                                   constants.FLEET_PROM_FILE),
+                      encoding="utf-8") as f:
+                prom = f.read()
+        except OSError:
+            pass
+        return snap, prom
+
+    def _fleet_view(self, req, as_json: bool) -> None:
+        """Scheduler snapshot + tony_fleet_* families: live from a
+        running daemon's RPC (see _fleet_client), falling back to the
+        atomically replaced artifacts when the daemon is down."""
+        if not self.fleet_dir:
+            return self._send(req, 404, "text/plain",
+                              b"no fleet dir configured or discovered")
+        snap, prom = self._fleet_snapshot()
         if snap is None:
             return self._send(req, 404, "text/plain",
                               b"no fleet status snapshot yet")
@@ -292,6 +333,19 @@ class PortalServer:
                 f"{html.escape(str(v.get('category', '?')))}</b> — "
                 f"{html.escape(str(v.get('summary', '')))}<br>"
                 f"advice: {html.escape(str(v.get('advice', '')))}</p>")
+        # Firing-alert banner (tony_tpu/alerts/): quiet when nothing
+        # fires; /alerts has the full per-rule table.
+        fal = snap.get("alerts") or {}
+        if fal.get("degraded") or fal.get("firing"):
+            parts = []
+            if fal.get("degraded"):
+                parts.append("evaluation DEGRADED")
+            for r in fal.get("firing") or []:
+                parts.append(
+                    f"{html.escape(str(r.get('rule', '?')))} "
+                    f"[{html.escape(str(r.get('severity', '?')))}]")
+            body.append("<p><b>alerts</b> — " + "; ".join(parts)
+                        + " (<a href='/alerts'>details</a>)</p>")
         # Host-health cordon banner (fleet/health.py): quiet when the
         # fleet is clean — operators should only see it on an incident.
         health = snap.get("health") or {}
@@ -356,15 +410,113 @@ class PortalServer:
                 f"<td>{(f'{wait:.1f}s' if wait is not None else '')}</td>"
                 f"<td>{app_cell}</td></tr>")
         body.append("</table>")
-        try:
-            with open(os.path.join(self.fleet_dir,
-                                   constants.FLEET_PROM_FILE),
-                      encoding="utf-8") as f:
-                prom = f.read()
+        if prom:
             body.append("<h2>tony_fleet_* exposition</h2><pre>"
                         + html.escape(prom) + "</pre>")
-        except OSError:
-            pass
+        self._send_html(req, "".join(body))
+
+    def _job_alerts(self, job_id: str) -> Dict[str, str]:
+        """Final journaled alert state per rule (REC_ALERT fold) for one
+        job. Live jobs bypass the TTL cache — their journal grows
+        between requests, the same staleness contract as _events;
+        finished jobs keep the cache."""
+        if not self._job_live(job_id):
+            hit = self.cache.get("alerts", job_id)
+            if hit is not None:
+                return hit
+        job_dir = self._job_dir(job_id)
+        if job_dir is None:
+            return {}
+        path = os.path.join(job_dir, constants.JOURNAL_FILE)
+        alerts: Dict[str, str] = {}
+        if os.path.exists(path):
+            from tony_tpu.coordinator import journal as cjournal
+            try:
+                alerts = dict(cjournal.replay(path).alerts)
+            except Exception as e:  # noqa: BLE001 — view stays up
+                log.debug("alert replay failed for %s: %s", job_id, e)
+        if not self._job_live(job_id):
+            self.cache.put("alerts", job_id, alerts)
+        return alerts
+
+    def _alerts_view(self, req, as_json: bool) -> None:
+        """The firing-state rollup: fleet-scope rules (live from the
+        daemon's engine, or the REC_FLEET_ALERT fold of a dead one)
+        plus every job's journaled alert state — the portal face of
+        `tony-tpu alerts` / `tony-tpu fleet alerts`."""
+        fleet: Optional[dict] = None
+        if self.fleet_dir:
+            client = self._fleet_client()
+            if client is not None:
+                try:
+                    fleet = client.alerts()
+                except Exception:  # noqa: BLE001 — fall back to replay
+                    fleet = None
+                finally:
+                    client.close()
+            if fleet is None:
+                from tony_tpu.fleet import journal as fjournal
+                try:
+                    st = fjournal.replay(os.path.join(
+                        self.fleet_dir, constants.FLEET_JOURNAL_FILE))
+                    fleet = {"scope": "fleet", "offline": True,
+                             "alerts": [{"rule": r, "state": s}
+                                        for r, s
+                                        in sorted(st.alerts.items())]}
+                except Exception as e:  # noqa: BLE001
+                    log.debug("fleet alert replay failed: %s", e)
+        jobs = {job_id: self._job_alerts(job_id)
+                for job_id in sorted(
+                    history.list_job_dirs(self.history_root))}
+        jobs = {j: a for j, a in jobs.items() if a}
+        if as_json:
+            return self._send_json(req, {"fleet": fleet, "jobs": jobs})
+        body = ["<h1>alerts</h1>"]
+        if fleet is not None:
+            body.append("<h2>fleet</h2>")
+            if fleet.get("degraded"):
+                body.append("<p><b>evaluation DEGRADED</b> — disabled "
+                            "after a fault; restart the daemon to "
+                            "re-arm</p>")
+            if fleet.get("offline"):
+                body.append("<p>(journal replay — no live daemon)</p>")
+            rows = fleet.get("alerts") or []
+            if rows:
+                body.append("<table border=1 cellpadding=4><tr>"
+                            "<th>rule</th><th>state</th><th>severity"
+                            "</th><th>value</th><th>series</th></tr>")
+                for r in rows:
+                    state = str(r.get("state", "?"))
+                    cell = f"<b>{html.escape(state)}</b>" \
+                        if state == "firing" else html.escape(state)
+                    v = r.get("value")
+                    body.append(
+                        f"<tr><td>{html.escape(str(r.get('rule')))}"
+                        f"</td><td>{cell}</td>"
+                        f"<td>{html.escape(str(r.get('severity', '')))}"
+                        f"</td><td>{'' if v is None else f'{v:.4g}'}"
+                        f"</td><td>{html.escape(str(r.get('series', '')))}"
+                        f"</td></tr>")
+                body.append("</table>")
+            else:
+                body.append("<p>no fleet alert transitions</p>")
+        body.append("<h2>jobs</h2>")
+        if not jobs:
+            body.append("<p>no journaled alert transitions in any "
+                        "job</p>")
+        else:
+            body.append("<table border=1 cellpadding=4><tr><th>job</th>"
+                        "<th>rule</th><th>state</th></tr>")
+            for job_id, alerts in jobs.items():
+                a = html.escape(job_id)
+                for rule, state in sorted(alerts.items()):
+                    cell = f"<b>{html.escape(state)}</b>" \
+                        if state == "firing" else html.escape(state)
+                    body.append(
+                        f"<tr><td><a href='/metrics/{a}'>{a}</a></td>"
+                        f"<td>{html.escape(rule)}</td>"
+                        f"<td>{cell}</td></tr>")
+            body.append("</table>")
         self._send_html(req, "".join(body))
 
     def _config_view(self, req, job_id: str, as_json: bool) -> None:
@@ -453,9 +605,23 @@ class PortalServer:
             for t, m in tasks)
         self._send_html(
             req, f"<h1>metrics — {html.escape(job_id)}</h1>"
-                 f"<table border=1 cellpadding=4><tr><th>task</th>{head}"
-                 f"</tr>{rows}</table>" + self._coord_section(job_id)
+                 + self._alert_banner(job_id)
+                 + f"<table border=1 cellpadding=4><tr><th>task</th>"
+                 f"{head}</tr>{rows}</table>"
+                 + self._coord_section(job_id)
                  + self._liveness_incidents(evs))
+
+    def _alert_banner(self, job_id: str) -> str:
+        """Firing-alert banner for the per-job views: quiet unless the
+        journal fold says a rule is firing right now (live job) or was
+        left firing at death (evidence — see /diagnose)."""
+        firing = sorted(r for r, s in self._job_alerts(job_id).items()
+                        if s == "firing")
+        if not firing:
+            return ""
+        return ("<p><b>alerts firing:</b> "
+                + ", ".join(html.escape(r) for r in firing)
+                + " (<a href='/alerts'>details</a>)</p>")
 
     def _coord_section(self, job_id: str) -> str:
         """Control-plane self-observation table for the metrics view:
