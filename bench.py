@@ -811,6 +811,76 @@ def run_fleet_suite(n_jobs=50, tick_s=0.2, timeout_s=420):
     }
 
 
+def run_whatif_suite(journal_path="", sim_budget_s=5.0):
+    """The BENCH_WHATIF family: the fleet time machine's cost and its
+    payoff on the checked-in 50-job recorded tenant mix
+    (tests/fixtures/whatif_mix, regenerated by
+    tests/scripts/gen_whatif_mix.py). Three gates ride the diff:
+
+    * ``parity_mismatches`` must stay 0 — the simulator and the policy
+      engine share one scheduling brain (lower better);
+    * ``sim_wall_s`` — full report (parity + base + counterfactual +
+      3-point quota sweep) must fold in under ``sim_budget_s`` (lower
+      better; the portal /whatif view recomputes per request);
+    * headline ``value`` = the quota-bump counterfactual's improvement
+      fraction on the starved tenant's queue-wait p99 (higher better —
+      the number the whole subsystem exists to produce).
+
+    Deterministic and sub-second: safe for the CI bench-smoke lane."""
+    from tony_tpu.fleet import simulator as fsim
+    from tony_tpu.fleet import timeline as ftimeline
+
+    if not journal_path:
+        journal_path = os.path.join(
+            os.path.dirname(os.path.abspath(__file__)), "tests",
+            "fixtures", "whatif_mix", "fleet.journal.jsonl")
+    t0 = time.monotonic()
+    tl = ftimeline.load(path=journal_path)
+    ov = fsim.build_overrides(quotas=["capped=4"])
+    report = fsim.whatif(tl, ov, sweeps=["quota.capped=2,3,4"])
+    sim_wall_s = round(time.monotonic() - t0, 3)
+    par = report["parity"]
+    if not par["ok"]:
+        raise RuntimeError(
+            f"whatif parity broke on the recorded mix: "
+            f"{par['mismatch_counts']} {par['mismatches'][:2]}")
+    if sim_wall_s > sim_budget_s:
+        raise RuntimeError(
+            f"whatif report took {sim_wall_s}s (budget {sim_budget_s}s)")
+    base = report["base"]
+    cf = report["counterfactuals"][0]
+    base_p99 = base["per_tenant"]["capped"]["queue_wait_p99_s"]
+    cf_p99 = cf["per_tenant"]["capped"]["queue_wait_p99_s"]
+    improvement = round((base_p99 - cf_p99) / base_p99, 4) \
+        if base_p99 else 0.0
+    point = {
+        "jobs": report["jobs"],
+        "records": report["records"],
+        "sim_wall_s": sim_wall_s,
+        "parity_mismatches": len(par["mismatches"]),
+        "parity_records_checked": par["counts"]["grant"]
+        + par["counts"]["preempt"] + par["counts"]["decision"],
+        "queue_wait_p99_s": base["metrics"]["queue_wait_p99_s"],
+        "capped_queue_wait_p99_s": base_p99,
+        "capped_whatif_queue_wait_p99_s": cf_p99,
+        "p99_improvement_fraction": improvement,
+        "quota_hold_s": base["metrics"]["quota_hold_s"],
+        "whatif_quota_hold_s": cf["metrics"]["quota_hold_s"],
+        "makespan_s": base["metrics"]["makespan_s"],
+        "whatif_makespan_s": cf["metrics"]["makespan_s"],
+        "utilization_fraction": base["metrics"]["utilization_fraction"],
+        "sweep_points": len(report["counterfactuals"]) - 1,
+    }
+    return {
+        "metric": "p99_improvement_fraction",
+        "value": improvement,
+        "unit": "fractional queue-wait-p99 reduction for the starved "
+                "tenant under --quota capped=4",
+        "vs_baseline": None,
+        "detail": {"suite": "whatif", "whatif": point},
+    }
+
+
 def measure_migrate_point(width=16, target="slice-1", hb_interval_ms=300,
                           monitor_interval_ms=100):
     """One BENCH_MIGRATE move point: a gang of ``width`` beat-only
@@ -988,7 +1058,8 @@ def main(argv=None):
     ap.add_argument("--tolerance", type=float, default=0.10,
                     help="relative regression tolerance for --against")
     ap.add_argument("--suite",
-                    choices=("default", "scale", "fleet", "migrate"),
+                    choices=("default", "scale", "fleet", "migrate",
+                             "whatif"),
                     default="default",
                     help="'scale' runs the control-plane width family "
                          "(BENCH_SCALE: rendezvous/beats/tick/journal/"
@@ -1001,15 +1072,20 @@ def main(argv=None):
                          "migration's two layers (BENCH_MIGRATE: "
                          "drain→relaunch wall at width, async-snapshot "
                          "stall vs the sync baseline) instead of the "
-                         "training bench")
+                         "training bench; 'whatif' folds the checked-in "
+                         "50-job recorded mix through the fleet time "
+                         "machine (BENCH_WHATIF: parity gate, report "
+                         "wall, counterfactual queue-wait payoff — "
+                         "deterministic, sub-second, no daemon)")
     ap.add_argument("--out", default="",
                     help="also write the bench json to this path")
     args = ap.parse_args(argv)
 
-    if args.suite in ("scale", "fleet", "migrate"):
+    if args.suite in ("scale", "fleet", "migrate", "whatif"):
         doc = {"scale": run_scale_suite,
                "fleet": run_fleet_suite,
-               "migrate": run_migrate_suite}[args.suite]()
+               "migrate": run_migrate_suite,
+               "whatif": run_whatif_suite}[args.suite]()
         print(json.dumps(doc))
         if args.out:
             with open(args.out, "w", encoding="utf-8") as f:

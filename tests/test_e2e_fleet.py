@@ -313,6 +313,25 @@ def test_fleet_50_job_tenant_mix_preempt_kill_recover(tmp_path):
                  if e.get("ph") == "X" and e["args"].get("trace")}
     assert trace_ids == {payload["traceId"]}, trace_ids
 
+    # -- fleet time machine: the recorded drill parity-replays ---------
+    # Every grant and preemption the daemon journaled across this run —
+    # quota holds, the priority preempt, the SIGKILL + recovery replay —
+    # must come back bit-for-bit when the journal is re-executed through
+    # the policy engine offline (simulator and daemon share ONE brain).
+    # Decision-reason wording may drift across the recovery boundary
+    # (soft notes); the grant/preempt gate may not.
+    from tony_tpu.fleet import simulator as fsim
+    from tony_tpu.fleet import timeline as ftimeline
+
+    par = fsim.parity_replay(ftimeline.load(fleet_dir))
+    assert par["supported"], par.get("reason")
+    assert par["gate_ok"], par["mismatches"]
+    assert par["counts"]["grant"] == 50, par["counts"]
+    # ...and the what-if CLI folds the same journal into a
+    # counterfactual report (quota bump on the capped tenant)
+    assert cli_main(["fleet", "whatif", "--dir", fleet_dir,
+                     "--quota", "capped=4", "--json"]) == 0
+
 
 @pytest.mark.timeout_s(420)
 def test_fleet_warm_pool_and_shared_cache_for_every_tenant(tmp_path):

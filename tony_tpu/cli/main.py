@@ -1565,6 +1565,31 @@ def _cmd_fleet(args: argparse.Namespace) -> int:
         else:
             print(_render_alert_rows(res))
         return 0
+    if args.fleet_cmd == "whatif":
+        # Offline by design, like diagnose: the time machine replays a
+        # RECORDED journal — it never needs (or touches) a live daemon.
+        from tony_tpu.fleet import simulator as fsim
+        from tony_tpu.fleet.journal import FleetJournalError
+
+        try:
+            report = fsim.whatif_from_dir(
+                fleet_dir, sets=args.set, quotas=args.quota,
+                pool=args.pool or None, priorities=args.priority,
+                sweeps=args.sweep)
+        except FleetJournalError as e:
+            print(f"{e}", file=sys.stderr)
+            return 1
+        except ValueError as e:
+            print(f"whatif: {e}", file=sys.stderr)
+            return 2
+        if args.json:
+            print(json.dumps(report, indent=1, sort_keys=True))
+        else:
+            print(fsim.render_report(report))
+        par = report.get("parity") or {}
+        if args.expect_parity and not par.get("ok"):
+            return 1
+        return 0
     if args.fleet_cmd == "explain":
         from tony_tpu.fleet import diagnose as fdiagnose
         from tony_tpu.fleet.journal import FleetJournalError
@@ -2087,6 +2112,44 @@ def build_parser() -> argparse.ArgumentParser:
     fd.add_argument("--conf-file")
     fd.add_argument("--conf", action="append", metavar="K=V")
     fd.set_defaults(fn=_cmd_fleet)
+    fw = fl_sub.add_parser(
+        "whatif",
+        help="fleet time machine: replay the recorded journal through "
+             "the real policy engine under counterfactual quotas / "
+             "priorities / pool shape and diff goodput, queue waits "
+             "and per-tenant hold seconds against the recorded run — "
+             "parity-gated, fully offline (docs/operations.md "
+             "'Capacity planning and what-if')")
+    fw.add_argument("--set", action="append", default=[],
+                    metavar="K=V",
+                    help="override a tony.fleet.* knob in the replay "
+                         "(quotas, slices, hosts-per-slice, "
+                         "sim-preemption/defrag/restore; also the "
+                         "quota.<tenant> / priority.<job> / pool "
+                         "shorthands)")
+    fw.add_argument("--quota", action="append", default=[],
+                    metavar="TENANT=N",
+                    help="counterfactual host quota for one tenant")
+    fw.add_argument("--pool", default="",
+                    metavar="SxH", help="counterfactual pool shape, "
+                    "e.g. 4x8 = 4 slices of 8 hosts")
+    fw.add_argument("--priority", action="append", default=[],
+                    metavar="JOB=P",
+                    help="counterfactual priority for one recorded job")
+    fw.add_argument("--sweep", action="append", default=[],
+                    metavar="K=a,b,c",
+                    help="sweep one key over a value grid (repeat for "
+                         "a cartesian product; max 64 combinations)")
+    fw.add_argument("--expect-parity", action="store_true",
+                    help="exit 1 unless the parity gate reproduces the "
+                         "recorded sequence bit-for-bit")
+    fw.add_argument("--dir")
+    fw.add_argument("--workdir")
+    fw.add_argument("--json", action="store_true",
+                    help="print the raw whatif report document")
+    fw.add_argument("--conf-file")
+    fw.add_argument("--conf", action="append", metavar="K=V")
+    fw.set_defaults(fn=_cmd_fleet)
     fco = fl_sub.add_parser(
         "cordon",
         help="pull one pool host out of placement by hand "

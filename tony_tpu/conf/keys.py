@@ -1003,6 +1003,24 @@ FLEET_LEDGER_INTERVAL_S = _key(
     "jobs' span trees / perf artifacts into queued/startup/train/stall "
     "phase accounting — too hot for every scheduler tick at 50 jobs, "
     "cheap at this interval.")
+FLEET_SIM_PREEMPTION = _key(
+    "tony.fleet.sim-preemption", True, bool,
+    "What-if simulator toggle (`tony-tpu fleet whatif --set`): False "
+    "re-runs the recorded workload with every gang RIGID (min_hosts "
+    "forced to 0, so the preemption planner finds no elastic victims "
+    "and defrag finds no movers). Measures how much of the recorded "
+    "goodput the elastic-shrink machinery actually bought.")
+FLEET_SIM_DEFRAG = _key(
+    "tony.fleet.sim-defrag", True, bool,
+    "What-if simulator toggle: False disables defragmentation "
+    "migrations in the counterfactual — a fragmentation-held job waits "
+    "for natural drains instead of a planned one-mover consolidation. "
+    "Attributes fragmentation-hold seconds to the defrag planner.")
+FLEET_SIM_RESTORE = _key(
+    "tony.fleet.sim-restore", True, bool,
+    "What-if simulator toggle: False disables grow-back restores — "
+    "preempted jobs stay at their shrunk size to job end. Shows how "
+    "much queue-idle capacity the restore path actually recycles.")
 
 # --- fleet host health (tony_tpu/fleet/health.py) -------------------------
 HEALTH_ENABLED = _key(

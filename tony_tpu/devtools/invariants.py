@@ -59,6 +59,15 @@ fleet-ledger             the goodput ledger re-folded offline books
                          sum-to-wall discipline at the fleet layer;
                          migration wall books under its own phase and
                          participates in the same sum)
+fleet-sim-parity         the journaled grant/preempt sequence re-derives
+                         bit-for-bit through the real policy engine
+                         (fleet/simulator.py parity replay) — placements
+                         and shrink victims the engine would not have
+                         planned mean daemon/policy drift, the condition
+                         under which `fleet whatif` counterfactuals stop
+                         being trustworthy (hold-reason wording and
+                         operator migrations are notes, not violations;
+                         non-terminal journals are skipped)
 fleet-trace-stitch       every granted job's span tree carries the
                          fleet's trace id (the grant's injected
                          tony.internal.fleet-trace-id reached the
@@ -714,6 +723,52 @@ def _check_fleet_ledger(fleet_dir: str, rep: Report) -> None:
     rep.checked["fleet-ledger"] = checked
 
 
+def _check_fleet_parity(fleet_dir: str, rep: Report) -> None:
+    """Re-derive the journal's grant/preempt sequence through the real
+    policy engine (fleet/simulator.py parity replay) and hold it
+    bit-for-bit: a placement or victim the engine would not have
+    produced means the daemon and the policy drifted — the exact
+    condition under which `fleet whatif` counterfactuals (and the
+    recorded journal itself) stop being trustworthy. Hold-decision
+    REASON WORDING may drift across daemon versions (and operator
+    migrations are exogenous), so only grant/preempt divergence is a
+    violation; everything else is a note."""
+    from tony_tpu.fleet import simulator as fsim
+    from tony_tpu.fleet import timeline as ftimeline
+
+    try:
+        tl = ftimeline.load(fleet_dir)
+        par = fsim.parity_replay(tl)
+    except Exception as e:  # noqa: BLE001 — a crashed replay IS the finding
+        rep.violations.append(Violation(
+            "fleet-sim-parity", constants.FLEET_JOURNAL_FILE, 0,
+            f"parity replay crashed over this fleet dir: {e}"))
+        return
+    if not par.get("supported"):
+        rep.notes.append(
+            f"fleet-sim-parity: skipped — {par.get('reason', '?')}")
+        return
+    counts = par.get("counts") or {}
+    rep.checked["fleet-sim-parity"] = \
+        counts.get("grant", 0) + counts.get("preempt", 0)
+    gated = {"grant", "preempt"}
+    for m in par.get("mismatches") or []:
+        if m.get("kind") in gated:
+            rep.violations.append(Violation(
+                "fleet-sim-parity", constants.FLEET_JOURNAL_FILE,
+                int(m.get("index", 0)),
+                f"record {m['index']}: journaled {m['kind']} diverges "
+                f"from the policy engine's plan — recorded "
+                f"{m['recorded']}; the engine planned {m['expected']}"))
+    soft = sum(v for k, v in (par.get("mismatch_counts") or {}).items()
+               if k not in gated)
+    if soft:
+        rep.notes.append(
+            f"fleet-sim-parity: {soft} decision/restore record(s) "
+            f"differ from the replayed plan (reason wording or "
+            f"recovery-path drift — not gated)")
+
+
 def _check_fleet_trace(fleet_dir: str, rep: Report) -> None:
     """Fleet span-log hygiene + cross-layer stitching: the fleet dir's
     own span log must be tree-consistent (non-strict: a killed daemon
@@ -860,6 +915,7 @@ def check_job_dir(job_dir: str) -> Report:
         _check_prom(os.path.join(job_dir, constants.FLEET_PROM_FILE),
                     constants.FLEET_PROM_FILE, rep)
         _check_fleet_ledger(job_dir, rep)
+        _check_fleet_parity(job_dir, rep)
         _check_fleet_trace(job_dir, rep)
         if not os.path.exists(os.path.join(job_dir,
                                            constants.JOURNAL_FILE)):

@@ -1912,9 +1912,21 @@ class FleetDaemon:
             if job.finished_ms:
                 milestones.append({"ts_ms": job.finished_ms,
                                    "what": f"finished {job.state}"})
+            from tony_tpu.fleet import timeline as ftimeline
+
             return {"ok": True, "job": job_id, "state": job.state,
                     "tenant": job.req.tenant, "app_id": job.app_id,
-                    "decisions": decisions, "milestones": milestones}
+                    "decisions": decisions,
+                    # Decision.blocking/free rolled up into attributed
+                    # hold seconds (same algebra as the offline path
+                    # and the what-if differ — fleet/timeline.py).
+                    "holds": ftimeline.holds_summary(
+                        ftimeline.hold_intervals(
+                            decisions, granted_ms=job.granted_ms,
+                            finished_ms=job.finished_ms,
+                            now_ms=int(time.time() * 1000),
+                            hosts=job.req.hosts)),
+                    "milestones": milestones}
 
     # -- alerting ---------------------------------------------------------
     def _alerts_tick(self) -> None:

@@ -451,26 +451,22 @@ def load_incident(fleet_dir: str) -> Optional[Dict[str, Any]]:
 
 def bundle_from_dir(fleet_dir: str,
                     now_ms: Optional[int] = None) -> Dict[str, Any]:
-    """Rebuild the diagnosis bundle OFFLINE from a fleet dir — journal
-    replay + ledger fold + the replayed decision history; works on a
-    dir copied off a dead host, no daemon needed."""
+    """Rebuild the diagnosis bundle OFFLINE from a fleet dir — the
+    shared timeline fold (fleet/timeline.py) + ledger fold + the
+    replayed decision history; works on a dir copied off a dead host,
+    no daemon needed."""
     from tony_tpu.fleet import journal as fjournal
     from tony_tpu.fleet import ledger as fledger
+    from tony_tpu.fleet import timeline as ftimeline
 
-    path = os.path.join(fleet_dir, constants.FLEET_JOURNAL_FILE)
-    st = fjournal.replay(path)
+    tl = ftimeline.load(fleet_dir)
+    st = tl.state
     now = int(now_ms or time.time() * 1000)
-    led = fledger.fold_fleet_dir(fleet_dir, now_ms=now)
+    led = fledger.fold_fleet_dir(fleet_dir, now_ms=now,
+                                 timeline=tl)
     queue: List[Dict[str, Any]] = []
-    grant_waits: List[float] = []
-    preempts_per_job: Dict[str, int] = {}
-    grants = preempts = 0
     used: Dict[str, int] = {}
     for fold in st.jobs.values():
-        if fold.granted_ms:
-            grants += 1
-            grant_waits.append(
-                max(0.0, (fold.granted_ms - fold.submitted_ms) / 1000.0))
         if fold.state == "QUEUED":
             queue.append({
                 "job": fold.job_id, "tenant": fold.tenant,
@@ -483,18 +479,13 @@ def bundle_from_dir(fleet_dir: str,
         elif fold.state not in fjournal.TERMINAL_STATES \
                 and fold.hosts:
             used[fold.tenant] = used.get(fold.tenant, 0) + fold.hosts
-    # preemption counts come from the raw records (the fold keeps only
-    # the final placement)
-    records, _ = _raw_records(path)
-    alert_last: Dict[str, Dict[str, Any]] = {}
-    for rec in records:
-        if rec.get("t") == fjournal.REC_FLEET_PREEMPT:
-            job = str(rec.get("job", "") or "")
-            preempts += 1
-            preempts_per_job[job] = preempts_per_job.get(job, 0) + 1
-        elif rec.get("t") == fjournal.REC_FLEET_ALERT:
-            alert_last[str(rec.get("rule", "") or "")] = rec
-    grant_waits.sort()
+    # preemption counts and the alert fold come from the timeline's raw
+    # record prefix (the job fold keeps only the final placement)
+    grants = len(tl.grant_waits)
+    preempts = tl.preemptions_total
+    preempts_per_job = dict(tl.preempts_per_job)
+    alert_last = tl.alert_last
+    grant_waits = tl.grant_waits
     median = grant_waits[len(grant_waits) // 2] if grant_waits else 0.0
     pool_dir = ""
     for fold in st.jobs.values():
@@ -540,21 +531,14 @@ def bundle_from_dir(fleet_dir: str,
     }
 
 
-def _raw_records(path: str):
-    from tony_tpu.devtools.invariants import _iter_journal_records
-
-    recs, torn = _iter_journal_records(path)
-    return [r for _, r in recs], torn
-
-
 def offline_explain(fleet_dir: str, job_id: str) -> Dict[str, Any]:
     """`fleet explain` without a daemon: rebuild the job's hold
-    timeline from the replayed REC_FLEET_DECISION records — the same
-    response shape as the fleet.explain RPC."""
-    from tony_tpu.fleet import journal as fjournal
+    timeline from the replayed REC_FLEET_DECISION records (via the
+    shared fleet/timeline.py fold) — the same response shape as the
+    fleet.explain RPC."""
+    from tony_tpu.fleet import timeline as ftimeline
 
-    st = fjournal.replay(os.path.join(fleet_dir,
-                                      constants.FLEET_JOURNAL_FILE))
+    st = ftimeline.load(fleet_dir).state
     fold = st.jobs.get(job_id)
     if fold is None:
         return {"ok": False,
@@ -577,6 +561,15 @@ def offline_explain(fleet_dir: str, job_id: str) -> Dict[str, Any]:
     return {"ok": True, "job": job_id, "state": fold.state,
             "tenant": fold.tenant, "app_id": fold.app_id,
             "decisions": list(fold.decisions),
+            # Decision.blocking/free threaded through as attributed
+            # hold seconds: which jobs blocked this one, under which
+            # hold kind, for how long — the citation `fleet whatif`
+            # diffs against when a counterfactual removes a hold.
+            "holds": ftimeline.holds_summary(ftimeline.hold_intervals(
+                fold.decisions, granted_ms=fold.granted_ms,
+                finished_ms=fold.finished_ms,
+                now_ms=int(time.time() * 1000),
+                hosts=fold.hosts_requested)),
             "milestones": milestones, "offline": True}
 
 
@@ -613,6 +606,17 @@ def render_explain(doc: Dict[str, Any]) -> str:
         if r["blocking"]:
             out.append(f"  {'':14}blocking: "
                        f"{', '.join(str(b) for b in r['blocking'])}")
+    holds = doc.get("holds") or {}
+    if holds:
+        parts = []
+        for kind in sorted(holds):
+            h = holds[kind]
+            cite = f" (blocking: {', '.join(h['blocking'])})" \
+                if h.get("blocking") else ""
+            free = f", {h['free']} free" \
+                if kind == "fragmentation" else ""
+            parts.append(f"{kind} {h['seconds']}s{free}{cite}")
+        out.append(f"  held: {'; '.join(parts)}")
     return "\n".join(out)
 
 

@@ -389,14 +389,16 @@ def job_history_dirs(fleet_dir: str) -> Dict[str, str]:
 
 
 def fold_fleet_dir(fleet_dir: str,
-                   now_ms: Optional[int] = None) -> Dict[str, Any]:
-    """Offline entry: replay the fleet journal, resolve each job's
-    history dir, compute every ledger and the rollup — what `tony-tpu
-    check`, `fleet diagnose` (offline) and the bench suite consume."""
-    from tony_tpu.fleet import journal as fjournal
+                   now_ms: Optional[int] = None,
+                   timeline=None) -> Dict[str, Any]:
+    """Offline entry: fold the fleet journal (via the shared
+    fleet/timeline.py replay — pass ``timeline`` to reuse a fold the
+    caller already paid for), resolve each job's history dir, compute
+    every ledger and the rollup — what `tony-tpu check`,
+    `fleet diagnose` (offline) and the bench suite consume."""
+    from tony_tpu.fleet import timeline as ftimeline
 
-    path = os.path.join(fleet_dir, constants.FLEET_JOURNAL_FILE)
-    st = fjournal.replay(path)
+    st = (timeline or ftimeline.load(fleet_dir)).state
     dirs = job_history_dirs(fleet_dir)
     jobs: Dict[str, Dict[str, Any]] = {}
     for job_id, fold in sorted(st.jobs.items()):

@@ -453,14 +453,15 @@ class PolicyEngine:
              and r.req.min_hosts > 0 and r.hosts > r.req.min_hosts),
             key=lambda r: (r.req.priority, -r.req.seq))
         shrinks: List[Decision] = []
+        trial = tentative.clone()
         for v in victims:
-            if tentative.place(req.hosts) is not None:
+            if trial.place(req.hosts) is not None:
                 break
             placement = dict(v.placement)
             to = v.hosts
             while to > v.req.min_hosts \
-                    and tentative.place(req.hosts) is None:
-                tentative.shrink(placement, 1)
+                    and trial.place(req.hosts) is None:
+                trial.shrink(placement, 1)
                 to -= 1
             if to < v.hosts:
                 shrinks.append(Decision(
@@ -469,9 +470,14 @@ class PolicyEngine:
                     reason=f"reclaim {v.hosts - to} host(s) for "
                            f"{req.job_id!r} (priority {req.priority} > "
                            f"{v.req.priority})"))
-        # tentative stays mutated on failure too — harmless: schedule()
-        # holds the head of the line right after this either way.
-        return shrinks if tentative.place(req.hosts) is not None else []
+        if not shrinks or trial.place(req.hosts) is None:
+            # Failure MUST leave ``tentative`` untouched: schedule()
+            # falls through to _plan_defrag next, and a defrag placement
+            # computed against phantom reclaimed capacity is a MIGRATE
+            # nobody can apply (migrate_applied would overfill a slice).
+            return []
+        tentative._free = list(trial._free)
+        return shrinks
 
     def _plan_defrag(self, req: JobRequest,
                      tentative: SlicePool) -> List[Decision]:

@@ -187,6 +187,11 @@ class PortalServer:
                 # SLO/alert rollup (tony_tpu/alerts/): fleet-scope rule
                 # state + every job's journaled alert fold.
                 return self._alerts_view(req, as_json)
+            if parts == ["whatif"]:
+                # Fleet time machine (fleet/simulator.py): replay the
+                # recorded journal under counterfactual quotas/pool/
+                # priorities passed as query params.
+                return self._whatif_view(req, query, as_json)
             view, *rest = parts
             if view in ("config", "jobs", "logs", "logfile",
                         "profiles", "profile", "metrics", "trace",
@@ -227,7 +232,9 @@ class PortalServer:
         body = ["<h1>tony-tpu jobs</h1>"]
         if self.fleet_dir:
             body.append("<p><a href='/fleet'>fleet scheduler</a> — "
-                        "queue, tenants, grants</p>")
+                        "queue, tenants, grants · "
+                        "<a href='/whatif'>whatif</a> — counterfactual "
+                        "replay</p>")
         body.append("<p><a href='/alerts'>alerts</a> — SLO rule "
                     "state, fleet + per job</p>")
         body += ["<table border=1 cellpadding=4>",
@@ -314,7 +321,9 @@ class PortalServer:
                 f"{pool.get('used', '?')}/{pool.get('total', '?')} used "
                 f"({pool.get('free', '?')} free), queue depth "
                 f"{snap.get('queue_depth', '?')}, wait p50 "
-                f"{qw.get('p50_s', 0)}s / p99 {qw.get('p99_s', 0)}s</p>"]
+                f"{qw.get('p50_s', 0)}s / p99 {qw.get('p99_s', 0)}s — "
+                f"<a href='/whatif'>whatif</a> (counterfactual "
+                f"replay)</p>"]
         # Fleet incident verdict (fleet/diagnose.py): the daemon
         # refreshes fleet.incident.json every export; torn/absent
         # degrades to no banner (same posture as incident.json).
@@ -413,6 +422,96 @@ class PortalServer:
         if prom:
             body.append("<h2>tony_fleet_* exposition</h2><pre>"
                         + html.escape(prom) + "</pre>")
+        self._send_html(req, "".join(body))
+
+    def _whatif_view(self, req, query: Dict[str, List[str]],
+                     as_json: bool) -> None:
+        """Counterfactual replay of the recorded fleet journal
+        (fleet/simulator.py): ``/whatif?quota=tenant=4&pool=2x8&
+        priority=job=10&set=k=v&sweep=k=a,b,c``. Always recomputed —
+        the journal grows while the daemon lives, and each query is a
+        different experiment; the 50-job scale this targets re-folds in
+        well under a second (BENCH_WHATIF budget: 5 s)."""
+        if not self.fleet_dir:
+            return self._send(req, 404, "text/plain",
+                              b"no fleet dir configured or discovered")
+        from tony_tpu.fleet import simulator as fsim
+
+        try:
+            report = fsim.whatif_from_dir(
+                self.fleet_dir, sets=query.get("set"),
+                quotas=query.get("quota"),
+                pool=(query.get("pool") or [""])[0] or None,
+                priorities=query.get("priority"),
+                sweeps=query.get("sweep"))
+        except ValueError as e:
+            return self._send(req, 400, "text/plain",
+                              f"whatif: {e}".encode())
+        except Exception as e:  # noqa: BLE001 — view stays up
+            return self._send(req, 404, "text/plain",
+                              f"whatif unavailable: {e}".encode())
+        if as_json:
+            return self._send_json(req, report)
+        body = [f"<h1>fleet whatif — "
+                f"{html.escape(str(report.get('journal')))}</h1>",
+                "<p><a href='/fleet'>fleet</a> — recorded state. "
+                "Query params: <code>quota=tenant=N</code>, "
+                "<code>pool=SxH</code>, <code>priority=job=P</code>, "
+                "<code>set=key=value</code>, "
+                "<code>sweep=key=a,b,c</code> (repeatable).</p>"]
+        par = report.get("parity") or {}
+        if par.get("ok"):
+            body.append("<p><b>parity: OK</b> — the recorded sequence "
+                        "reproduces bit-for-bit; counterfactuals are "
+                        "trustworthy</p>")
+        elif not par.get("supported"):
+            body.append(f"<p><b>parity: skipped</b> — "
+                        f"{html.escape(str(par.get('reason', '')))}</p>")
+        else:
+            gate = "grant/preempt gate holds" if par.get("gate_ok") \
+                else "grant/preempt gate BROKEN"
+            body.append(f"<p><b>parity: "
+                        f"{html.escape(json.dumps(par.get('mismatch_counts')))}"
+                        f"</b> — {gate}</p>")
+        rec = (report.get("recorded") or {}).get("metrics") or {}
+        base = (report.get("base") or {}).get("metrics") or {}
+        cfs = report.get("counterfactuals") or []
+        keys = [k for k in fsim._TABLE_KEYS if k in rec or k in base]
+        body.append("<table border=1 cellpadding=4><tr><th>metric</th>"
+                    "<th>recorded</th><th>sim-base</th>"
+                    + "".join(f"<th>{html.escape(c['label'])}</th>"
+                              for c in cfs) + "</tr>")
+        for k in keys:
+            cells = ""
+            for c in cfs:
+                entry = (c.get("diff") or {}).get(k) or {}
+                v = entry.get("counterfactual",
+                              (c.get("metrics") or {}).get(k))
+                mark = ""
+                if entry.get("improves") is True:
+                    mark = " ✓"
+                elif entry.get("improves") is False:
+                    mark = " ✗"
+                cells += f"<td>{html.escape(fsim._cell(v))}{mark}</td>"
+            body.append(f"<tr><td>{html.escape(k)}</td>"
+                        f"<td>{html.escape(fsim._cell(rec.get(k)))}</td>"
+                        f"<td>{html.escape(fsim._cell(base.get(k)))}</td>"
+                        + cells + "</tr>")
+        body.append("</table>")
+        for c in cfs:
+            removed = c.get("holds_removed") or []
+            if not removed:
+                continue
+            body.append(f"<h2>{html.escape(c['label'])} — holds "
+                        f"removed</h2><ul>")
+            for h in removed:
+                blocking = ", ".join(h.get("was_blocking") or []) or "—"
+                body.append(
+                    f"<li>tenant <b>{html.escape(h['tenant'])}</b>: "
+                    f"{h['removed_s']}s of "
+                    f"{html.escape(h['hold'].replace('_s', ''))} "
+                    f"(was blocking: {html.escape(blocking)})</li>")
+            body.append("</ul>")
         self._send_html(req, "".join(body))
 
     def _job_alerts(self, job_id: str) -> Dict[str, str]:

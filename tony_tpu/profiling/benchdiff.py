@@ -44,7 +44,11 @@ _HIGHER = ("tokens_per_sec", "samples_per_sec", "mfu_vs_peak_bf16",
            # BENCH_MIGRATE family (bench --suite migrate): share of the
            # synchronous save cost the async writer hides, and the
            # destination gang's warm-pool adoption rate.
-           "ckpt_overlap_fraction", "warm_adoption_fraction")
+           "ckpt_overlap_fraction", "warm_adoption_fraction",
+           # BENCH_WHATIF family (bench --suite whatif): the
+           # counterfactual's fractional queue-wait payoff on the
+           # starved tenant, and how full the pool ran in the sim.
+           "improvement_fraction", "utilization_fraction")
 #: metric-name suffixes where smaller is better
 _LOWER = ("submit_to_first_step_s", "probe_self_reported_s",
           "phase_total_s", "seconds_per_step", "mean_step_s",
@@ -58,7 +62,14 @@ _LOWER = ("submit_to_first_step_s", "probe_self_reported_s",
           # BENCH_MIGRATE family: the move's wall, training steps the
           # move lost (the e2e drills pin 0), and save()-blocking share
           # of the step loop under the async snapshot writer.
-          "migration_wall_s", "steps_lost", "ckpt_stall_fraction")
+          "migration_wall_s", "steps_lost", "ckpt_stall_fraction",
+          # BENCH_WHATIF family (bench --suite whatif, fleet time
+          # machine): policy-parity divergences (must pin 0), the full
+          # report's fold wall, the recorded mix's end-to-end span, and
+          # per-kind hold seconds the counterfactual differ attributes.
+          "parity_mismatches", "sim_wall_s", "makespan_s",
+          "quota_hold_s", "capacity_hold_s", "fragmentation_hold_s",
+          "preempt_wait_hold_s", "priority_hold_s")
 #: path components under which every plain numeric leaf is seconds of a
 #: phase breakdown → lower is better
 _LOWER_CONTAINERS = ("phases", "step_phases_s", "phase_span_durations")
